@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Mirrors the reference's strategy of testing distributed semantics without a
+cluster (test_utils.py:166-205): sharding/resharding tests run on 8 virtual
+CPU devices; multi-process semantics are tested with real subprocesses.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,105 @@
+"""Flatten/inflate round-trip tests (reference pattern: tests/test_flatten.py)."""
+
+from collections import OrderedDict, namedtuple
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.flatten import flatten, inflate
+from torchsnapshot_tpu.manifest import DictEntry, ListEntry, NamedTupleEntry
+
+Point = namedtuple("Point", ["x", "y"])
+
+
+def test_roundtrip_nested() -> None:
+    obj = {
+        "model": OrderedDict(
+            [("w", np.ones((2, 2))), ("b", np.zeros(3))],
+        ),
+        "step": 7,
+        "history": [1.0, 2.0, {"nested": "deep"}],
+        "coords": (1, 2, 3),
+    }
+    manifest, flattened = flatten(obj, prefix="app")
+    out = inflate(manifest, flattened, prefix="app")
+    assert out["step"] == 7
+    assert isinstance(out["model"], OrderedDict)
+    np.testing.assert_array_equal(out["model"]["w"], obj["model"]["w"])
+    assert out["history"][2] == {"nested": "deep"}
+    assert out["coords"] == (1, 2, 3)
+    assert isinstance(out["coords"], tuple)
+
+
+def test_namedtuple_roundtrip() -> None:
+    obj = {"pt": Point(x=np.ones(2), y=3)}
+    manifest, flattened = flatten(obj, prefix="s")
+    entry = manifest["s/pt"]
+    assert isinstance(entry, NamedTupleEntry)
+    assert entry.fields == ["x", "y"]
+    out = inflate(manifest, flattened, prefix="s")
+    assert isinstance(out["pt"], Point)
+    assert out["pt"].y == 3
+
+
+def test_optax_state_flattens() -> None:
+    import jax.numpy as jnp
+    import optax
+
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros(3)}
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    manifest, flattened = flatten({"opt": state}, prefix="0")
+    out = inflate(manifest, flattened, prefix="0")
+    # The reconstructed state must work as an optax state again.
+    import jax
+
+    grads = jax.tree.map(jnp.ones_like, params)
+    opt.update(grads, out["opt"], params)
+
+
+def test_key_escaping() -> None:
+    obj = {"a/b": 1, "a%2Fb": 2, "c": {"d/e%": 3}}
+    manifest, flattened = flatten(obj, prefix="r")
+    assert len(flattened) == 3
+    out = inflate(manifest, flattened, prefix="r")
+    assert out == obj
+
+
+def test_int_keys_preserved() -> None:
+    obj = {0: "a", 1: "b", "k": {2: "c"}}
+    manifest, flattened = flatten(obj, prefix="")
+    out = inflate(manifest, flattened, prefix="")
+    assert out == obj
+    assert set(out.keys()) == {0, 1, "k"}
+
+
+def test_colliding_keys_rejected() -> None:
+    with pytest.raises(RuntimeError, match="collide"):
+        flatten({1: "a", "1": "b"}, prefix="")
+
+
+def test_unsupported_key_type_rejected() -> None:
+    with pytest.raises(RuntimeError, match="unsupported key type"):
+        flatten({(1, 2): "a"}, prefix="")
+
+
+def test_empty_containers() -> None:
+    obj = {"empty_list": [], "empty_dict": {}, "t": ()}
+    manifest, flattened = flatten(obj, prefix="p")
+    assert flattened == {}
+    out = inflate(manifest, flattened, prefix="p")
+    assert out == obj
+
+
+def test_leaf_at_root() -> None:
+    manifest, flattened = flatten(42, prefix="x")
+    assert manifest == {}
+    assert flattened == {"x": 42}
+    assert inflate(manifest, flattened, prefix="x") == 42
+
+
+def test_manifest_entries_are_expected_types() -> None:
+    manifest, _ = flatten({"l": [1], "d": {"k": 2}}, prefix="0")
+    assert isinstance(manifest["0"], DictEntry)
+    assert isinstance(manifest["0/l"], ListEntry)
+    assert manifest["0/d"].keys == ["k"]

@@ -1,0 +1,149 @@
+"""Manifest golden-data + elasticity tests (reference: tests/test_manifest.py:21-441)."""
+
+import pytest
+
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    DictEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_available_entries,
+    get_manifest_for_rank,
+    is_replicated,
+)
+
+
+def _array(location: str, replicated: bool = False) -> ArrayEntry:
+    return ArrayEntry(
+        location=location,
+        serializer="buffer_protocol",
+        dtype="float32",
+        shape=[4, 4],
+        replicated=replicated,
+    )
+
+
+def _shard(off, sz, location) -> Shard:
+    return Shard(offsets=off, sizes=sz, array=_array(location))
+
+
+@pytest.fixture
+def global_manifest():
+    return {
+        "0/state/step": PrimitiveEntry.from_object(100, replicated=False),
+        "1/state/step": PrimitiveEntry.from_object(100, replicated=False),
+        "0/model/weight": _array("replicated/model/weight", replicated=True),
+        "1/model/weight": _array("replicated/model/weight", replicated=True),
+        "0/model/emb": ShardedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            shards=[_shard([0, 0], [4, 4], "sharded/model/emb_0_0")],
+        ),
+        "1/model/emb": ShardedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            shards=[_shard([4, 0], [4, 4], "sharded/model/emb_4_0")],
+        ),
+        "0/extra/local": _array("0/extra/local"),
+        "0/obj": ObjectEntry(
+            location="0/obj", serializer="pickle", obj_type="Foo", replicated=False
+        ),
+        "0": DictEntry(keys=["state", "model", "extra", "obj"]),
+        "0/state": OrderedDictEntry(keys=["step"]),
+        "1": DictEntry(keys=["state", "model"]),
+        "1/state": OrderedDictEntry(keys=["step"]),
+    }
+
+
+def test_rank0_view(global_manifest) -> None:
+    avail = get_available_entries(global_manifest, 0)
+    assert avail["state/step"].get_value() == 100
+    assert avail["model/weight"].replicated
+    assert len(avail["model/emb"].shards) == 2  # merged across ranks
+    assert "extra/local" in avail
+    assert "obj" in avail
+    # container entries excluded
+    assert "state" not in avail
+
+
+def test_rank1_view(global_manifest) -> None:
+    avail = get_available_entries(global_manifest, 1)
+    assert "extra/local" not in avail  # per-rank, owned by rank 0
+    assert "obj" not in avail
+    assert "state/step" in avail  # rank 1 saved its own
+    assert len(avail["model/emb"].shards) == 2
+
+
+def test_larger_world_rank42(global_manifest) -> None:
+    # A rank beyond the saving world size sees replicated + sharded only.
+    avail = get_available_entries(global_manifest, 42)
+    assert set(avail) == {"model/weight", "model/emb"}
+
+
+def test_yaml_roundtrip(global_manifest) -> None:
+    md = SnapshotMetadata(version="0.1.0", world_size=2, manifest=global_manifest)
+    restored = SnapshotMetadata.from_yaml(md.to_yaml())
+    assert restored.version == "0.1.0"
+    assert restored.world_size == 2
+    assert set(restored.manifest) == set(global_manifest)
+    emb = restored.manifest["0/model/emb"]
+    assert isinstance(emb, ShardedArrayEntry)
+    assert emb.shards[0].offsets == [0, 0]
+    assert emb.shards[0].array.location == "sharded/model/emb_0_0"
+    step = restored.manifest["0/state/step"]
+    assert step.get_value() == 100
+    assert isinstance(restored.manifest["0/state"], OrderedDictEntry)
+
+
+def test_primitive_float_bit_exact() -> None:
+    val = 0.1 + 0.2  # not representable exactly
+    entry = PrimitiveEntry.from_object(val)
+    rt = SnapshotMetadata(version="v", world_size=1, manifest={"0/x": entry})
+    restored = SnapshotMetadata.from_yaml(rt.to_yaml())
+    assert restored.manifest["0/x"].get_value() == val
+
+
+def test_primitive_types() -> None:
+    for val in [3, -1, True, False, "hello", b"\x00\xff", None, 2.5]:
+        entry = PrimitiveEntry.from_object(val)
+        assert entry.get_value() == val
+        assert type(entry.get_value()) is type(val)
+
+
+def test_chunked_entry_roundtrip() -> None:
+    entry = ChunkedArrayEntry(
+        dtype="bfloat16",
+        shape=[100, 10],
+        chunks=[
+            _shard([0, 0], [50, 10], "replicated/x_0_0"),
+            _shard([50, 0], [50, 10], "replicated/x_50_0"),
+        ],
+        replicated=True,
+    )
+    md = SnapshotMetadata(version="v", world_size=1, manifest={"0/x": entry})
+    restored = SnapshotMetadata.from_yaml(md.to_yaml()).manifest["0/x"]
+    assert restored.chunks[1].offsets == [50, 0]
+    assert is_replicated(restored)
+
+
+def test_get_manifest_for_rank_includes_containers(global_manifest) -> None:
+    md = SnapshotMetadata(version="v", world_size=2, manifest=global_manifest)
+    m0 = get_manifest_for_rank(md, 0)
+    assert isinstance(m0[""], DictEntry)  # rank-root container present
+    assert "state" in m0 and isinstance(m0["state"], OrderedDictEntry)
+    # new rank falls back to rank 0's containers
+    m42 = get_manifest_for_rank(md, 42)
+    assert "state" in m42
+
+
+def test_byte_range_persisted() -> None:
+    e = _array("batched/abc")
+    e.byte_range = [128, 256]
+    md = SnapshotMetadata(version="v", world_size=1, manifest={"0/t": e})
+    restored = SnapshotMetadata.from_yaml(md.to_yaml()).manifest["0/t"]
+    assert restored.byte_range == [128, 256]

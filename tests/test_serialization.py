@@ -1,0 +1,94 @@
+"""Dtype-matrix round-trip tests for the serialization codecs.
+
+Mirrors the reference's parametrized dtype coverage
+(tests/test_tensor_io_preparer.py:104-107) extended to ml_dtypes.
+"""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.serialization import (
+    SUPPORTED_DTYPE_STRINGS,
+    array_as_memoryview,
+    array_from_buffer,
+    array_size_bytes,
+    dtype_to_string,
+    object_as_bytes,
+    object_from_bytes,
+    string_to_dtype,
+)
+
+
+def _rand_array(dtype_str: str, shape=(7, 5)) -> np.ndarray:
+    dtype = string_to_dtype(dtype_str)
+    rng = np.random.default_rng(0)
+    if dtype_str == "bool":
+        return rng.integers(0, 2, size=shape).astype(bool)
+    if dtype_str.startswith(("int", "uint")):
+        hi = 2 if dtype_str.endswith("2") else (8 if dtype_str.endswith("4") else 100)
+        return rng.integers(0, hi, size=shape).astype(dtype)
+    if dtype_str.startswith("complex"):
+        return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(dtype)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype_str", sorted(SUPPORTED_DTYPE_STRINGS))
+def test_roundtrip_all_dtypes(dtype_str: str) -> None:
+    arr = _rand_array(dtype_str)
+    mv = array_as_memoryview(arr)
+    assert len(mv) == array_size_bytes(arr.shape, dtype_str)
+    out = array_from_buffer(bytes(mv), dtype_str, arr.shape)
+    assert out.dtype == string_to_dtype(dtype_str)
+    assert out.shape == arr.shape
+    # Bitwise equality: the strongest round-trip guarantee, and robust to
+    # dtypes whose values can't be compared (e8m0 NaN etc.).
+    assert bytes(array_as_memoryview(out)) == bytes(mv)
+
+
+def test_memoryview_is_zero_copy() -> None:
+    arr = np.arange(16, dtype=np.float32).reshape(4, 4)
+    mv = array_as_memoryview(arr)
+    arr[0, 0] = 42.0
+    assert np.frombuffer(mv, dtype=np.float32)[0] == 42.0
+
+
+def test_non_contiguous_input() -> None:
+    arr = np.arange(24, dtype=np.int32).reshape(4, 6)[:, ::2]
+    mv = array_as_memoryview(arr)
+    out = array_from_buffer(bytes(mv), "int32", arr.shape)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_scalar_shape() -> None:
+    arr = np.float64(3.5)
+    mv = array_as_memoryview(np.asarray(arr))
+    out = array_from_buffer(bytes(mv), "float64", ())
+    assert out == arr
+
+
+def test_dtype_string_stability() -> None:
+    # On-disk format: these names must never change meaning.
+    for name in ["float32", "bfloat16", "int8", "bool", "float8_e4m3fn"]:
+        if name in SUPPORTED_DTYPE_STRINGS:
+            assert dtype_to_string(string_to_dtype(name)) == name
+
+
+def test_unknown_dtype_string_raises() -> None:
+    with pytest.raises(ValueError, match="Unknown dtype"):
+        string_to_dtype("float1337")
+
+
+def test_object_roundtrip() -> None:
+    obj = {"a": [1, 2, (3, "x")], "b": {4, 5}}
+    assert object_from_bytes(object_as_bytes(obj)) == obj
+
+
+def test_jax_array_to_numpy_roundtrip() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4)
+    host = np.asarray(jax.device_get(x))
+    mv = array_as_memoryview(host)
+    out = array_from_buffer(bytes(mv), "bfloat16", (3, 4))
+    np.testing.assert_array_equal(out, host)

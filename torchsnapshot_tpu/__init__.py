@@ -1,0 +1,13 @@
+from .version import __version__  # noqa: F401
+
+# Populated progressively as layers land; the full public surface mirrors the
+# reference's __init__ (Snapshot, Stateful, StateDict, RNGState, __version__).
+from .manifest import SnapshotMetadata  # noqa: F401
+
+try:
+    from .stateful import AppState, Stateful  # noqa: F401
+    from .state_dict import StateDict  # noqa: F401
+    from .rng_state import RNGState  # noqa: F401
+    from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
+except ImportError:  # pragma: no cover - during incremental bring-up only
+    pass

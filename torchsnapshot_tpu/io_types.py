@@ -1,0 +1,103 @@
+"""Layer-2/3 contracts between I/O preparation, execution, and storage.
+
+TPU-native analogue of the reference's io_types (torchsnapshot/io_types.py:19-103):
+the scheduler operates purely on bytes + cost callbacks, so it stays agnostic of
+jax.Array vs numpy vs pickled objects. Buffer stagers perform the device->host
+boundary crossing (async DMA via jax.Array.copy_to_host_async); buffer consumers
+perform host->device materialization.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, List, Optional, Tuple, Union
+
+BufferType = Union[bytes, bytearray, memoryview]
+
+
+@dataclass
+class WriteIO:
+    """A single write of a buffer to a storage path."""
+
+    path: str
+    buf: BufferType
+
+
+@dataclass
+class ReadIO:
+    """A single read of a storage path, optionally a byte range [lo, hi)."""
+
+    path: str
+    buf: bytearray = field(default_factory=bytearray)
+    byte_range: Optional[Tuple[int, int]] = None
+
+
+class BufferStager(abc.ABC):
+    """Produces the bytes to be written for one write request.
+
+    ``stage_buffer`` runs inside the scheduler's staging pipeline under the
+    memory budget. For device arrays this is where the DtoH copy happens.
+    """
+
+    @abc.abstractmethod
+    async def stage_buffer(self, executor=None) -> BufferType:
+        ...
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int:
+        """Peak host memory the staged buffer will occupy."""
+        ...
+
+
+class BufferConsumer(abc.ABC):
+    """Consumes the bytes read for one read request."""
+
+    @abc.abstractmethod
+    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int:
+        """Peak host memory needed while consuming the buffer."""
+        ...
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    byte_range: Optional[Tuple[int, int]] = None
+
+
+class StoragePlugin(abc.ABC):
+    """Storage backend interface (reference: io_types.py:54-103).
+
+    Byte-range reads are first-class: the batcher and chunked-read paths rely
+    on them. Implementations must be safe to drive from an asyncio event loop.
+    """
+
+    @abc.abstractmethod
+    async def write(self, write_io: WriteIO) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def read(self, read_io: ReadIO) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def close(self) -> None:
+        ...
+
+    def sync_close(self, event_loop) -> None:
+        event_loop.run_until_complete(self.close())

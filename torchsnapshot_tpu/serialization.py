@@ -1,0 +1,142 @@
+"""Array <-> bytes codecs with an explicit, forward-compatible dtype table.
+
+TPU-native redesign of the reference's serialization layer
+(torchsnapshot/serialization.py:49-213):
+
+- Every JAX dtype — including bfloat16 and the float8/int4 families via
+  ml_dtypes — is serialized through the buffer protocol with zero copies.
+  The reference needed an untyped-storage hack for bf16 and a torch.save
+  fallback for unsupported dtypes; neither is needed here. Sub-word dtypes
+  (int4 etc.) are stored in ml_dtypes' one-byte-per-element layout.
+- Arbitrary Python objects use pickle (the reference used torch.save, which
+  is pickle with a zip envelope).
+- There is no quantized-tensor codec: JAX has no quantized array type.
+  Quantized models store int8/fp8 arrays with scale/zero-point as separate
+  leaves, which round-trip through the ordinary array path. This is an
+  intentional divergence documented here for parity review.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from enum import Enum
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; tolerate standalone use
+    import ml_dtypes
+
+    _ML_DTYPE_NAMES = [
+        "bfloat16",
+        "float8_e4m3",
+        "float8_e4m3fn",
+        "float8_e4m3fnuz",
+        "float8_e4m3b11_fnuz",
+        "float8_e5m2",
+        "float8_e5m2fnuz",
+        "float8_e3m4",
+        "float8_e8m0fnu",
+        "float4_e2m1fn",
+        "float6_e2m3fn",
+        "float6_e3m2fn",
+        "int4",
+        "uint4",
+        "int2",
+        "uint2",
+    ]
+    _ML_DTYPES = {
+        name: np.dtype(getattr(ml_dtypes, name))
+        for name in _ML_DTYPE_NAMES
+        if hasattr(ml_dtypes, name)
+    }
+except ImportError:  # pragma: no cover
+    _ML_DTYPES = {}
+
+_NUMPY_DTYPE_NAMES = [
+    "float16",
+    "float32",
+    "float64",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "bool",
+    "complex64",
+    "complex128",
+]
+
+# Explicit string <-> dtype tables. New dtypes must be added here consciously
+# so that on-disk metadata stays forward-compatible (reference pattern:
+# serialization.py:49-94).
+STRING_TO_DTYPE = {name: np.dtype(name) for name in _NUMPY_DTYPE_NAMES}
+STRING_TO_DTYPE.update(_ML_DTYPES)
+DTYPE_TO_STRING = {dtype: name for name, dtype in STRING_TO_DTYPE.items()}
+
+SUPPORTED_DTYPE_STRINGS = frozenset(STRING_TO_DTYPE)
+
+
+class Serializer(Enum):
+    BUFFER_PROTOCOL = "buffer_protocol"
+    PICKLE = "pickle"
+
+
+def dtype_to_string(dtype: Any) -> str:
+    dtype = np.dtype(dtype)
+    try:
+        return DTYPE_TO_STRING[dtype]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype for serialization: {dtype}") from None
+
+
+def string_to_dtype(s: str) -> np.dtype:
+    try:
+        return STRING_TO_DTYPE[s]
+    except KeyError:
+        raise ValueError(
+            f"Unknown dtype string {s!r} in snapshot metadata. "
+            "The snapshot may have been written by a newer version."
+        ) from None
+
+
+def dtype_size_bytes(s: str) -> int:
+    return string_to_dtype(s).itemsize
+
+
+def array_size_bytes(shape: Sequence[int], dtype_str: str) -> int:
+    return int(np.prod(shape, dtype=np.int64)) * dtype_size_bytes(dtype_str) if shape else dtype_size_bytes(dtype_str)
+
+
+def array_as_memoryview(arr: np.ndarray) -> memoryview:
+    """Zero-copy memoryview of a numpy array of any supported dtype.
+
+    ml_dtypes dtypes don't expose a buffer-protocol format, so we view the
+    (contiguous) array as flat uint8 — always zero-copy for contiguous input.
+    """
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return memoryview(arr.reshape(-1).view(np.uint8))
+
+
+def array_from_buffer(
+    buf: Any, dtype_str: str, shape: Sequence[int]
+) -> np.ndarray:
+    """Zero-copy numpy view over serialized bytes (read-only if buf is)."""
+    dtype = string_to_dtype(dtype_str)
+    flat = np.frombuffer(buf, dtype=np.uint8)
+    return flat.view(dtype).reshape(tuple(shape))
+
+
+def object_as_bytes(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def object_from_bytes(buf: Any) -> Any:
+    return pickle.loads(bytes(buf) if isinstance(buf, memoryview) else buf)
