@@ -1,0 +1,60 @@
+"""FS storage plugin tests (reference: tests/test_fs_storage_plugin.py:26)."""
+
+import asyncio
+import os
+
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def test_write_read_delete(tmp_path, loop) -> None:
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(4096)
+
+    loop.run_until_complete(plugin.write(WriteIO(path="a/b/c.bin", buf=payload)))
+    assert (tmp_path / "a" / "b" / "c.bin").read_bytes() == payload
+
+    read_io = ReadIO(path="a/b/c.bin")
+    loop.run_until_complete(plugin.read(read_io))
+    assert bytes(read_io.buf) == payload
+
+    ranged = ReadIO(path="a/b/c.bin", byte_range=(100, 200))
+    loop.run_until_complete(plugin.read(ranged))
+    assert bytes(ranged.buf) == payload[100:200]
+
+    loop.run_until_complete(plugin.delete("a/b/c.bin"))
+    assert not (tmp_path / "a" / "b" / "c.bin").exists()
+    loop.run_until_complete(plugin.close())
+
+
+def test_memoryview_write(tmp_path, loop) -> None:
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = bytearray(b"hello world" * 100)
+    loop.run_until_complete(
+        plugin.write(WriteIO(path="mv.bin", buf=memoryview(payload)))
+    )
+    read_io = ReadIO(path="mv.bin")
+    loop.run_until_complete(plugin.read(read_io))
+    assert bytes(read_io.buf) == bytes(payload)
+
+
+def test_url_resolution(tmp_path) -> None:
+    for url in [str(tmp_path), f"fs://{tmp_path}"]:
+        plugin = url_to_storage_plugin(url)
+        assert isinstance(plugin, FSStoragePlugin)
+        assert plugin.root == str(tmp_path)
+
+
+def test_unknown_protocol_raises() -> None:
+    with pytest.raises(RuntimeError, match="Failed to resolve storage plugin"):
+        url_to_storage_plugin("bogus://bucket/path")

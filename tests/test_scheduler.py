@@ -1,0 +1,218 @@
+"""Scheduler pipeline tests: budget compliance, starvation escape, pipelining.
+
+Reference patterns: plan-level tests with in-memory storage
+(tests/test_batcher.py:268-281 style) + white-box budget assertions.
+"""
+
+import asyncio
+from typing import Dict, Optional
+
+import pytest
+
+from torchsnapshot_tpu.io_types import (
+    BufferConsumer,
+    BufferStager,
+    ReadIO,
+    ReadReq,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+)
+from torchsnapshot_tpu.scheduler import (
+    execute_write_reqs,
+    execute_read_reqs,
+    get_process_memory_budget_bytes,
+    sync_execute_read_reqs,
+    sync_execute_write_reqs,
+)
+
+
+class InMemoryStoragePlugin(StoragePlugin):
+    def __init__(self, delay: float = 0.0) -> None:
+        self.storage: Dict[str, bytes] = {}
+        self.delay = delay
+
+    async def write(self, write_io: WriteIO) -> None:
+        await asyncio.sleep(self.delay)
+        self.storage[write_io.path] = bytes(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        await asyncio.sleep(self.delay)
+        data = self.storage[read_io.path]
+        if read_io.byte_range is not None:
+            lo, hi = read_io.byte_range
+            data = data[lo:hi]
+        read_io.buf = bytearray(data)
+
+    async def delete(self, path: str) -> None:
+        del self.storage[path]
+
+    async def close(self) -> None:
+        pass
+
+
+class TrackingStager(BufferStager):
+    """Stager instrumented to observe peak concurrent staging cost."""
+
+    live_bytes = 0
+    peak_bytes = 0
+
+    def __init__(self, payload: bytes, delay: float = 0.005) -> None:
+        self.payload = payload
+        self.delay = delay
+
+    async def stage_buffer(self, executor=None):
+        cls = TrackingStager
+        cls.live_bytes += len(self.payload)
+        cls.peak_bytes = max(cls.peak_bytes, cls.live_bytes)
+        await asyncio.sleep(self.delay)
+        # NOTE: live_bytes decremented when I/O completes (the scheduler holds
+        # the buffer until written) — handled by the storage wrapper below.
+        return self.payload
+
+    def get_staging_cost_bytes(self) -> int:
+        return len(self.payload)
+
+
+class ReleasingStoragePlugin(InMemoryStoragePlugin):
+    async def write(self, write_io: WriteIO) -> None:
+        await super().write(write_io)
+        TrackingStager.live_bytes -= len(write_io.buf)
+
+
+class SimpleConsumer(BufferConsumer):
+    def __init__(self, sink: Dict[str, bytes], key: str, cost: int) -> None:
+        self.sink = sink
+        self.key = key
+        self.cost = cost
+
+    async def consume_buffer(self, buf, executor=None) -> None:
+        self.sink[self.key] = bytes(buf)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self.cost
+
+
+def _make_write_reqs(n: int, size: int):
+    return [
+        WriteReq(path=f"obj_{i}", buffer_stager=TrackingStager(bytes([i % 256]) * size))
+        for i in range(n)
+    ]
+
+
+def _reset_tracking():
+    TrackingStager.live_bytes = 0
+    TrackingStager.peak_bytes = 0
+
+
+def test_write_all_completed() -> None:
+    _reset_tracking()
+    loop = asyncio.new_event_loop()
+    storage = InMemoryStoragePlugin()
+    reqs = _make_write_reqs(20, 100)
+    sync_execute_write_reqs(reqs, storage, 10**9, rank=0, event_loop=loop)
+    loop.close()
+    assert len(storage.storage) == 20
+    assert storage.storage["obj_3"] == bytes([3]) * 100
+
+
+def test_budget_respected() -> None:
+    _reset_tracking()
+    loop = asyncio.new_event_loop()
+    storage = ReleasingStoragePlugin(delay=0.002)
+    reqs = _make_write_reqs(16, 1000)
+    sync_execute_write_reqs(reqs, storage, 3000, rank=0, event_loop=loop)
+    loop.close()
+    assert len(storage.storage) == 16
+    assert TrackingStager.peak_bytes <= 3000
+
+
+def test_oversized_request_does_not_deadlock() -> None:
+    _reset_tracking()
+    loop = asyncio.new_event_loop()
+    storage = InMemoryStoragePlugin()
+    reqs = _make_write_reqs(3, 5000)  # each bigger than budget
+    sync_execute_write_reqs(reqs, storage, 1000, rank=0, event_loop=loop)
+    loop.close()
+    assert len(storage.storage) == 3
+
+
+def test_pending_io_work_defers_storage_io() -> None:
+    """The returned PendingIOWork is the staging-complete consistency point."""
+    _reset_tracking()
+    loop = asyncio.new_event_loop()
+    storage = InMemoryStoragePlugin(delay=0.05)
+    reqs = _make_write_reqs(4, 10)
+    pending = loop.run_until_complete(
+        execute_write_reqs(reqs, storage, 10**9, rank=0)
+    )
+    # Staging is done for every request, but slow storage I/O may not be.
+    staged = [r.buffer_stager for r in reqs]
+    assert all(s.payload is not None for s in staged)
+    pending.sync_complete(loop)
+    loop.close()
+    assert len(storage.storage) == 4
+
+
+def test_read_pipeline() -> None:
+    loop = asyncio.new_event_loop()
+    storage = InMemoryStoragePlugin()
+    storage.storage = {f"k{i}": bytes([i]) * 50 for i in range(10)}
+    sink: Dict[str, bytes] = {}
+    reqs = [
+        ReadReq(path=f"k{i}", buffer_consumer=SimpleConsumer(sink, f"k{i}", 50))
+        for i in range(10)
+    ]
+    sync_execute_read_reqs(reqs, storage, 10**9, rank=0, event_loop=loop)
+    loop.close()
+    assert sink == storage.storage
+
+
+def test_read_with_byte_range() -> None:
+    loop = asyncio.new_event_loop()
+    storage = InMemoryStoragePlugin()
+    storage.storage = {"blob": bytes(range(100))}
+    sink: Dict[str, bytes] = {}
+    reqs = [
+        ReadReq(
+            path="blob",
+            buffer_consumer=SimpleConsumer(sink, "mid", 30),
+            byte_range=(10, 40),
+        )
+    ]
+    sync_execute_read_reqs(reqs, storage, 10**9, rank=0, event_loop=loop)
+    loop.close()
+    assert sink["mid"] == bytes(range(10, 40))
+
+
+def test_read_oversized_budget_escape() -> None:
+    loop = asyncio.new_event_loop()
+    storage = InMemoryStoragePlugin()
+    storage.storage = {"big": b"x" * 10000}
+    sink: Dict[str, bytes] = {}
+    reqs = [ReadReq(path="big", buffer_consumer=SimpleConsumer(sink, "big", 10000))]
+    sync_execute_read_reqs(reqs, storage, 100, rank=0, event_loop=loop)
+    loop.close()
+    assert sink["big"] == b"x" * 10000
+
+
+def test_memory_budget_env_override(monkeypatch) -> None:
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES", "12345")
+    assert get_process_memory_budget_bytes() == 12345
+
+
+def test_memory_budget_default_capped() -> None:
+    budget = get_process_memory_budget_bytes()
+    assert 0 < budget <= 32 * 1024**3
+
+
+def test_write_error_propagates() -> None:
+    class FaultyStorage(InMemoryStoragePlugin):
+        async def write(self, write_io: WriteIO) -> None:
+            raise RuntimeError("injected storage failure")
+
+    loop = asyncio.new_event_loop()
+    reqs = _make_write_reqs(2, 10)
+    with pytest.raises(RuntimeError, match="injected storage failure"):
+        sync_execute_write_reqs(reqs, FaultyStorage(), 10**9, rank=0, event_loop=loop)
+    loop.close()

@@ -1,0 +1,61 @@
+"""URL scheme -> storage plugin resolution (reference: storage_plugin.py:17-68).
+
+``fs://`` (and bare paths) resolve to the filesystem plugin; ``gs://`` to GCS;
+``s3://`` to S3 (requires boto3, which may be absent — construction raises a
+clear error in that case). Third-party plugins register via the
+``torchsnapshot_tpu.storage_plugins`` entry-point group.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from importlib.metadata import entry_points
+from typing import Any, Dict, Optional
+
+from .io_types import StoragePlugin
+
+
+def url_to_storage_plugin(
+    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+) -> StoragePlugin:
+    if "://" in url_path:
+        protocol, _, path = url_path.partition("://")
+        if protocol == "":
+            protocol = "fs"
+    else:
+        protocol, path = "fs", url_path
+
+    if protocol == "fs":
+        from .storage_plugins.fs import FSStoragePlugin
+
+        return FSStoragePlugin(root=path, storage_options=storage_options)
+    elif protocol == "s3":
+        from .storage_plugins.s3 import S3StoragePlugin
+
+        return S3StoragePlugin(root=path, storage_options=storage_options)
+    elif protocol in ("gs", "gcs"):
+        from .storage_plugins.gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(root=path, storage_options=storage_options)
+
+    # Third-party plugins via entry points (reference: storage_plugin.py:45-57).
+    eps = entry_points()
+    group = eps.select(group="torchsnapshot_tpu.storage_plugins")
+    for ep in group:
+        if ep.name == protocol:
+            return ep.load()(root=path, storage_options=storage_options)
+    raise RuntimeError(
+        f"Failed to resolve storage plugin for protocol {protocol!r} "
+        f"(url: {url_path!r})."
+    )
+
+
+def url_to_storage_plugin_in_event_loop(
+    url_path: str,
+    event_loop: asyncio.AbstractEventLoop,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> StoragePlugin:
+    async def _construct() -> StoragePlugin:
+        return url_to_storage_plugin(url_path, storage_options)
+
+    return event_loop.run_until_complete(_construct())

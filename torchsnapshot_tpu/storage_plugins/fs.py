@@ -1,0 +1,50 @@
+"""Local/POSIX filesystem storage plugin (reference: storage_plugins/fs.py:19-54).
+
+Async file I/O via aiofiles (thread-pool backed — file I/O releases the GIL so
+this overlaps with DtoH staging). Parent directories are created lazily with a
+cache; ranged reads seek into the file.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Set
+
+import aiofiles
+import aiofiles.os
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+
+class FSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str, storage_options=None) -> None:
+        self.root = root
+        self._dir_cache: Set[str] = set()
+
+    async def _ensure_parent(self, path: str) -> None:
+        parent = os.path.dirname(path)
+        if parent and parent not in self._dir_cache:
+            os.makedirs(parent, exist_ok=True)
+            self._dir_cache.add(parent)
+
+    async def write(self, write_io: WriteIO) -> None:
+        path = os.path.join(self.root, write_io.path)
+        await self._ensure_parent(path)
+        async with aiofiles.open(path, "wb") as f:
+            await f.write(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        path = os.path.join(self.root, read_io.path)
+        async with aiofiles.open(path, "rb") as f:
+            if read_io.byte_range is None:
+                read_io.buf = bytearray(await f.read())
+            else:
+                lo, hi = read_io.byte_range
+                await f.seek(lo)
+                read_io.buf = bytearray(await f.read(hi - lo))
+
+    async def delete(self, path: str) -> None:
+        await aiofiles.os.remove(os.path.join(self.root, path))
+
+    async def close(self) -> None:
+        pass
