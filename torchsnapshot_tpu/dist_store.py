@@ -1,0 +1,328 @@
+"""Out-of-band TCP KV store + two-phase barrier for snapshot coordination.
+
+TPU-native analogue of the reference's TCPStore + LinearBarrier
+(dist_store.py:22-196). The store is the coordination backbone for *all*
+snapshot metadata traffic (see pg_wrapper): it rides the host network (DCN on
+a TPU pod), is fully independent of the XLA runtime, and is safe to use from
+background threads — the property the async commit protocol requires
+(reference: snapshot.py:1033 "no collectives in this method").
+
+Protocol: length-prefixed pickled request/response dicts over a persistent
+connection. Server-side blocking waits use a condition variable, so ``get``
+blocks without client polling. One handler thread per connection — fine at
+checkpoint scale (one client per process, metadata-sized payloads).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+_LEN = struct.Struct(">Q")
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("Store connection closed.")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class _StoreServer:
+    """In-process KV server. Rank 0 hosts one; all ranks connect as clients."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        self._data: Dict[str, bytes] = {}
+        self._cond = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="tpusnapshot-store", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_msg(conn)
+                _send_msg(conn, self._dispatch(req))
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req["op"]
+        key = req.get("key")
+        with self._cond:
+            if op == "set":
+                self._data[key] = req["value"]
+                self._cond.notify_all()
+                return {"ok": True}
+            elif op == "add":
+                cur = int(self._data.get(key, b"0"))
+                cur += req["amount"]
+                self._data[key] = str(cur).encode()
+                self._cond.notify_all()
+                return {"ok": True, "value": cur}
+            elif op == "get":
+                deadline = time.monotonic() + req["timeout"]
+                while key not in self._data:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(timeout=min(remaining, 1.0)):
+                        if time.monotonic() >= deadline:
+                            return {"ok": False, "timeout": True}
+                return {"ok": True, "value": self._data[key]}
+            elif op == "wait_any":
+                keys = req["keys"]
+                deadline = time.monotonic() + req["timeout"]
+                while True:
+                    for k in keys:
+                        if k in self._data:
+                            return {"ok": True, "key": k, "value": self._data[k]}
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return {"ok": False, "timeout": True}
+                    self._cond.wait(timeout=min(remaining, 1.0))
+            elif op == "check":
+                return {"ok": True, "value": key in self._data}
+            elif op == "delete":
+                existed = self._data.pop(key, None) is not None
+                return {"ok": True, "value": existed}
+            elif op == "delete_prefix":
+                keep = req.get("except_keys") or []
+                doomed = [
+                    k
+                    for k in self._data
+                    if k.startswith(req["prefix"]) and k not in keep
+                ]
+                for k in doomed:
+                    del self._data[k]
+                return {"ok": True, "value": len(doomed)}
+            else:
+                return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Client handle to a store server (optionally hosting it in-process).
+
+    Thread-safe: calls are serialized over one connection with a lock; use
+    separate TCPStore instances for genuinely concurrent use (e.g. the async
+    commit thread creates its own connection).
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: Optional[int] = None,
+        is_server: bool = False,
+        timeout: float = DEFAULT_BARRIER_TIMEOUT_S,
+    ) -> None:
+        self._server: Optional[_StoreServer] = None
+        if is_server:
+            self._server = _StoreServer(port=port or 0)
+            port = self._server.port
+            host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        assert port is not None
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), timeout=None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _request(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            _send_msg(self._sock, req)
+            resp = _recv_msg(self._sock)
+        if resp.get("timeout"):
+            raise TimeoutError(
+                f"Store operation {req['op']!r} on {req.get('key') or req.get('keys')} "
+                f"timed out after {req.get('timeout')}s."
+            )
+        if not resp.get("ok"):
+            raise RuntimeError(f"Store error: {resp.get('error')}")
+        return resp
+
+    def set(self, key: str, value: bytes) -> None:
+        self._request({"op": "set", "key": key, "value": bytes(value)})
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        return self._request(
+            {"op": "get", "key": key, "timeout": timeout or self.timeout}
+        )["value"]
+
+    def wait_any(
+        self, keys: List[str], timeout: Optional[float] = None
+    ) -> Tuple[str, bytes]:
+        resp = self._request(
+            {"op": "wait_any", "keys": keys, "timeout": timeout or self.timeout}
+        )
+        return resp["key"], resp["value"]
+
+    def add(self, key: str, amount: int) -> int:
+        return self._request({"op": "add", "key": key, "amount": amount})["value"]
+
+    def check(self, key: str) -> bool:
+        return self._request({"op": "check", "key": key})["value"]
+
+    def delete(self, key: str) -> bool:
+        return self._request({"op": "delete", "key": key})["value"]
+
+    def delete_prefix(self, prefix: str, except_keys: Optional[List[str]] = None) -> int:
+        return self._request(
+            {"op": "delete_prefix", "prefix": prefix, "except_keys": except_keys}
+        )["value"]
+
+    def clone(self) -> "TCPStore":
+        """A new connection to the same server (for use from another thread)."""
+        return TCPStore(self.host, self.port, is_server=False, timeout=self.timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._server is not None:
+            self._server.close()
+
+
+def create_store(
+    rank: int, addr: Optional[str] = None, timeout: float = DEFAULT_BARRIER_TIMEOUT_S
+) -> TCPStore:
+    """Bootstrap a store: rank 0 hosts, everyone connects to ``addr``.
+
+    ``addr`` ("host:port") must be agreed out of band — from the
+    TORCHSNAPSHOT_TPU_STORE_ADDR env var, the jax.distributed coordinator, or
+    the test launcher (reference analogue: dist_store.py:53-88, where rank 0
+    binds a free port and broadcasts it over the default store).
+    """
+    if rank == 0:
+        if addr is not None and ":" in addr:
+            host, _, port = addr.rpartition(":")
+            return TCPStore(host or "127.0.0.1", int(port), is_server=True, timeout=timeout)
+        return TCPStore("127.0.0.1", None, is_server=True, timeout=timeout)
+    assert addr is not None, "Non-zero ranks must be given the store address."
+    host, _, port = addr.rpartition(":")
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return TCPStore(host, int(port), timeout=timeout)
+        except (ConnectionRefusedError, OSError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+class LinearBarrier:
+    """Two-phase (arrive/depart) store barrier with leader action in between
+    and cross-rank error propagation (reference: dist_store.py:91-196).
+
+    Usable from any thread — it only talks to the store. The async-commit
+    protocol relies on this: every rank arrives after its storage I/O
+    completes; the leader (rank 0) writes the snapshot metadata between the
+    phases; depart releases everyone. If any rank reports an error, all other
+    ranks raise instead of committing.
+    """
+
+    def __init__(
+        self,
+        prefix: str,
+        store: TCPStore,
+        rank: int,
+        world_size: int,
+        leader_rank: int = 0,
+    ) -> None:
+        self.prefix = prefix
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.leader_rank = leader_rank
+
+    def _key(self, *parts: str) -> str:
+        return "/".join((self.prefix,) + parts)
+
+    def _err_key(self) -> str:
+        return self._key("error")
+
+    def report_error(self, err: BaseException) -> None:
+        try:
+            payload = pickle.dumps(err)
+        except Exception:
+            payload = pickle.dumps(RuntimeError(repr(err)))
+        self.store.set(self._err_key(), payload)
+
+    def _raise_if_error(self, key: str, value: bytes) -> None:
+        if key == self._err_key():
+            err = pickle.loads(value)
+            raise RuntimeError(
+                f"A peer rank reported an error at barrier {self.prefix!r}."
+            ) from err
+
+    def arrive(self, timeout: Optional[float] = None) -> None:
+        self.store.set(self._key("arrive", str(self.rank)), b"1")
+        if self.rank == self.leader_rank:
+            for r in range(self.world_size):
+                key, value = self.store.wait_any(
+                    [self._key("arrive", str(r)), self._err_key()], timeout
+                )
+                self._raise_if_error(key, value)
+
+    def depart(self, timeout: Optional[float] = None) -> None:
+        if self.rank == self.leader_rank:
+            self.store.set(self._key("depart"), b"1")
+            # Leader departs last: safe to reclaim barrier keys would race
+            # with stragglers still waiting on depart — keys are reclaimed by
+            # the next snapshot's delete_prefix instead.
+        else:
+            key, value = self.store.wait_any(
+                [self._key("depart"), self._err_key()], timeout
+            )
+            self._raise_if_error(key, value)

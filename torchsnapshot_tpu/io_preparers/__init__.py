@@ -1,0 +1,26 @@
+from .array import ArrayBufferConsumer, ArrayBufferStager, ArrayIOPreparer
+from .chunked import ChunkedArrayIOPreparer
+from .object import ObjectBufferConsumer, ObjectIOPreparer
+from .primitive import PrimitivePreparer
+from .prepare import (
+    get_storage_path,
+    is_partitionable_array,
+    is_sharded_jax_array,
+    prepare_read,
+    prepare_write,
+)
+
+__all__ = [
+    "ArrayBufferConsumer",
+    "ArrayBufferStager",
+    "ArrayIOPreparer",
+    "ChunkedArrayIOPreparer",
+    "ObjectBufferConsumer",
+    "ObjectIOPreparer",
+    "PrimitivePreparer",
+    "get_storage_path",
+    "is_partitionable_array",
+    "is_sharded_jax_array",
+    "prepare_read",
+    "prepare_write",
+]

@@ -1,0 +1,252 @@
+"""Plain-array write/read planning (reference: io_preparer.py:498-726).
+
+The stager performs the TPU->host boundary crossing: for a jax.Array it
+issues ``copy_to_host_async`` (true async DMA — no GIL workaround needed,
+unlike the reference's CUDA thread-pool dance, io_preparer.py:513-523) and
+materializes a zero-copy numpy view in an executor thread. numpy inputs are
+viewed without copying at all.
+
+The consumer fills a destination numpy view in-place (memory-efficient
+restore, reference rationale: snapshot.py:693-700) and/or reports the value
+through a callback; for jax.Array destinations the callback re-materializes
+the array on device with its original sharding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from ..manifest import ArrayEntry
+from ..serialization import (
+    Serializer,
+    array_as_memoryview,
+    array_from_buffer,
+    array_size_bytes,
+    dtype_to_string,
+)
+
+
+def _is_jax_array(arr) -> bool:
+    try:
+        import jax
+
+        return isinstance(arr, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def array_nbytes(arr) -> int:
+    """Logical byte size of a numpy or jax array."""
+    return array_size_bytes(arr.shape, dtype_to_string(arr.dtype))
+
+
+def to_host(arr) -> np.ndarray:
+    """Synchronous DtoH materialization (numpy passthrough)."""
+    if _is_jax_array(arr):
+        return np.asarray(arr)
+    return np.asarray(arr)
+
+
+class ArrayBufferStager(BufferStager):
+    def __init__(self, arr) -> None:
+        self.arr = arr
+
+    async def stage_buffer(self, executor=None) -> BufferType:
+        arr = self.arr
+        if _is_jax_array(arr):
+            try:
+                arr.copy_to_host_async()  # kick off the DMA before blocking
+            except Exception:
+                pass
+            loop = asyncio.get_running_loop()
+            host = await loop.run_in_executor(executor, np.asarray, arr)
+        else:
+            host = np.asarray(arr)
+        return array_as_memoryview(host)
+
+    def get_staging_cost_bytes(self) -> int:
+        return array_nbytes(self.arr)
+
+
+class ArrayBufferConsumer(BufferConsumer):
+    """Deserializes into ``dst_view`` (if given) and invokes ``callback`` with
+    the host array. Exactly one of the two is typically used."""
+
+    def __init__(
+        self,
+        entry: ArrayEntry,
+        dst_view: Optional[np.ndarray] = None,
+        callback: Optional[Callable[[np.ndarray], None]] = None,
+    ) -> None:
+        self.entry = entry
+        self.dst_view = dst_view
+        self.callback = callback
+
+    def _consume_sync(self, buf: BufferType) -> None:
+        arr = array_from_buffer(buf, self.entry.dtype, self.entry.shape)
+        if self.dst_view is not None:
+            np.copyto(self.dst_view, arr, casting="same_kind")
+            if self.callback is not None:
+                self.callback(self.dst_view)
+        elif self.callback is not None:
+            self.callback(arr)
+
+    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(executor, self._consume_sync, buf)
+        else:
+            self._consume_sync(buf)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return array_size_bytes(self.entry.shape, self.entry.dtype)
+
+
+class ArrayIOPreparer:
+    @staticmethod
+    def prepare_write(
+        storage_path: str, arr, replicated: bool = False
+    ) -> Tuple[ArrayEntry, List[WriteReq]]:
+        entry = ArrayEntry(
+            location=storage_path,
+            serializer=Serializer.BUFFER_PROTOCOL.value,
+            dtype=dtype_to_string(arr.dtype),
+            shape=list(arr.shape),
+            replicated=replicated,
+        )
+        return entry, [
+            WriteReq(path=storage_path, buffer_stager=ArrayBufferStager(arr))
+        ]
+
+    @staticmethod
+    def prepare_read(
+        entry: ArrayEntry,
+        dst_view: Optional[np.ndarray] = None,
+        callback: Optional[Callable[[np.ndarray], None]] = None,
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> List[ReadReq]:
+        if buffer_size_limit_bytes is None:
+            consumer = ArrayBufferConsumer(entry, dst_view=dst_view, callback=callback)
+            byte_range = (
+                tuple(entry.byte_range) if entry.byte_range is not None else None
+            )
+            return [
+                ReadReq(
+                    path=entry.location,
+                    buffer_consumer=consumer,
+                    byte_range=byte_range,
+                )
+            ]
+        return _prepare_chunked_read(entry, dst_view, callback, buffer_size_limit_bytes)
+
+
+class _SlicedArrayConsumer(BufferConsumer):
+    """Consumes one byte-range of a serialized array into the matching flat
+    slice of the destination (chunked reads under a memory budget,
+    reference: io_preparer.py:672-718)."""
+
+    def __init__(
+        self,
+        entry: ArrayEntry,
+        assembler: "ArrayAssembler",
+        elem_lo: int,
+        elem_hi: int,
+    ) -> None:
+        self.entry = entry
+        self.assembler = assembler
+        self.elem_lo = elem_lo
+        self.elem_hi = elem_hi
+
+    def _consume_sync(self, buf: BufferType) -> None:
+        from ..serialization import string_to_dtype
+
+        flat = np.frombuffer(buf, dtype=np.uint8).view(string_to_dtype(self.entry.dtype))
+        self.assembler.fill_flat(self.elem_lo, self.elem_hi, flat)
+
+    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(executor, self._consume_sync, buf)
+        else:
+            self._consume_sync(buf)
+
+    def get_consuming_cost_bytes(self) -> int:
+        itemsize = array_size_bytes((1,), self.entry.dtype)
+        return (self.elem_hi - self.elem_lo) * itemsize
+
+
+class ArrayAssembler:
+    """Accumulates partial fills of one destination array; fires ``callback``
+    when the last part lands. Shared by chunked and sharded restores."""
+
+    def __init__(
+        self,
+        dst: np.ndarray,
+        num_parts: int,
+        callback: Optional[Callable[[np.ndarray], None]] = None,
+    ) -> None:
+        self.dst = dst
+        self._flat = dst.reshape(-1)
+        self._remaining = num_parts
+        self.callback = callback
+
+    def fill_flat(self, elem_lo: int, elem_hi: int, values: np.ndarray) -> None:
+        np.copyto(self._flat[elem_lo:elem_hi], values, casting="same_kind")
+        self.part_done()
+
+    def fill_region(self, index: Tuple[slice, ...], values: np.ndarray) -> None:
+        # dst[()] on a 0-d array yields a scalar, not a view — copy whole-array.
+        target = self.dst[index] if index else self.dst
+        np.copyto(target, values, casting="same_kind")
+        self.part_done()
+
+    def part_done(self) -> None:
+        self._remaining -= 1
+        if self._remaining == 0 and self.callback is not None:
+            self.callback(self.dst)
+
+
+def _prepare_chunked_read(
+    entry: ArrayEntry,
+    dst_view: Optional[np.ndarray],
+    callback: Optional[Callable[[np.ndarray], None]],
+    buffer_size_limit_bytes: int,
+) -> List[ReadReq]:
+    itemsize = array_size_bytes((1,), entry.dtype)
+    total_elems = int(np.prod(entry.shape, dtype=np.int64)) if entry.shape else 1
+    elems_per_read = max(1, buffer_size_limit_bytes // itemsize)
+
+    if dst_view is None:
+        from ..serialization import string_to_dtype
+
+        dst_view = np.empty(tuple(entry.shape), dtype=string_to_dtype(entry.dtype))
+
+    ranges = []
+    lo = 0
+    while lo < total_elems:
+        hi = min(lo + elems_per_read, total_elems)
+        ranges.append((lo, hi))
+        lo = hi
+    if not ranges:
+        ranges = [(0, 0)]
+
+    assembler = ArrayAssembler(dst_view, num_parts=len(ranges), callback=callback)
+    base = entry.byte_range[0] if entry.byte_range is not None else 0
+    read_reqs = []
+    for elem_lo, elem_hi in ranges:
+        read_reqs.append(
+            ReadReq(
+                path=entry.location,
+                buffer_consumer=_SlicedArrayConsumer(entry, assembler, elem_lo, elem_hi),
+                byte_range=(base + elem_lo * itemsize, base + elem_hi * itemsize),
+            )
+        )
+    return read_reqs
+
+
+def get_array_size_from_entry(entry: ArrayEntry) -> int:
+    return array_size_bytes(entry.shape, entry.dtype)

@@ -1,0 +1,80 @@
+"""Arbitrary-object preparer (reference: io_preparer.py:728-799).
+
+Objects are pickled. Since objects can't be restored in place, the consumer
+reports the deserialized value through a callback which the orchestrator uses
+to replace the flattened value before inflate (reference wiring:
+snapshot.py:736-745).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from ..manifest import ObjectEntry
+from ..serialization import Serializer, object_as_bytes, object_from_bytes
+
+
+class ObjectBufferStager(BufferStager):
+    def __init__(self, obj: Any) -> None:
+        self.obj = obj
+        self._size_estimate: Optional[int] = None
+
+    async def stage_buffer(self, executor=None) -> BufferType:
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(executor, object_as_bytes, self.obj)
+        return object_as_bytes(self.obj)
+
+    def get_staging_cost_bytes(self) -> int:
+        if self._size_estimate is None:
+            try:
+                import sys
+
+                self._size_estimate = max(sys.getsizeof(self.obj), 1024)
+            except TypeError:  # pragma: no cover
+                self._size_estimate = 1024
+        return self._size_estimate
+
+
+class ObjectBufferConsumer(BufferConsumer):
+    def __init__(self, entry: ObjectEntry) -> None:
+        self.entry = entry
+        self._callback: Optional[Callable[[Any], None]] = None
+
+    def set_consume_callback(self, callback: Callable[[Any], None]) -> None:
+        self._callback = callback
+
+    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            obj = await loop.run_in_executor(executor, object_from_bytes, buf)
+        else:
+            obj = object_from_bytes(buf)
+        if self._callback is not None:
+            self._callback(obj)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return 1024  # unknown until deserialized; objects are small in practice
+
+
+class ObjectIOPreparer:
+    @staticmethod
+    def prepare_write(
+        storage_path: str, obj: Any, replicated: bool = False
+    ) -> Tuple[ObjectEntry, List[WriteReq]]:
+        entry = ObjectEntry(
+            location=storage_path,
+            serializer=Serializer.PICKLE.value,
+            obj_type=type(obj).__name__,
+            replicated=replicated,
+        )
+        return entry, [
+            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj))
+        ]
+
+    @staticmethod
+    def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], ObjectBufferConsumer]:
+        consumer = ObjectBufferConsumer(entry)
+        return [ReadReq(path=entry.location, buffer_consumer=consumer)], consumer

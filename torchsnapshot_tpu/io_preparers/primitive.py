@@ -1,0 +1,19 @@
+"""Primitive preparer: int/float/str/bool/bytes/None inlined into metadata —
+zero storage I/O (reference: io_preparer.py:801-812, prepare_read returns []).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..manifest import PrimitiveEntry
+
+
+class PrimitivePreparer:
+    @staticmethod
+    def should_inline(obj: Any) -> bool:
+        return type(obj).__name__ in PrimitiveEntry.supported_types()
+
+    @staticmethod
+    def prepare_write(obj: Any, replicated: bool = False) -> PrimitiveEntry:
+        return PrimitiveEntry.from_object(obj, replicated=replicated)

@@ -1,0 +1,33 @@
+"""Host RNG state capture (reference: rng_state.py:34-38, adapted for JAX).
+
+JAX PRNG keys are explicit arrays — store them in app state like any other
+leaf. What remains ambient on the host is Python's ``random`` and NumPy's
+global generator (commonly used for data pipelines); ``RNGState`` captures
+both. States are pickled to bytes so they inline into snapshot metadata as
+primitives (zero storage I/O).
+
+The Snapshot orchestrator gives RNGState entries the same invariant the
+reference does (snapshot.py:329-373): their state is captured at ``take``
+entry and re-applied after, so taking a snapshot never perturbs the RNG
+stream; on ``restore`` they are restored last.
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+from typing import Any, Dict
+
+import numpy as np
+
+
+class RNGState:
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "python": pickle.dumps(random.getstate()),
+            "numpy": pickle.dumps(np.random.get_state()),
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        random.setstate(pickle.loads(state_dict["python"]))
+        np.random.set_state(pickle.loads(state_dict["numpy"]))

@@ -1,0 +1,22 @@
+"""StateDict: a dict that is its own state dict (reference: state_dict.py:13-41).
+
+Used to capture raw pytrees (params, opt_state, step counters, PRNG keys) in an
+app state::
+
+    app_state = {"model": StateDict(params=params, step=0)}
+
+After ``restore``, the restored values are accessible via the same instance.
+"""
+
+from __future__ import annotations
+
+from collections import UserDict
+from typing import Any, Dict
+
+
+class StateDict(UserDict):
+    def state_dict(self) -> Dict[str, Any]:
+        return self.data
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.data.update(state_dict)

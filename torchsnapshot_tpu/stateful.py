@@ -1,0 +1,22 @@
+"""The Stateful protocol: what can be checkpointed (reference: stateful.py:13-23).
+
+Anything exposing ``state_dict()``/``load_state_dict()`` participates in an
+app state. For JAX the canonical unit of state is a pytree; ``StateDict``
+adapts a raw pytree into a Stateful.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    def state_dict(self) -> Dict[str, Any]:
+        ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        ...
+
+
+AppState = Dict[str, Stateful]

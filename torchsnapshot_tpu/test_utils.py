@@ -1,0 +1,172 @@
+"""Test harness utilities (reference: torchsnapshot/test_utils.py).
+
+- array-aware deep equality for state dicts / pytrees (the reference patched
+  Tensor.__eq__ under a mock, test_utils.py:52-98; numpy/jax compare cleanly);
+- random pytree generators over the full dtype table;
+- a single-node multi-process launcher for distributed semantics tests (the
+  analogue of the reference's torch-elastic launcher, test_utils.py:166-205):
+  N subprocesses, a TCP KV store rendezvous on localhost, CPU backend.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+import traceback
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+
+def _leaf_equal(a: Any, b: Any) -> bool:
+    try:
+        import jax
+
+        if isinstance(a, jax.Array):
+            a = np.asarray(a)
+        if isinstance(b, jax.Array):
+            b = np.asarray(b)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        if not isinstance(a, np.ndarray) or not isinstance(b, np.ndarray):
+            return False
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return False
+        # bitwise comparison: exact, and robust to NaN and exotic dtypes
+        return a.tobytes() == b.tobytes()
+    return bool(a == b) and type(a) is type(b)
+
+
+def tree_eq(a: Any, b: Any, path: str = "") -> Tuple[bool, str]:
+    """Deep equality over nested dict/list/tuple structures with arrays."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        if set(a.keys()) != set(b.keys()):
+            return False, f"{path}: key sets differ ({set(a)} vs {set(b)})"
+        for k in a:
+            ok, why = tree_eq(a[k], b[k], f"{path}/{k}")
+            if not ok:
+                return ok, why
+        return True, ""
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        if len(a) != len(b):
+            return False, f"{path}: lengths differ ({len(a)} vs {len(b)})"
+        for i, (x, y) in enumerate(zip(a, b)):
+            ok, why = tree_eq(x, y, f"{path}/{i}")
+            if not ok:
+                return ok, why
+        return True, ""
+    if _leaf_equal(a, b):
+        return True, ""
+    return False, f"{path}: leaves differ ({a!r} vs {b!r})"
+
+
+def assert_state_dict_eq(tc_or_none: Any, a: Any, b: Any) -> None:
+    ok, why = tree_eq(a, b)
+    assert ok, why
+
+
+def check_state_dict_eq(a: Any, b: Any) -> bool:
+    return tree_eq(a, b)[0]
+
+
+def rand_array(dtype_str: str, shape=(8, 8), seed: int = 0) -> np.ndarray:
+    from .serialization import string_to_dtype
+
+    dtype = string_to_dtype(dtype_str)
+    rng = np.random.default_rng(seed)
+    if dtype_str == "bool":
+        return rng.integers(0, 2, size=shape).astype(bool)
+    if dtype_str.startswith(("int", "uint")):
+        hi = 2 if dtype_str.endswith("2") else (8 if dtype_str.endswith("4") else 100)
+        return rng.integers(0, hi, size=shape).astype(dtype)
+    if dtype_str.startswith("complex"):
+        return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(
+            dtype
+        )
+    return rng.standard_normal(shape).astype(dtype)
+
+
+# ---------------------------------------------------------------- launcher
+
+
+def _worker_entry(
+    fn: Callable,
+    rank: int,
+    world_size: int,
+    store_addr: str,
+    result_queue,
+    args: Tuple,
+) -> None:
+    try:
+        # Each subprocess is its own "host process": single CPU device.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+        from .dist_store import create_store
+        from .pg_wrapper import init_process_group
+
+        store = create_store(rank=rank, addr=store_addr)
+        init_process_group(store=store, rank=rank, world_size=world_size)
+        result = fn(rank, world_size, *args)
+        result_queue.put((rank, "ok", result))
+    except BaseException:  # noqa: B036
+        result_queue.put((rank, "error", traceback.format_exc()))
+
+
+def _find_free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_with_subprocesses(
+    fn: Callable, world_size: int, *args: Any, timeout: float = 180.0
+) -> Dict[int, Any]:
+    """Run ``fn(rank, world_size, *args)`` in ``world_size`` subprocesses with
+    a shared KV-store rendezvous. Returns {rank: result}; raises on any
+    rank's failure (reference analogue: test_utils.py:166-205)."""
+    ctx = mp.get_context("spawn")
+    result_queue = ctx.Queue()
+    port = _find_free_port()
+    store_addr = f"127.0.0.1:{port}"
+    procs = []
+    for rank in range(world_size):
+        p = ctx.Process(
+            target=_worker_entry,
+            args=(fn, rank, world_size, store_addr, result_queue, args),
+            daemon=False,
+        )
+        p.start()
+        procs.append(p)
+
+    results: Dict[int, Any] = {}
+    errors = []
+    for _ in range(world_size):
+        try:
+            rank, status, payload = result_queue.get(timeout=timeout)
+        except Exception:
+            for p in procs:
+                p.terminate()
+            raise TimeoutError(
+                f"Multi-process test timed out after {timeout}s; "
+                f"got results from ranks {sorted(results)} of {world_size}."
+            )
+        if status == "ok":
+            results[rank] = payload
+        else:
+            errors.append((rank, payload))
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    if errors:
+        raise RuntimeError(
+            "Worker failures:\n"
+            + "\n".join(f"--- rank {r} ---\n{tb}" for r, tb in errors)
+        )
+    return results
