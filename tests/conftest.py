@@ -1,16 +1,25 @@
-"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's strategy of testing distributed semantics without a
 cluster (test_utils.py:166-205): sharding/resharding tests run on 8 virtual
 CPU devices; multi-process semantics are tested with real subprocesses.
+
+NOTE: the ambient environment may have already imported jax (via
+sitecustomize) with JAX_PLATFORMS pointed at real TPU hardware, so setting
+the env var here is too late — use jax.config, which takes effect at first
+backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
