@@ -1,0 +1,137 @@
+"""TCP KV store + LinearBarrier tests (reference: tests/test_dist_store.py)."""
+
+import threading
+import time
+
+import pytest
+
+from torchsnapshot_tpu.dist_store import LinearBarrier, TCPStore, create_store
+
+
+@pytest.fixture
+def store():
+    s = TCPStore("127.0.0.1", is_server=True, timeout=10.0)
+    yield s
+    s.close()
+
+
+def test_set_get(store) -> None:
+    store.set("k", b"v")
+    assert store.get("k") == b"v"
+    assert store.check("k")
+    assert not store.check("nope")
+
+
+def test_blocking_get(store) -> None:
+    def setter():
+        time.sleep(0.2)
+        store2 = store.clone()
+        store2.set("later", b"done")
+        store2.close()
+
+    t = threading.Thread(target=setter)
+    t.start()
+    assert store.get("later", timeout=5.0) == b"done"
+    t.join()
+
+
+def test_get_timeout(store) -> None:
+    with pytest.raises(TimeoutError):
+        store.get("never", timeout=0.3)
+
+
+def test_add(store) -> None:
+    assert store.add("ctr", 1) == 1
+    assert store.add("ctr", 5) == 6
+    assert store.get("ctr") == b"6"
+
+
+def test_wait_any(store) -> None:
+    store.set("b", b"2")
+    key, value = store.wait_any(["a", "b"], timeout=2.0)
+    assert key == "b" and value == b"2"
+
+
+def test_delete_and_prefix(store) -> None:
+    store.set("p/1", b"x")
+    store.set("p/2", b"y")
+    store.set("q/1", b"z")
+    assert store.delete("p/1")
+    assert not store.delete("p/1")
+    assert store.delete_prefix("p/") == 1
+    assert store.check("q/1")
+
+
+def test_multiple_clients(store) -> None:
+    clients = [store.clone() for _ in range(4)]
+    for i, c in enumerate(clients):
+        c.set(f"client/{i}", str(i).encode())
+    for i, c in enumerate(clients):
+        assert c.get(f"client/{(i + 1) % 4}") == str((i + 1) % 4).encode()
+    for c in clients:
+        c.close()
+
+
+def test_linear_barrier_two_threads(store) -> None:
+    """Barrier with leader action between phases, driven from threads
+    (the async-commit usage pattern)."""
+    events = []
+    lock = threading.Lock()
+
+    def run(rank: int) -> None:
+        s = store.clone()
+        b = LinearBarrier("bar1", s, rank, 2)
+        b.arrive(timeout=10.0)
+        if rank == 0:
+            with lock:
+                events.append("leader-action")
+        b.depart(timeout=10.0)
+        with lock:
+            events.append(f"departed-{rank}")
+        s.close()
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert events[0] == "leader-action"
+    assert set(events[1:]) == {"departed-0", "departed-1"}
+
+
+def test_linear_barrier_error_propagation(store) -> None:
+    """A rank's reported error must surface on peers instead of committing
+    (reference: dist_store.py:177-193)."""
+    results = {}
+
+    def leader() -> None:
+        s = store.clone()
+        b = LinearBarrier("bar2", s, 0, 2)
+        try:
+            b.arrive(timeout=10.0)
+            results[0] = "committed"
+        except RuntimeError as e:
+            results[0] = f"error: {e.__cause__}"
+        s.close()
+
+    def failing_peer() -> None:
+        s = store.clone()
+        b = LinearBarrier("bar2", s, 1, 2)
+        b.report_error(ValueError("injected failure"))
+        s.close()
+
+    threads = [threading.Thread(target=leader), threading.Thread(target=failing_peer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert "injected failure" in results[0]
+
+
+def test_create_store_rendezvous() -> None:
+    server = create_store(rank=0)
+    client = create_store(rank=1, addr=server.addr)
+    client.set("hello", b"world")
+    assert server.get("hello") == b"world"
+    client.close()
+    server.close()

@@ -1,0 +1,208 @@
+"""Multi-process snapshot semantics: replication, striping, elasticity
+(reference: tests/test_ddp.py, tests/test_replication_glob.py,
+tests/test_partition_replicated_paths.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+
+def _replicated_take_worker(rank: int, world_size: int, snap_path: str):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    # identical ("replicated") params on every rank + per-rank state
+    params = {
+        "w1": np.arange(4096, dtype=np.float32).reshape(64, 64),
+        "w2": np.ones((32, 32), dtype=np.float32) * 7,
+    }
+    app_state = {
+        "model": StateDict(**params),
+        "local": StateDict(rank_data=np.full((8,), rank, dtype=np.int32), step=rank),
+    }
+    snapshot = Snapshot.take(snap_path, app_state, replicated=["model/*"])
+    manifest = snapshot.get_manifest()
+
+    # every rank's manifest view carries the replicated entries
+    assert f"{rank}/model/w1" in manifest
+    entry = manifest[f"{rank}/model/w1"]
+    assert entry.replicated
+    return sorted(
+        os.path.relpath(os.path.join(dp, f), snap_path)
+        for dp, _, fs in os.walk(snap_path)
+        for f in fs
+    )
+
+
+def _replicated_restore_worker(rank: int, world_size: int, snap_path: str):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    snapshot = Snapshot(snap_path)
+    dst = StateDict(
+        w1=np.zeros((64, 64), dtype=np.float32),
+        w2=np.zeros((32, 32), dtype=np.float32),
+    )
+    local_dst = StateDict(rank_data=np.zeros((8,), dtype=np.int32), step=-1)
+    snapshot.restore({"model": dst, "local": local_dst})
+    np.testing.assert_array_equal(
+        dst["w1"], np.arange(4096, dtype=np.float32).reshape(64, 64)
+    )
+    np.testing.assert_array_equal(dst["w2"], np.ones((32, 32), dtype=np.float32) * 7)
+    np.testing.assert_array_equal(
+        local_dst["rank_data"], np.full((8,), rank, dtype=np.int32)
+    )
+    assert local_dst["step"] == rank
+    return "ok"
+
+
+@pytest.mark.parametrize("world_size", [2, 4])
+def test_replicated_save_restore(tmp_path, world_size: int) -> None:
+    snap_path = str(tmp_path / "snap")
+    results = run_with_subprocesses(_replicated_take_worker, world_size, snap_path)
+
+    # Replicated data written exactly once (under replicated/), striped:
+    # every rank saw the same file set, and each replicated array appears once.
+    file_sets = list(results.values())
+    assert all(fs == file_sets[0] for fs in file_sets)
+    files = file_sets[0]
+    repl_files = [f for f in files if f.startswith("replicated/")]
+    assert any("model/w1" in f for f in repl_files)
+    assert any("model/w2" in f for f in repl_files)
+    # per-rank entries present for every rank
+    for r in range(world_size):
+        assert any(f.startswith(f"{r}/local/rank_data") for f in files)
+
+    results = run_with_subprocesses(
+        _replicated_restore_worker, world_size, snap_path
+    )
+    assert all(v == "ok" for v in results.values())
+
+
+def _elastic_take_worker(rank: int, world_size: int, snap_path: str):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    app_state = {
+        "model": StateDict(w=np.arange(100, dtype=np.float64)),
+        "local": StateDict(step=rank * 10),
+    }
+    Snapshot.take(snap_path, app_state, replicated=["model/*"])
+    return "ok"
+
+
+def _elastic_restore_worker(rank: int, world_size: int, snap_path: str):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    snapshot = Snapshot(snap_path)
+    dst = StateDict(w=np.zeros(100, dtype=np.float64))
+    snapshot.restore({"model": dst})
+    np.testing.assert_array_equal(dst["w"], np.arange(100, dtype=np.float64))
+
+    # per-rank entries only restorable by their original ranks
+    local_dst = StateDict(step=-1)
+    if rank < 2:
+        snapshot.restore({"local": local_dst})
+        assert local_dst["step"] == rank * 10
+        return "restored-local"
+    else:
+        try:
+            snapshot.restore({"local": local_dst})
+            return "unexpected-success"
+        except RuntimeError as e:
+            assert "Unable to find entry" in str(e)
+            return "got-elasticity-error"
+
+
+def test_elasticity_world_size_change(tmp_path) -> None:
+    """Save with world=2, restore with world=4: replicated entries restore
+    everywhere; per-rank entries error helpfully on new ranks
+    (reference: snapshot.py:112-155, 707-725)."""
+    snap_path = str(tmp_path / "snap")
+    run_with_subprocesses(_elastic_take_worker, 2, snap_path)
+    results = run_with_subprocesses(_elastic_restore_worker, 4, snap_path)
+    assert results[0] == "restored-local"
+    assert results[1] == "restored-local"
+    assert results[2] == "got-elasticity-error"
+    assert results[3] == "got-elasticity-error"
+
+
+def test_shrink_world_size(tmp_path) -> None:
+    """Save with world=4, restore with world=1 (single process)."""
+    snap_path = str(tmp_path / "snap")
+    run_with_subprocesses(_elastic_take_worker, 4, snap_path)
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    snapshot = Snapshot(snap_path)
+    dst = StateDict(w=np.zeros(100, dtype=np.float64))
+    snapshot.restore({"model": dst})
+    np.testing.assert_array_equal(dst["w"], np.arange(100, dtype=np.float64))
+    # rank 0 can also restore its own per-rank entry
+    local_dst = StateDict(step=-1)
+    snapshot.restore({"local": local_dst})
+    assert local_dst["step"] == 0
+
+
+def _striping_worker(rank: int, world_size: int, snap_path: str):
+    """Force small chunks so the replicated array stripes across ranks."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.io_preparers import chunked
+
+    old = chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES
+    chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES = 1024  # 4 rows of 64 floats
+    try:
+        arr = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+        snapshot = Snapshot.take(
+            snap_path, {"model": StateDict(big=arr)}, replicated=["model/*"]
+        )
+    finally:
+        chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES = old
+    entry = snapshot.get_manifest()[f"{rank}/model/big"]
+    return [tuple(c.offsets) for c in entry.chunks]
+
+
+def test_replicated_chunk_striping(tmp_path) -> None:
+    """The chunk set is identical in every rank's manifest entry, while the
+    bytes are written cooperatively (greedy striping — the manifest records
+    all chunks, each rank writes a disjoint subset)."""
+    snap_path = str(tmp_path / "snap")
+    results = run_with_subprocesses(_striping_worker, 2, snap_path)
+    assert results[0] == results[1]
+    assert len(results[0]) == 16  # 64 rows / 4 rows-per-chunk
+
+    # all chunk files exist exactly once under replicated/
+    files = [
+        f
+        for dp, _, fs in os.walk(snap_path)
+        for f in fs
+        if "model/big" in os.path.join(dp, f)
+    ]
+    assert len(files) == 16
+
+
+def _glob_mismatch_worker(rank: int, world_size: int, snap_path: str):
+    """Ranks claim different globs -> only the verified intersection is
+    replicated (reference: tests/test_replication_glob.py:104-113)."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    app_state = {
+        "m": StateDict(
+            a=np.ones(10, dtype=np.float32),
+            b=np.ones(10, dtype=np.float32) * 2,
+        )
+    }
+    globs = ["m/a", "m/b"] if rank == 0 else ["m/a"]
+    snapshot = Snapshot.take(snap_path, app_state, replicated=globs)
+    manifest = snapshot.get_manifest()
+    return {
+        "a_replicated": manifest[f"{rank}/m/a"].replicated,
+        "b_replicated": manifest[f"{rank}/m/b"].replicated,
+    }
+
+
+def test_replication_glob_negotiation(tmp_path) -> None:
+    results = run_with_subprocesses(_glob_mismatch_worker, 2, str(tmp_path / "s"))
+    for r in results.values():
+        assert r["a_replicated"] is True
+        assert r["b_replicated"] is False

@@ -46,10 +46,13 @@ class ChunkedArrayIOPreparer:
     def chunk_ranges(
         shape: Tuple[int, ...],
         dtype_str: str,
-        chunk_size_bytes: int = DEFAULT_MAX_CHUNK_SIZE_BYTES,
+        chunk_size_bytes: Optional[int] = None,
     ) -> List[Tuple[int, int]]:
         """[lo, hi) ranges along dim 0 such that each chunk <= chunk_size_bytes
         (single-row chunks if one row exceeds the limit)."""
+        if chunk_size_bytes is None:
+            # resolved at call time so tests can shrink the module constant
+            chunk_size_bytes = DEFAULT_MAX_CHUNK_SIZE_BYTES
         if len(shape) == 0 or 0 in shape:
             return [(0, shape[0] if shape else 0)] if shape else []
         total_bytes = array_size_bytes(shape, dtype_str)
@@ -67,7 +70,7 @@ class ChunkedArrayIOPreparer:
     def chunk_shards(
         shape: Tuple[int, ...],
         dtype_str: str,
-        chunk_size_bytes: int = DEFAULT_MAX_CHUNK_SIZE_BYTES,
+        chunk_size_bytes: Optional[int] = None,
     ) -> List[Tuple[List[int], List[int]]]:
         """(offsets, sizes) per chunk; scalar arrays produce one empty-offset
         chunk covering the whole array."""

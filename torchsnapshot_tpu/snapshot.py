@@ -275,7 +275,7 @@ class Snapshot:
                 manifest=global_manifest,
             )
             memory_budget = get_process_memory_budget_bytes(
-                pg_wrapper.pg if world_size > 1 else None
+                pg_wrapper if world_size > 1 else None
             )
             pending_io_work = event_loop.run_until_complete(
                 execute_write_reqs(write_reqs, storage, memory_budget, rank)
@@ -303,27 +303,36 @@ class Snapshot:
             metadata = self._read_metadata(storage, event_loop)
             available = get_manifest_for_rank(metadata, rank)
             memory_budget = get_process_memory_budget_bytes(
-                pg_wrapper.pg if pg_wrapper.get_world_size() > 1 else None
+                pg_wrapper if pg_wrapper.get_world_size() > 1 else None
             )
             keys = self._gather_keys(pg_wrapper, sorted(app_state.keys()))
             # RNG states restore last so earlier load side effects can't
             # perturb them (reference: snapshot.py:489-500).
             ordered = [k for k in keys if not isinstance(app_state.get(k), RNGState)]
             ordered += [k for k in keys if isinstance(app_state.get(k), RNGState)]
-            for key in ordered:
-                if key not in app_state:
-                    continue
-                self._load_stateful(
-                    rank=rank,
-                    stateful=app_state[key],
-                    key=key,
-                    available=available,
-                    metadata=metadata,
-                    storage=storage,
-                    event_loop=event_loop,
-                    memory_budget=memory_budget,
-                )
+            # Defer raising until after the barrier: a rank failing (e.g. a
+            # per-rank entry missing after a world-size change) must not
+            # desert the barrier and deadlock healthy peers.
+            exc: Optional[BaseException] = None
+            try:
+                for key in ordered:
+                    if key not in app_state:
+                        continue
+                    self._load_stateful(
+                        rank=rank,
+                        stateful=app_state[key],
+                        key=key,
+                        available=available,
+                        metadata=metadata,
+                        storage=storage,
+                        event_loop=event_loop,
+                        memory_budget=memory_budget,
+                    )
+            except BaseException as e:  # noqa: B036
+                exc = e
             pg_wrapper.barrier()
+            if exc is not None:
+                raise exc
         finally:
             storage.sync_close(event_loop)
             event_loop.close()

@@ -108,7 +108,18 @@ def _worker_entry(
 
         store = create_store(rank=rank, addr=store_addr)
         init_process_group(store=store, rank=rank, world_size=world_size)
-        result = fn(rank, world_size, *args)
+        try:
+            result = fn(rank, world_size, *args)
+        finally:
+            # Exit barrier: the store server lives in rank 0's process, so no
+            # rank may exit (killing it) while peers still use the store.
+            try:
+                n = store.add("__exit__/count", 1)
+                if n == world_size:
+                    store.set("__exit__/done", b"1")
+                store.get("__exit__/done", timeout=60.0)
+            except Exception:
+                pass
         result_queue.put((rank, "ok", result))
     except BaseException:  # noqa: B036
         result_queue.put((rank, "error", traceback.format_exc()))
