@@ -1,0 +1,155 @@
+"""async_take: consistency point, commit protocol, fault injection
+(reference: tests/test_async_take.py — SlowFS/FaultyFS plugin subclassing,
+error propagation through wait(), metadata-not-committed assertions)."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.io_types import WriteIO
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+
+class SlowFSStoragePlugin(FSStoragePlugin):
+    async def write(self, write_io: WriteIO) -> None:
+        await asyncio.sleep(0.3)
+        await super().write(write_io)
+
+
+class FaultyFSStoragePlugin(FSStoragePlugin):
+    async def write(self, write_io: WriteIO) -> None:
+        if write_io.path != SNAPSHOT_METADATA_FNAME:
+            raise RuntimeError("injected storage failure")
+        await super().write(write_io)
+
+
+def test_async_take_completes(tmp_path, monkeypatch) -> None:
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+        SlowFSStoragePlugin,
+    )
+    app_state = {"m": StateDict(w=np.arange(1000, dtype=np.float32))}
+    t0 = time.monotonic()
+    pending = Snapshot.async_take(str(tmp_path / "snap"), app_state)
+    returned_after = time.monotonic() - t0
+    snapshot = pending.wait()
+    assert pending.done()
+    # the slow write (0.3s) must not have blocked the caller
+    assert returned_after < 0.3
+    dst = StateDict(w=np.zeros(1000, dtype=np.float32))
+    snapshot.restore({"m": dst})
+    np.testing.assert_array_equal(dst["w"], app_state["m"]["w"])
+
+
+def test_async_take_consistency_point(tmp_path, monkeypatch) -> None:
+    """Mutations after async_take returns must not affect the snapshot —
+    staging completes before return (reference: snapshot.py:257-262)."""
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+        SlowFSStoragePlugin,
+    )
+    arr = np.arange(256, dtype=np.float64)
+    app_state = {"m": StateDict(w=arr, step=1)}
+    pending = Snapshot.async_take(str(tmp_path / "snap"), app_state)
+    arr[:] = -1.0  # mutate while storage I/O is still in flight
+    snapshot = pending.wait()
+    dst = StateDict(w=np.zeros(256, dtype=np.float64), step=0)
+    snapshot.restore({"m": dst})
+    np.testing.assert_array_equal(dst["w"], np.arange(256, dtype=np.float64))
+
+
+def test_async_take_error_propagation(tmp_path, monkeypatch) -> None:
+    """Failures surface through wait() AND the metadata is never committed
+    (reference: tests/test_async_take.py:53-64)."""
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+        FaultyFSStoragePlugin,
+    )
+    app_state = {"m": StateDict(w=np.ones(64, dtype=np.float32))}
+    pending = Snapshot.async_take(str(tmp_path / "snap"), app_state)
+    with pytest.raises(RuntimeError, match="injected storage failure"):
+        pending.wait()
+    assert pending.done()
+    assert not (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+
+
+def test_sync_take_error_no_commit(tmp_path, monkeypatch) -> None:
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+        FaultyFSStoragePlugin,
+    )
+    with pytest.raises(RuntimeError, match="injected storage failure"):
+        Snapshot.take(
+            str(tmp_path / "snap"),
+            {"m": StateDict(w=np.ones(64, dtype=np.float32))},
+        )
+    assert not (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+
+
+def _async_take_worker(rank: int, world_size: int, snap_path: str):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    app_state = {
+        "model": StateDict(w=np.arange(100, dtype=np.float32)),
+        "local": StateDict(step=rank),
+    }
+    pending = Snapshot.async_take(snap_path, app_state, replicated=["model/*"])
+    snapshot = pending.wait()
+    return sorted(snapshot.get_manifest().keys())
+
+
+def test_async_take_multiprocess(tmp_path) -> None:
+    snap_path = str(tmp_path / "snap")
+    results = run_with_subprocesses(_async_take_worker, 2, snap_path)
+    assert results[0] == results[1]
+    assert os.path.exists(os.path.join(snap_path, SNAPSHOT_METADATA_FNAME))
+
+
+class _Rank1FaultyPlugin(FSStoragePlugin):
+    async def write(self, write_io) -> None:
+        raise RuntimeError("rank-1 injected failure")
+
+
+def _async_take_one_rank_fails_worker(rank: int, world_size: int, snap_path: str):
+    import unittest.mock as mock
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME as MD
+
+    app_state = {"local": StateDict(data=np.full(1000, rank, dtype=np.float32))}
+
+    if rank == 1:
+        ctx = mock.patch(
+            "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+            _Rank1FaultyPlugin,
+        )
+    else:
+        ctx = mock.patch(
+            "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+            SlowFSStoragePlugin,
+        )
+
+    with ctx:
+        pending = Snapshot.async_take(snap_path, app_state)
+        try:
+            pending.wait()
+            return "committed"
+        except RuntimeError as e:
+            return f"error: {e}"
+
+
+def test_async_take_all_or_nothing(tmp_path) -> None:
+    """If any rank fails, no rank commits and everyone sees an error
+    (reference: tests/test_async_take.py:107-115)."""
+    snap_path = str(tmp_path / "snap")
+    results = run_with_subprocesses(
+        _async_take_one_rank_fails_worker, 2, snap_path
+    )
+    assert all(r.startswith("error") for r in results.values()), results
+    assert not os.path.exists(os.path.join(snap_path, SNAPSHOT_METADATA_FNAME))

@@ -52,8 +52,31 @@ def to_host(arr) -> np.ndarray:
 
 
 class ArrayBufferStager(BufferStager):
+    """Stages one array into a host buffer *owned by the snapshot*.
+
+    Staging is the consistency point of async_take: the staged buffer must
+    not alias caller memory, or mutations after async_take returns would leak
+    into the snapshot (reference guarantee: snapshot.py:257-262). For TPU
+    arrays ``device_get`` inherently copies (DtoH DMA); on the CPU backend
+    (and for numpy inputs) an explicit copy is made.
+    """
+
     def __init__(self, arr) -> None:
         self.arr = arr
+
+    @staticmethod
+    def _stage_sync(arr) -> np.ndarray:
+        if _is_jax_array(arr):
+            host = np.asarray(arr)
+            # CPU-backend jax arrays materialize as zero-copy views of the
+            # device buffer; copy so donation/deletion can't corrupt the
+            # snapshot. On TPU the DtoH transfer already produced host-owned
+            # memory — no extra copy.
+            devices = arr.sharding.device_set
+            if next(iter(devices)).platform == "cpu":
+                host = np.array(host, copy=True)
+            return host
+        return np.array(arr, copy=True)
 
     async def stage_buffer(self, executor=None) -> BufferType:
         arr = self.arr
@@ -62,10 +85,8 @@ class ArrayBufferStager(BufferStager):
                 arr.copy_to_host_async()  # kick off the DMA before blocking
             except Exception:
                 pass
-            loop = asyncio.get_running_loop()
-            host = await loop.run_in_executor(executor, np.asarray, arr)
-        else:
-            host = np.asarray(arr)
+        loop = asyncio.get_running_loop()
+        host = await loop.run_in_executor(executor, self._stage_sync, arr)
         return array_as_memoryview(host)
 
     def get_staging_cost_bytes(self) -> int:
