@@ -1,0 +1,142 @@
+"""Batcher tests (reference: tests/test_batcher.py — batching x chunking x
+dtype matrix, plan-level fulfillment, round trips through the full stack)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.batcher import (
+    BatchedBufferConsumer,
+    batch_read_requests,
+    batch_write_requests,
+)
+from torchsnapshot_tpu.io_types import ReadReq, WriteReq
+from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer
+
+
+def _prepare(arrs):
+    entries, reqs = [], []
+    for i, arr in enumerate(arrs):
+        entry, wr = ArrayIOPreparer.prepare_write(f"0/m/p{i}", arr)
+        entries.append(entry)
+        reqs.extend(wr)
+    return entries, reqs
+
+
+def test_batch_write_packs_small_arrays() -> None:
+    arrs = [np.full((10, 10), i, dtype=np.float32) for i in range(8)]
+    entries, reqs = _prepare(arrs)
+    entries, batched = batch_write_requests(entries, reqs)
+    assert len(batched) == 1
+    assert batched[0].path.startswith("batched/")
+    offsets = [e.byte_range for e in entries]
+    assert all(br is not None for br in offsets)
+    assert offsets[0][0] == 0
+    # all entries point at the same slab
+    assert len({e.location for e in entries}) == 1
+
+
+def test_batched_roundtrip_through_stack(tmp_path, monkeypatch) -> None:
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    arrs = {f"p{i}": np.random.default_rng(i).standard_normal((32, 32)).astype(np.float32) for i in range(6)}
+    app_state = {"m": StateDict(**arrs)}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), app_state)
+
+    # all six arrays live in one slab file
+    files = [
+        os.path.relpath(os.path.join(dp, f), tmp_path / "snap")
+        for dp, _, fs in os.walk(tmp_path / "snap")
+        for f in fs
+    ]
+    slab_files = [f for f in files if f.startswith("batched/")]
+    assert len(slab_files) == 1
+    assert not any(f.startswith("0/m/") for f in files)
+
+    dst = StateDict(**{k: np.zeros((32, 32), dtype=np.float32) for k in arrs})
+    snapshot.restore({"m": dst})
+    for k, v in arrs.items():
+        np.testing.assert_array_equal(dst[k], v)
+
+
+def test_batched_read_object(tmp_path, monkeypatch) -> None:
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    arrs = {f"p{i}": np.full((4, 4), i, dtype=np.int32) for i in range(4)}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(**arrs)})
+    out = snapshot.read_object("0/m/p2")
+    np.testing.assert_array_equal(out, np.full((4, 4), 2, dtype=np.int32))
+
+
+def test_replicated_entries_not_batched(tmp_path, monkeypatch) -> None:
+    """Replicated chunk locations are deterministic across ranks and must
+    not be rewritten to per-writer slab names."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    arrs = {f"p{i}": np.ones((8, 8), dtype=np.float32) for i in range(4)}
+    snapshot = Snapshot.take(
+        str(tmp_path / "snap"), {"m": StateDict(**arrs)}, replicated=["m/*"]
+    )
+    manifest = snapshot.get_manifest()
+    for i in range(4):
+        entry = manifest[f"0/m/p{i}"]
+        assert entry.chunks[0].array.location.startswith("replicated/")
+
+
+def test_batch_read_requests_merges_adjacent() -> None:
+    consumed = {}
+
+    class Rec:
+        def __init__(self, key, cost):
+            self.key = key
+            self.cost = cost
+
+        async def consume_buffer(self, buf, executor=None):
+            consumed[self.key] = bytes(buf)
+
+        def get_consuming_cost_bytes(self):
+            return self.cost
+
+    reqs = [
+        ReadReq("f", Rec("a", 10), byte_range=(0, 10)),
+        ReadReq("f", Rec("b", 10), byte_range=(10, 20)),
+        ReadReq("f", Rec("c", 5), byte_range=(20, 25)),
+        ReadReq("g", Rec("d", 5), byte_range=(0, 5)),
+        ReadReq("h", Rec("e", 3)),  # whole-file read untouched
+    ]
+    merged = batch_read_requests(reqs)
+    spanning = [r for r in merged if r.path == "f"]
+    assert len(spanning) == 1
+    assert spanning[0].byte_range == (0, 25)
+    assert isinstance(spanning[0].buffer_consumer, BatchedBufferConsumer)
+    assert len([r for r in merged if r.path == "g"]) == 1
+    assert len([r for r in merged if r.path == "h"]) == 1
+
+
+def test_batch_read_requests_respects_gap() -> None:
+    class Null:
+        async def consume_buffer(self, buf, executor=None):
+            pass
+
+        def get_consuming_cost_bytes(self):
+            return 1
+
+    far = 100 * 1024 * 1024
+    reqs = [
+        ReadReq("f", Null(), byte_range=(0, 10)),
+        ReadReq("f", Null(), byte_range=(far, far + 10)),
+    ]
+    merged = batch_read_requests(reqs)
+    assert len(merged) == 2  # gap too large to merge
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_batching_dtype_matrix(tmp_path, monkeypatch, dtype) -> None:
+    from torchsnapshot_tpu.test_utils import rand_array
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    arrs = {f"p{i}": rand_array(dtype, (16, 4), seed=i) for i in range(3)}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(**arrs)})
+    dst = StateDict(**{k: np.zeros_like(v) for k, v in arrs.items()})
+    snapshot.restore({"m": dst})
+    for k, v in arrs.items():
+        assert dst[k].tobytes() == v.tobytes()

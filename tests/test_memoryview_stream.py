@@ -1,0 +1,50 @@
+"""MemoryviewStream tests (reference: tests/test_memoryview_stream.py)."""
+
+import io
+
+import pytest
+
+from torchsnapshot_tpu.memoryview_stream import MemoryviewStream
+
+
+def test_read_seek_tell() -> None:
+    data = bytes(range(100))
+    s = MemoryviewStream(memoryview(data))
+    assert s.readable() and s.seekable() and not s.writable()
+    assert len(s) == 100
+    assert s.read(10) == data[:10]
+    assert s.tell() == 10
+    assert s.read() == data[10:]
+    assert s.read(5) == b""
+    s.seek(0)
+    assert s.read(-1) == data
+    s.seek(-10, io.SEEK_END)
+    assert s.read() == data[-10:]
+    s.seek(20)
+    s.seek(5, io.SEEK_CUR)
+    assert s.tell() == 25
+    with pytest.raises(ValueError):
+        s.seek(-1)
+
+
+def test_readinto() -> None:
+    s = MemoryviewStream(memoryview(b"hello world"))
+    buf = bytearray(5)
+    assert s.readinto(buf) == 5
+    assert bytes(buf) == b"hello"
+
+
+def test_closed() -> None:
+    s = MemoryviewStream(memoryview(b"x"))
+    s.close()
+    with pytest.raises(ValueError):
+        s.read()
+
+
+def test_gcs_s3_plugin_importable() -> None:
+    # construction may require credentials/deps; module import must not
+    from torchsnapshot_tpu.storage_plugins import gcs, s3  # noqa: F401
+
+    import importlib
+
+    assert importlib.util.find_spec("torchsnapshot_tpu.storage_plugins.s3")

@@ -1,0 +1,226 @@
+"""Write/read batching: slab-pack small writes, merge ranged reads.
+
+TPU-native analogue of the reference batcher (batcher.py:22-373). Opt-in via
+``TORCHSNAPSHOT_TPU_ENABLE_BATCHING=1`` (reference: snapshot.py:425,603,748).
+
+Write side: small buffer-protocol array writes are packed into ~128 MB slabs
+under ``batched/<uuid>``; each packed entry's location is rewritten to the
+slab with a byte_range, so restores are ranged reads into the slab
+(reference: batcher.py:98-242). Sub-buffers stage concurrently into one
+bytearray. Replicated entries are *not* batched: their chunk locations are
+computed deterministically on every rank (the striping design), and slab
+names are per-writer.
+
+Read side: ranged reads against the same file are merged into spanning reads
+feeding multiple consumers (reference: batch_read_requests, batcher.py:276-366).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    Manifest,
+    ShardedArrayEntry,
+)
+from .serialization import Serializer
+
+_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
+_READ_MERGE_GAP_BYTES = 4 * 1024 * 1024
+ENABLE_BATCHING_ENV_VAR = "TORCHSNAPSHOT_TPU_ENABLE_BATCHING"
+
+
+def batching_enabled() -> bool:
+    return os.environ.get(ENABLE_BATCHING_ENV_VAR, "0") not in ("0", "", "false")
+
+
+def _is_batchable_entry(entry: Entry) -> bool:
+    return (
+        isinstance(entry, ArrayEntry)
+        and entry.serializer == Serializer.BUFFER_PROTOCOL.value
+        and entry.byte_range is None
+    )
+
+
+class BatchedBufferStager(BufferStager):
+    """Stages sub-buffers concurrently into one slab (batcher.py:41-67)."""
+
+    def __init__(self, stagers: List[BufferStager], offsets: List[int], total: int):
+        self.stagers = stagers
+        self.offsets = offsets
+        self.total = total
+
+    async def stage_buffer(self, executor=None) -> BufferType:
+        slab = bytearray(self.total)
+        view = memoryview(slab)
+
+        async def fill(stager: BufferStager, lo: int) -> None:
+            buf = await stager.stage_buffer(executor)
+            mv = memoryview(buf).cast("B")
+            view[lo:lo + mv.nbytes] = mv
+
+        await asyncio.gather(
+            *(fill(s, lo) for s, lo in zip(self.stagers, self.offsets))
+        )
+        return slab
+
+    def get_staging_cost_bytes(self) -> int:
+        # slab + the largest in-flight sub-buffer is the true peak, but
+        # sub-buffers are views in the common case; the slab dominates.
+        return self.total
+
+
+def batch_write_requests(
+    entries: List[Entry], write_reqs: List[WriteReq]
+) -> Tuple[List[Entry], List[WriteReq]]:
+    """Pack batchable write requests into slabs, rewriting entry locations
+    and byte ranges in place. ``entries`` are the manifest entry objects whose
+    (sub-)ArrayEntries correspond to the write requests by location."""
+    req_by_path: Dict[str, WriteReq] = {r.path: r for r in write_reqs}
+
+    # Collect (array_entry, req) pairs eligible for batching.
+    candidates: List[Tuple[ArrayEntry, WriteReq]] = []
+    for entry in entries:
+        sub_entries: List[ArrayEntry] = []
+        if isinstance(entry, ArrayEntry):
+            sub_entries = [entry]
+        elif isinstance(entry, ChunkedArrayEntry):
+            if entry.replicated:
+                continue  # deterministic striped locations — do not rewrite
+            sub_entries = [c.array for c in entry.chunks]
+        elif isinstance(entry, ShardedArrayEntry):
+            sub_entries = [s.array for s in entry.shards]
+        else:
+            continue
+        if isinstance(entry, ArrayEntry) and entry.replicated:
+            continue
+        for sub in sub_entries:
+            req = req_by_path.get(sub.location)
+            if req is not None and _is_batchable_entry(sub):
+                candidates.append((sub, req))
+
+    if len(candidates) < 2:
+        return entries, write_reqs
+
+    # Greedy slab packing in path order.
+    slabs: List[List[Tuple[ArrayEntry, WriteReq]]] = []
+    current: List[Tuple[ArrayEntry, WriteReq]] = []
+    current_size = 0
+    for sub, req in sorted(candidates, key=lambda t: t[0].location):
+        size = req.buffer_stager.get_staging_cost_bytes()
+        if size >= _SLAB_SIZE_THRESHOLD_BYTES:
+            continue  # large writes gain nothing from batching
+        if current and current_size + size > _SLAB_SIZE_THRESHOLD_BYTES:
+            slabs.append(current)
+            current, current_size = [], 0
+        current.append((sub, req))
+        current_size += size
+    if current:
+        slabs.append(current)
+
+    batched_paths = set()
+    new_reqs: List[WriteReq] = []
+    for slab in slabs:
+        if len(slab) < 2:
+            continue
+        slab_path = f"batched/{uuid.uuid4().hex}"
+        offsets: List[int] = []
+        stagers: List[BufferStager] = []
+        off = 0
+        for sub, req in slab:
+            size = req.buffer_stager.get_staging_cost_bytes()
+            batched_paths.add(sub.location)
+            sub.location = slab_path
+            sub.byte_range = [off, off + size]
+            offsets.append(off)
+            stagers.append(req.buffer_stager)
+            off += size
+        new_reqs.append(
+            WriteReq(
+                path=slab_path,
+                buffer_stager=BatchedBufferStager(stagers, offsets, off),
+            )
+        )
+
+    remaining = [r for r in write_reqs if r.path not in batched_paths]
+    return entries, remaining + new_reqs
+
+
+class BatchedBufferConsumer(BufferConsumer):
+    """Feeds slices of one spanning read to multiple consumers
+    (batcher.py:247-273)."""
+
+    def __init__(
+        self, sub_consumers: List[BufferConsumer], sub_ranges: List[Tuple[int, int]]
+    ) -> None:
+        self.sub_consumers = sub_consumers
+        self.sub_ranges = sub_ranges  # relative to the spanning read
+
+    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+        view = memoryview(buf)
+        await asyncio.gather(
+            *(
+                c.consume_buffer(view[lo:hi], executor)
+                for c, (lo, hi) in zip(self.sub_consumers, self.sub_ranges)
+            )
+        )
+
+    def get_consuming_cost_bytes(self) -> int:
+        return sum(hi - lo for lo, hi in self.sub_ranges)
+
+
+def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    """Merge byte-range reads of the same file into spanning reads."""
+    by_path: Dict[str, List[ReadReq]] = {}
+    out: List[ReadReq] = []
+    for req in read_reqs:
+        if req.byte_range is None:
+            out.append(req)
+        else:
+            by_path.setdefault(req.path, []).append(req)
+
+    for path, reqs in by_path.items():
+        if len(reqs) == 1:
+            out.extend(reqs)
+            continue
+        reqs.sort(key=lambda r: r.byte_range[0])
+        group: List[ReadReq] = []
+        group_hi: Optional[int] = None
+
+        def flush() -> None:
+            if not group:
+                return
+            if len(group) == 1:
+                out.append(group[0])
+                return
+            lo = group[0].byte_range[0]
+            hi = max(r.byte_range[1] for r in group)
+            out.append(
+                ReadReq(
+                    path=path,
+                    buffer_consumer=BatchedBufferConsumer(
+                        [r.buffer_consumer for r in group],
+                        [(r.byte_range[0] - lo, r.byte_range[1] - lo) for r in group],
+                    ),
+                    byte_range=(lo, hi),
+                )
+            )
+
+        for req in reqs:
+            lo, hi = req.byte_range
+            if group_hi is not None and lo - group_hi <= _READ_MERGE_GAP_BYTES:
+                group.append(req)
+                group_hi = max(group_hi, hi)
+            else:
+                flush()
+                group = [req]
+                group_hi = hi
+        flush()
+    return out

@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from .batcher import batch_read_requests, batch_write_requests, batching_enabled
 from .dist_store import DEFAULT_BARRIER_TIMEOUT_S, LinearBarrier
 from .flatten import flatten, inflate
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
@@ -268,6 +269,14 @@ class Snapshot:
                     if not is_repl or logical_path in owned_objects:
                         write_reqs.extend(reqs)
 
+            if batching_enabled():
+                # Pack small per-rank/sharded writes into slabs; rewrites the
+                # manifest entries' locations/byte-ranges in place, so this
+                # must run before the manifest gather.
+                _, write_reqs = batch_write_requests(
+                    list(manifest.values()), write_reqs
+                )
+
             global_manifest = cls._gather_manifest(manifest, pg_wrapper)
             metadata = SnapshotMetadata(
                 version=__version__,
@@ -372,6 +381,9 @@ class Snapshot:
 
             read_reqs.extend(prepare_read(entry, obj_out=obj, callback=_cb))
 
+        # Merge adjacent ranged reads (slab restores, chunked reads) into
+        # spanning reads — always on; it only coalesces, never reorders data.
+        read_reqs = batch_read_requests(read_reqs)
         sync_execute_read_reqs(
             read_reqs, storage, memory_budget, rank, event_loop
         )

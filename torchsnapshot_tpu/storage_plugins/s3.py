@@ -1,0 +1,73 @@
+"""S3 storage plugin (reference: storage_plugins/s3.py:15-70).
+
+Uses boto3 (if installed) driven through the event loop's executor; ranged
+GETs use the HTTP Range header. Staged memoryviews are streamed via
+MemoryviewStream without copying.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+
+class S3StoragePlugin(StoragePlugin):
+    def __init__(self, root: str, storage_options: Optional[Dict[str, Any]] = None):
+        try:
+            import boto3
+        except ImportError as e:
+            raise RuntimeError(
+                "S3 support requires the boto3 package (not installed in this "
+                "environment). Install boto3 or use fs:// / gs:// storage."
+            ) from e
+        self.bucket, _, self.prefix = root.partition("/")
+        options = storage_options or {}
+        self.client = boto3.client("s3", **options.get("client_options", {}))
+
+    def _key(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    async def write(self, write_io: WriteIO) -> None:
+        from ..memoryview_stream import MemoryviewStream
+
+        loop = asyncio.get_running_loop()
+        buf = write_io.buf
+        if isinstance(buf, (bytes, bytearray)):
+            body: Any = bytes(buf)
+        else:
+            body = MemoryviewStream(memoryview(buf))
+        await loop.run_in_executor(
+            None,
+            lambda: self.client.put_object(
+                Bucket=self.bucket, Key=self._key(write_io.path), Body=body
+            ),
+        )
+
+    async def read(self, read_io: ReadIO) -> None:
+        loop = asyncio.get_running_loop()
+        kwargs: Dict[str, Any] = {
+            "Bucket": self.bucket,
+            "Key": self._key(read_io.path),
+        }
+        if read_io.byte_range is not None:
+            lo, hi = read_io.byte_range
+            kwargs["Range"] = f"bytes={lo}-{hi - 1}"  # inclusive
+
+        def get() -> bytes:
+            return self.client.get_object(**kwargs)["Body"].read()
+
+        read_io.buf = bytearray(await loop.run_in_executor(None, get))
+
+    async def delete(self, path: str) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: self.client.delete_object(
+                Bucket=self.bucket, Key=self._key(path)
+            ),
+        )
+
+    async def close(self) -> None:
+        pass
