@@ -1,0 +1,84 @@
+"""Headline benchmark: Snapshot save throughput for device state.
+
+Mirrors the reference's DDP benchmark (benchmarks/ddp/main.py: save a model
+of N x 100MB params, report wall time). Reference baseline on comparable
+1-worker hardware: 18 GB in ~45 s => 0.40 GB/s (benchmarks/ddp/README.md:15,
+reproduced in BASELINE.md). We report save throughput in GB/s on one chip;
+vs_baseline is the ratio against that 0.40 GB/s figure.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_SAVE_GBPS = 18.0 / 45.0  # benchmarks/ddp/README.md:15 (1 worker)
+
+
+def build_state(total_bytes: int, n_arrays: int = 18):
+    """n_arrays bf16 arrays totalling ~total_bytes, on device."""
+    per = total_bytes // n_arrays
+    n_elem = per // 2  # bf16
+    side = int(n_elem**0.5)
+    key = jax.random.PRNGKey(0)
+    arrs = {}
+    for i in range(n_arrays):
+        key, sub = jax.random.split(key)
+        arrs[f"param_{i}"] = jax.random.normal(sub, (side, side), jnp.bfloat16)
+    jax.block_until_ready(arrs)
+    return arrs
+
+
+def main() -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    total = int(float(sys.argv[1]) * (1 << 30)) if len(sys.argv) > 1 else 2 << 30
+    state = build_state(total)
+    nbytes = sum(a.nbytes for a in state.values())
+    app_state = {"model": StateDict(state)}
+
+    tmp = tempfile.mkdtemp(prefix="tsnap_bench_")
+    try:
+        # Warm-up on a small state to amortize one-time costs out of the try.
+        warm = {"model": StateDict({"w": jnp.ones((256, 256), jnp.bfloat16)})}
+        Snapshot.take(f"{tmp}/warm", warm)
+
+        t0 = time.perf_counter()
+        Snapshot.take(f"{tmp}/snap", app_state)
+        dt = time.perf_counter() - t0
+
+        # Sanity: restore must round-trip (not timed into the headline).
+        dst = {"model": StateDict({k: jnp.zeros_like(v) for k, v in state.items()})}
+        Snapshot(f"{tmp}/snap").restore(dst)
+        import numpy as np
+
+        a = np.asarray(jax.device_get(state["param_0"]))
+        b = np.asarray(jax.device_get(dst["model"]["param_0"]))
+        assert a.tobytes() == b.tobytes(), "restore not bit-exact"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    gbps = (nbytes / (1 << 30)) / dt
+    print(
+        json.dumps(
+            {
+                "metric": "snapshot_save_throughput_1chip",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / REFERENCE_SAVE_GBPS, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -72,9 +72,9 @@ class BatchedBufferStager(BufferStager):
         return slab
 
     def get_staging_cost_bytes(self) -> int:
-        # slab + the largest in-flight sub-buffer is the true peak, but
-        # sub-buffers are views in the common case; the slab dominates.
-        return self.total
+        # Sub-stagers allocate their own host buffers before being copied
+        # into the slab, and stage concurrently — peak is slab + sub-buffers.
+        return 2 * self.total
 
 
 def batch_write_requests(
@@ -173,7 +173,9 @@ class BatchedBufferConsumer(BufferConsumer):
         )
 
     def get_consuming_cost_bytes(self) -> int:
-        return sum(hi - lo for lo, hi in self.sub_ranges)
+        # The spanning read materializes the whole merged range, gaps
+        # included — charge the span, not just the consumed sub-ranges.
+        return max(hi for _, hi in self.sub_ranges)
 
 
 def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:

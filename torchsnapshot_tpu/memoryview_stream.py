@@ -33,9 +33,14 @@ class MemoryviewStream(io.RawIOBase):
         return data
 
     def readinto(self, b) -> int:
-        data = self.read(len(b))
-        n = len(data)
-        b[:n] = data
+        if self.closed:
+            raise ValueError("I/O operation on closed stream.")
+        end = min(self._pos + len(b), len(self._mv))
+        n = max(0, end - self._pos)
+        if n == 0:
+            return 0
+        b[:n] = self._mv[self._pos:end]
+        self._pos = end
         return n
 
     def seek(self, pos: int, whence: int = io.SEEK_SET) -> int:

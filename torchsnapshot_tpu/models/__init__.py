@@ -1,0 +1,9 @@
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    make_optimizer,
+    param_specs,
+)

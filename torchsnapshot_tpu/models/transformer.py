@@ -1,0 +1,255 @@
+"""Flagship model: a GSPMD-sharded decoder-only transformer.
+
+The reference (torchsnapshot) ships no model code — its benchmarks build
+throwaway torch models (benchmarks/fsdp/main.py builds a 1.9B-param
+transformer, benchmarks/ddp/main.py a 200x100MB-param module) purely to
+produce realistic distributed state to checkpoint. This module is the
+TPU-native analogue: a pure-JAX decoder-only transformer whose parameters
+and training step are annotated for a ('data','model') mesh:
+
+- dp: batch sharded over 'data'
+- tp: hidden/ffn/vocab dims sharded over 'model' (Megatron-style
+  column->row parallel pairs; XLA inserts the all-reduces)
+- sp: the residual stream between blocks is sequence-sharded over 'model'
+  (Megatron sequence parallelism), so norm/elementwise work is partitioned
+  and XLA materializes all-gather/reduce-scatter at block boundaries.
+
+The state it produces (params + optax opt_state + step + PRNG key) is the
+canonical AppState the snapshot layer checkpoints and reshards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        c = self
+        per_layer = 4 * c.d_model * c.d_model + 2 * c.d_model * c.d_ff + 2 * c.d_model
+        return c.vocab_size * c.d_model + c.n_layers * per_layer + c.d_model
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Initialize the parameter pytree (stacked-layer layout).
+
+    Per-layer weights are stacked along a leading layer axis so the forward
+    pass is a single `lax.scan` over layers — one compiled block instead of
+    n_layers unrolled ones, which keeps compile time flat as depth grows.
+    """
+    c = cfg
+    k_embed, k_attn, k_o, k_ff1, k_ff2 = jax.random.split(rng, 5)
+    L, D, F = c.n_layers, c.d_model, c.d_ff
+
+    def norm(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, c.param_dtype) * (fan_in**-0.5)
+        )
+
+    return {
+        "embed": norm(k_embed, (c.vocab_size, D), D),
+        "layers": {
+            "attn_qkv": norm(k_attn, (L, D, 3 * D), D),
+            "attn_out": norm(k_o, (L, D, D), D),
+            "ff_in": norm(k_ff1, (L, D, F), D),
+            "ff_out": norm(k_ff2, (L, F, D), F),
+            "ln1_scale": jnp.ones((L, D), c.param_dtype),
+            "ln2_scale": jnp.ones((L, D), c.param_dtype),
+        },
+        "ln_f_scale": jnp.ones((D,), c.param_dtype),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpecs for each param on a ('data','model') mesh (tp layout).
+
+    Column-parallel (output dim on 'model'): qkv, ff_in, embed.
+    Row-parallel (input dim on 'model'): attn_out, ff_out.
+    Norm scales replicated.
+    """
+    return {
+        "embed": P(None, "model"),
+        "layers": {
+            "attn_qkv": P(None, None, "model"),
+            "attn_out": P(None, "model", None),
+            "ff_in": P(None, None, "model"),
+            "ff_out": P(None, "model", None),
+            "ln1_scale": P(None, None),
+            "ln2_scale": P(None, None),
+        },
+        "ln_f_scale": P(None),
+    }
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Causal LM forward: (batch, seq) int32 -> (batch, seq, vocab) logits.
+
+    When `mesh` is given, sharding constraints implement dp/tp/sp; with
+    mesh=None the same code runs single-device.
+    """
+    c = cfg
+    B, S = tokens.shape
+
+    def cs(x, spec):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    x = params["embed"].astype(c.dtype)[tokens]  # (B, S, D)
+    pos = jnp.arange(S)[None, :, None]
+    dims = jnp.arange(c.d_model // 2)[None, None, :]
+    inv_freq = 10000.0 ** (-2.0 * dims / c.d_model)
+    # Fixed sinusoidal position encoding added to embeddings.
+    angles = pos * inv_freq
+    pe = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+    x = x + pe.astype(c.dtype)
+
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    def block(x, layer):
+        # sp: residual stream sequence-sharded over the tp axis between blocks.
+        x = cs(x, P("data", "model", None))
+        h = _rmsnorm(x, layer["ln1_scale"])
+        h = cs(h, P("data", None, None))
+        qkv = h @ layer["attn_qkv"].astype(c.dtype)  # (B,S,3D)
+        qkv = cs(qkv, P("data", None, "model"))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)  # (B,H,S,hd)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (c.head_dim**0.5)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, c.d_model)
+        attn = cs(attn, P("data", None, "model"))
+        x = x + cs(attn @ layer["attn_out"].astype(c.dtype), P("data", "model", None))
+
+        h = _rmsnorm(x, layer["ln2_scale"])
+        h = cs(h, P("data", None, None))
+        h = jax.nn.gelu(h @ layer["ff_in"].astype(c.dtype))
+        h = cs(h, P("data", None, "model"))
+        x = x + cs(h @ layer["ff_out"].astype(c.dtype), P("data", "model", None))
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = cs(x, P("data", None, None))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = x @ params["embed"].astype(c.dtype).T
+    return cs(logits, P("data", None, "model"))
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    logits = forward(params, batch["tokens"], cfg, mesh=mesh)
+    targets = batch["targets"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_optimizer(lr: float = 1e-3) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    *,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Returns train_step(state, batch) -> (state, loss), ready to jit.
+
+    state = {"params": ..., "opt_state": ..., "step": int32 scalar}.
+    """
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, cfg, mesh=mesh
+        )
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    return train_step
+
+
+def init_state(
+    rng: jax.Array,
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    *,
+    mesh: Optional[Mesh] = None,
+) -> Dict[str, Any]:
+    """Initialize {params, opt_state, step}; shard onto `mesh` if given."""
+    params = init_params(rng, cfg)
+    if mesh is not None:
+        from ..parallel.mesh import shard_pytree
+
+        params = shard_pytree(params, param_specs(cfg), mesh)
+    opt_state = tx.init(params)
+    return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+
+def state_specs(cfg: TransformerConfig, state: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpec pytree matching init_state's output.
+
+    Adam moments inherit their param's spec; scalars replicated.
+    """
+    p_specs = param_specs(cfg)
+
+    # optax adamw state: (ScaleByAdamState(count, mu, nu), EmptyState/others)
+    def map_opt(entry):
+        if isinstance(entry, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(
+                count=P(), mu=p_specs, nu=p_specs
+            )
+        return jax.tree_util.tree_map(lambda _: P(), entry)
+
+    opt_spec = tuple(map_opt(e) for e in state["opt_state"])
+    return {"params": p_specs, "opt_state": opt_spec, "step": P()}

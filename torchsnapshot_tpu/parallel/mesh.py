@@ -1,0 +1,70 @@
+"""Device-mesh construction and pytree sharding helpers.
+
+The reference framework (torchsnapshot) consumes state from externally
+parallelized models (DDP replication, ShardedTensor TP layouts, FSDP —
+SURVEY.md §2 "Parallelism / distribution strategies"). On TPU the analogue
+is GSPMD: a `jax.sharding.Mesh` plus `NamedSharding` annotations, with XLA
+inserting the collectives. This module provides the small amount of shared
+machinery the models/benchmarks need to produce such state.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Tuple[str, ...] = ("data", "model"),
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all).
+
+    If `axis_sizes` is given it maps axis name -> size (one axis may be -1
+    to absorb the remainder). Otherwise the 'model' axis gets the largest
+    power-of-two divisor <= sqrt(n) and 'data' the rest, which gives a
+    sensible dp x tp default on any device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        model = 1
+        while model * 2 <= int(math.isqrt(n)) and n % (model * 2) == 0:
+            model *= 2
+        axis_sizes = {"data": n // model, "model": model}
+        axis_names = tuple(axis_sizes.keys())
+    else:
+        axis_names = tuple(axis_sizes.keys())
+        sizes = list(axis_sizes.values())
+        if -1 in sizes:
+            known = math.prod(s for s in sizes if s != -1)
+            sizes[sizes.index(-1)] = n // known
+        axis_sizes = dict(zip(axis_names, sizes))
+    shape = tuple(axis_sizes[a] for a in axis_names)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {axis_sizes} != {n} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """device_put every leaf of `tree` with the matching PartitionSpec leaf.
+
+    `specs` is a pytree with the same treedef whose leaves are
+    PartitionSpec (or None for fully replicated).
+    """
+
+    def _put(x, spec):
+        spec = spec if spec is not None else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(
+        _put, tree, specs, is_leaf=lambda x: x is None
+    )

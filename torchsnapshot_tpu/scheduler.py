@@ -280,7 +280,14 @@ class _ReadPipeline:
         read_io = ReadIO(
             path=self.read_req.path, byte_range=self.read_req.byte_range
         )
-        await storage.read(read_io)
+        br = read_io.byte_range
+        if br is not None and br[1] <= br[0]:
+            # Zero-length range (e.g. a zero-size array packed into a slab):
+            # skip storage entirely — remote backends mishandle inverted or
+            # empty Range headers (S3 ignores them, GCS returns 416).
+            read_io.buf = bytearray()
+        else:
+            await storage.read(read_io)
         buf = read_io.buf
         throughput.add(len(buf))
         await self.read_req.buffer_consumer.consume_buffer(buf, executor)

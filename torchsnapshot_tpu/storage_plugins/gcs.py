@@ -38,6 +38,15 @@ def _is_transient(exc: BaseException) -> bool:
             return True
     except ImportError:  # pragma: no cover
         pass
+    try:
+        import requests.exceptions as rexc
+
+        # requests.exceptions.ConnectionError subclasses OSError, not the
+        # builtin ConnectionError — check it explicitly.
+        if isinstance(exc, (rexc.ConnectionError, rexc.Timeout, rexc.ChunkedEncodingError)):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
     return isinstance(exc, (ConnectionError, TimeoutError))
 
 
@@ -78,13 +87,9 @@ class GCSStoragePlugin(StoragePlugin):
         def upload() -> None:
             from ..memoryview_stream import MemoryviewStream
 
-            if isinstance(buf, (bytes, bytearray)):
-                blob.upload_from_string(bytes(buf))
-            else:
-                # stream the staged memoryview without copying
-                blob.upload_from_file(
-                    MemoryviewStream(memoryview(buf)), size=memoryview(buf).nbytes
-                )
+            # stream without copying — bytearray slabs included
+            mv = memoryview(buf)
+            blob.upload_from_file(MemoryviewStream(mv), size=mv.nbytes)
 
         await self._with_retries(upload)
 

@@ -33,11 +33,8 @@ class S3StoragePlugin(StoragePlugin):
         from ..memoryview_stream import MemoryviewStream
 
         loop = asyncio.get_running_loop()
-        buf = write_io.buf
-        if isinstance(buf, (bytes, bytearray)):
-            body: Any = bytes(buf)
-        else:
-            body = MemoryviewStream(memoryview(buf))
+        # stream without copying — bytearray slabs included
+        body: Any = MemoryviewStream(memoryview(write_io.buf))
         await loop.run_in_executor(
             None,
             lambda: self.client.put_object(
@@ -53,7 +50,8 @@ class S3StoragePlugin(StoragePlugin):
         }
         if read_io.byte_range is not None:
             lo, hi = read_io.byte_range
-            kwargs["Range"] = f"bytes={lo}-{hi - 1}"  # inclusive
+            kwargs["Range"] = f"bytes={lo}-{hi - 1}"  # inclusive; zero-length
+            # ranges are short-circuited upstream (scheduler.read_and_consume)
 
         def get() -> bytes:
             return self.client.get_object(**kwargs)["Body"].read()
