@@ -1,0 +1,165 @@
+"""Plan-level preparer tests that bypass scheduler and storage entirely:
+ReadReqs are fulfilled directly from WriteReqs' staged buffers in memory
+(reference pattern: tests/test_tensor_io_preparer.py:33-56). Also the
+reference's chunked-read edge cases — strided/offset/non-contiguous
+destination views and prime-sized arrays (tests/test_tensor_io_preparer.py:
+158-181) — and greedy-partition determinism
+(tests/test_partition_replicated_paths.py)."""
+
+import asyncio
+from typing import Dict, List
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer
+from torchsnapshot_tpu.io_types import ReadReq, WriteReq
+from torchsnapshot_tpu.snapshot import _partition_write_units
+
+
+def _fulfill(write_reqs: List[WriteReq], read_reqs: List[ReadReq]) -> None:
+    """Serve byte-range reads straight from staged write buffers."""
+
+    async def run() -> None:
+        staged: Dict[str, bytes] = {}
+        for wr in write_reqs:
+            buf = await wr.buffer_stager.stage_buffer(None)
+            staged[wr.path] = bytes(buf)
+        for rr in read_reqs:
+            blob = staged[rr.path]
+            if rr.byte_range is not None:
+                lo, hi = rr.byte_range
+                blob = blob[lo:hi]
+            await rr.buffer_consumer.consume_buffer(blob, None)
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("shape", [(13,), (7, 11), (1,), (0,), (5, 3, 2)])
+def test_write_read_plan_roundtrip(shape) -> None:
+    src = np.random.default_rng(0).standard_normal(shape).astype(np.float32)
+    entry, write_reqs = ArrayIOPreparer.prepare_write("loc", src)
+    dst = np.zeros(shape, dtype=np.float32)
+    read_reqs = ArrayIOPreparer.prepare_read(entry, dst_view=dst)
+    _fulfill(write_reqs, read_reqs)
+    np.testing.assert_array_equal(dst, src)
+
+
+@pytest.mark.parametrize("limit", [1, 7, 64, 10**9])
+def test_chunked_read_prime_sized(limit) -> None:
+    """Prime-sized array under assorted buffer limits — uneven final chunk."""
+    src = np.arange(97, dtype=np.int64)
+    entry, write_reqs = ArrayIOPreparer.prepare_write("loc", src)
+    dst = np.zeros(97, dtype=np.int64)
+    read_reqs = ArrayIOPreparer.prepare_read(
+        entry, dst_view=dst, buffer_size_limit_bytes=limit
+    )
+    if limit < src.nbytes:
+        assert len(read_reqs) > 1
+    _fulfill(write_reqs, read_reqs)
+    np.testing.assert_array_equal(dst, src)
+
+
+def test_chunked_read_into_strided_view() -> None:
+    """reshape(-1) of a strided view is a copy — fills must still land in the
+    underlying destination (reference: tests/test_tensor_io_preparer.py:158-181)."""
+    src = np.random.default_rng(1).standard_normal((16, 8)).astype(np.float32)
+    entry, write_reqs = ArrayIOPreparer.prepare_write("loc", src)
+
+    backing = np.zeros((16, 16), dtype=np.float32)
+    dst = backing[:, ::2]  # non-contiguous column-strided view
+    assert not dst.flags["C_CONTIGUOUS"]
+    read_reqs = ArrayIOPreparer.prepare_read(
+        entry, dst_view=dst, buffer_size_limit_bytes=64
+    )
+    _fulfill(write_reqs, read_reqs)
+    np.testing.assert_array_equal(backing[:, ::2], src)
+    # untouched lanes stay zero
+    np.testing.assert_array_equal(backing[:, 1::2], np.zeros((16, 8), np.float32))
+
+
+def test_chunked_read_into_offset_view() -> None:
+    src = np.arange(24, dtype=np.float32).reshape(4, 6)
+    entry, write_reqs = ArrayIOPreparer.prepare_write("loc", src)
+    backing = np.full((8, 6), -1, dtype=np.float32)
+    dst = backing[2:6, :]  # offset (but contiguous) view
+    read_reqs = ArrayIOPreparer.prepare_read(
+        entry, dst_view=dst, buffer_size_limit_bytes=32
+    )
+    _fulfill(write_reqs, read_reqs)
+    np.testing.assert_array_equal(backing[2:6, :], src)
+    assert (backing[:2] == -1).all() and (backing[6:] == -1).all()
+
+
+def test_unchunked_read_into_transposed_view() -> None:
+    src = np.random.default_rng(2).standard_normal((6, 4)).astype(np.float64)
+    entry, write_reqs = ArrayIOPreparer.prepare_write("loc", src)
+    backing = np.zeros((4, 6), dtype=np.float64)
+    dst = backing.T
+    read_reqs = ArrayIOPreparer.prepare_read(entry, dst_view=dst)
+    _fulfill(write_reqs, read_reqs)
+    np.testing.assert_array_equal(backing.T, src)
+
+
+# ------------------------------------------------------- partition planning
+
+
+def _partition_all_ranks(flattened, replicated, world_size):
+    plans = [
+        _partition_write_units(flattened, replicated, rank, world_size)
+        for rank in range(world_size)
+    ]
+    return plans
+
+
+def test_partition_deterministic_and_disjoint() -> None:
+    rng = np.random.default_rng(3)
+    flattened = {
+        f"model/p{i}": rng.standard_normal((sz,)).astype(np.float32)
+        for i, sz in enumerate([100, 5000, 17, 40000, 2, 900])
+    }
+    flattened["obj"] = {"arbitrary": "object"}
+    replicated = set(flattened)
+    world_size = 4
+    plans = _partition_all_ranks(flattened, replicated, world_size)
+
+    # Every chunk/object assigned exactly once across ranks.
+    chunk_owners = []
+    obj_owners = []
+    for rank, (chunks, objs) in enumerate(plans):
+        for lp, lst in chunks.items():
+            for c in lst:
+                chunk_owners.append((lp, tuple(c[0]), tuple(c[1]), rank))
+        for lp in objs:
+            obj_owners.append((lp, rank))
+    keys = [(lp, o, s) for lp, o, s, _ in chunk_owners]
+    assert len(keys) == len(set(keys)), "chunk assigned to multiple ranks"
+    assert len(obj_owners) == len({lp for lp, _ in obj_owners})
+
+    # Re-running yields the identical plan (determinism).
+    again = _partition_all_ranks(flattened, replicated, world_size)
+    for (c1, o1), (c2, o2) in zip(plans, again):
+        assert {k: [tuple(map(tuple, c)) for c in v] for k, v in c1.items()} == {
+            k: [tuple(map(tuple, c)) for c in v] for k, v in c2.items()
+        }
+        assert o1 == o2
+
+
+def test_partition_balances_load() -> None:
+    flattened = {
+        f"p{i}": np.zeros(1000, dtype=np.float32) for i in range(16)
+    }
+    replicated = set(flattened)
+    plans = _partition_all_ranks(flattened, replicated, 4)
+    per_rank = [
+        sum(len(v) for v in chunks.values()) for chunks, _ in plans
+    ]
+    assert sum(per_rank) == 16
+    assert max(per_rank) - min(per_rank) <= 1
+
+
+def test_partition_non_replicated_stays_local() -> None:
+    flattened = {"mine": np.zeros(10, dtype=np.float32)}
+    chunks, objs = _partition_write_units(flattened, set(), rank=2, world_size=4)
+    assert "mine" in chunks and len(chunks["mine"]) == 1
+    assert objs == set()

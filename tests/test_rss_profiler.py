@@ -1,0 +1,35 @@
+"""Tests for the RSS profiler (reference test pattern: used as a budget
+oracle in benchmarks; here we validate the sampling mechanics)."""
+
+import time
+
+import numpy as np
+
+from torchsnapshot_tpu.rss_profiler import RSSProfiler, measure_rss_deltas
+
+
+def test_samples_collected():
+    deltas = []
+    with measure_rss_deltas(deltas, interval_s=0.01):
+        time.sleep(0.1)
+    assert len(deltas) >= 2
+
+
+def test_allocation_visible_in_peak():
+    prof = RSSProfiler(interval_s=0.01)
+    with prof:
+        # 64 MB touch — comfortably above sampling noise.
+        buf = np.ones(64 * 1024 * 1024, dtype=np.uint8)
+        buf[::4096] += 1
+        time.sleep(0.1)
+    assert prof.peak_delta_bytes > 32 * 1024 * 1024
+    del buf
+
+
+def test_thread_stops_on_exit():
+    prof = RSSProfiler(interval_s=0.01)
+    with prof:
+        time.sleep(0.03)
+    n = len(prof.rss_deltas)
+    time.sleep(0.05)
+    assert len(prof.rss_deltas) == n

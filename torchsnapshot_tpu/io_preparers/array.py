@@ -15,6 +15,7 @@ the array on device with its original sharding.
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -211,8 +212,19 @@ class ArrayAssembler:
         callback: Optional[Callable[[np.ndarray], None]] = None,
     ) -> None:
         self.dst = dst
-        self._flat = dst.reshape(-1)
+        # reshape(-1) on a non-contiguous view returns a COPY, so flat fills
+        # would be lost; assemble into a contiguous scratch instead and copy
+        # back once on completion (reference covers strided/offset dst views,
+        # tests/test_tensor_io_preparer.py:158-181).
+        if dst.flags["C_CONTIGUOUS"]:
+            self._scratch = dst
+        else:
+            # Seed with current contents so partially-covering fills (e.g. a
+            # destination only some regions overlap) don't clobber the rest.
+            self._scratch = np.ascontiguousarray(dst)
+        self._flat = self._scratch.reshape(-1)
         self._remaining = num_parts
+        self._lock = threading.Lock()
         self.callback = callback
 
     def fill_flat(self, elem_lo: int, elem_hi: int, values: np.ndarray) -> None:
@@ -220,15 +232,21 @@ class ArrayAssembler:
         self.part_done()
 
     def fill_region(self, index: Tuple[slice, ...], values: np.ndarray) -> None:
-        # dst[()] on a 0-d array yields a scalar, not a view — copy whole-array.
-        target = self.dst[index] if index else self.dst
+        # scratch[()] on a 0-d array yields a scalar, not a view — copy whole-array.
+        target = self._scratch[index] if index else self._scratch
         np.copyto(target, values, casting="same_kind")
         self.part_done()
 
     def part_done(self) -> None:
-        self._remaining -= 1
-        if self._remaining == 0 and self.callback is not None:
-            self.callback(self.dst)
+        # Parts are consumed concurrently from executor threads.
+        with self._lock:
+            self._remaining -= 1
+            remaining = self._remaining
+        if remaining == 0:
+            if self._scratch is not self.dst:
+                np.copyto(self.dst, self._scratch, casting="same_kind")
+            if self.callback is not None:
+                self.callback(self.dst)
 
 
 def _prepare_chunked_read(

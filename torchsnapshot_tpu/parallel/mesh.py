@@ -27,9 +27,10 @@ def make_mesh(
     """Build a Mesh over `devices` (default: all).
 
     If `axis_sizes` is given it maps axis name -> size (one axis may be -1
-    to absorb the remainder). Otherwise the 'model' axis gets the largest
-    power-of-two divisor <= sqrt(n) and 'data' the rest, which gives a
-    sensible dp x tp default on any device count.
+    to absorb the remainder) and determines the axis names. Otherwise the
+    last of `axis_names` (the tp-like axis) gets the largest power-of-two
+    divisor <= sqrt(n), the first absorbs the rest, and middle axes get 1 —
+    a sensible dp x tp default on any device count.
     """
     if devices is None:
         devices = jax.devices()
@@ -38,9 +39,15 @@ def make_mesh(
         model = 1
         while model * 2 <= int(math.isqrt(n)) and n % (model * 2) == 0:
             model *= 2
-        axis_sizes = {"data": n // model, "model": model}
-        axis_names = tuple(axis_sizes.keys())
+        axis_sizes = {name: 1 for name in axis_names}
+        axis_sizes[axis_names[-1]] = model
+        axis_sizes[axis_names[0]] = (n // model) * axis_sizes[axis_names[0]]
     else:
+        if axis_names != ("data", "model") and tuple(axis_sizes) != axis_names:
+            raise ValueError(
+                f"axis_names {axis_names} conflicts with axis_sizes keys "
+                f"{tuple(axis_sizes)}; pass one or the other."
+            )
         axis_names = tuple(axis_sizes.keys())
         sizes = list(axis_sizes.values())
         if -1 in sizes:

@@ -1,0 +1,77 @@
+"""RSS memory profiler (reference: rss_profiler.py:17-56).
+
+Context manager that samples the process RSS delta on a background thread
+at a fixed interval and records the deltas into a caller-supplied list.
+Benchmarks use it to verify that the scheduler's per-process memory budget
+is actually respected (peak RSS delta <= budget + slack).
+
+Unlike CUDA, a JAX/TPU process stages device->host copies into ordinary
+host memory, so RSS is the right observable here too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Generator, List
+
+import psutil
+
+_DEFAULT_INTERVAL_S = 0.1
+
+
+class RSSProfiler:
+    """Samples RSS delta relative to entry on a daemon thread.
+
+    ``rss_deltas`` holds one sample per interval, in bytes. The first
+    sample is taken immediately on entry so short regions still record.
+    """
+
+    def __init__(self, interval_s: float = _DEFAULT_INTERVAL_S) -> None:
+        self.rss_deltas: List[int] = []
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._baseline = 0
+
+    def __enter__(self) -> "RSSProfiler":
+        self._baseline = psutil.Process().memory_info().rss
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._sample_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self._stop.set()
+        assert self._thread is not None
+        self._thread.join()
+
+    def _sample_loop(self) -> None:
+        proc = psutil.Process()
+        while True:
+            self.rss_deltas.append(proc.memory_info().rss - self._baseline)
+            if self._stop.wait(self.interval_s):
+                # One final sample so the peak inside the region isn't missed
+                # between the last tick and __exit__.
+                self.rss_deltas.append(proc.memory_info().rss - self._baseline)
+                return
+
+    @property
+    def peak_delta_bytes(self) -> int:
+        return max(self.rss_deltas, default=0)
+
+
+@contextlib.contextmanager
+def measure_rss_deltas(
+    rss_deltas: List[int], interval_s: float = _DEFAULT_INTERVAL_S
+) -> Generator[None, None, None]:
+    """Populate ``rss_deltas`` with RSS-vs-entry samples while the body runs.
+
+    Signature mirrors the reference's ``measure_rss_deltas`` so benchmarks
+    read the same way (reference rss_profiler.py:32-56).
+    """
+    profiler = RSSProfiler(interval_s=interval_s)
+    with profiler:
+        yield
+    rss_deltas.extend(profiler.rss_deltas)
