@@ -1,0 +1,45 @@
+"""Shared benchmark helpers: timing, RSS tracking, result printing.
+
+Reference analogues: benchmarks/*/main.py print wall times and peak RSS
+(e.g. benchmarks/torchrec/main.py:212,231); here every benchmark emits one
+JSON object per measured configuration so results are machine-comparable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Any, Dict, Generator, List
+
+
+@contextlib.contextmanager
+def timed_rss(result: Dict[str, Any]) -> Generator[None, None, None]:
+    """Populate result with wall_s and peak_rss_delta_mb for the body."""
+    from torchsnapshot_tpu.rss_profiler import RSSProfiler
+
+    prof = RSSProfiler(interval_s=0.05)
+    t0 = time.perf_counter()
+    with prof:
+        yield
+    result["wall_s"] = round(time.perf_counter() - t0, 3)
+    result["peak_rss_delta_mb"] = round(prof.peak_delta_bytes / 1e6, 1)
+
+
+def report(name: str, result: Dict[str, Any], data_bytes: int | None = None) -> None:
+    out = {"benchmark": name, **result}
+    if data_bytes is not None and result.get("wall_s"):
+        out["gbps"] = round(data_bytes / 1e9 / result["wall_s"], 3)
+    print(json.dumps(out))
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Run on N virtual CPU devices (must be called before first JAX use)."""
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,76 @@
+"""Memory-budgeted single-tensor load (reference: benchmarks/load_tensor/main.py:24-92).
+
+Saves one large array, then loads it via read_object with and without a
+memory budget. The budgeted load must show bounded peak RSS (byte-range
+chunked reads) at comparable throughput.
+
+Usage:
+  python benchmarks/load_tensor.py [--gb 1.0] [--budget-mb 100] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import tempfile
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gb", type=float, default=0.5)
+    ap.add_argument("--budget-mb", type=int, default=100)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    from bench_utils import force_cpu_devices, report, timed_rss
+
+    if args.cpu:
+        force_cpu_devices(1)
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    side = int((args.gb * 1e9 / 4) ** 0.5)
+    arr = np.random.default_rng(0).standard_normal((side, side)).astype(np.float32)
+    nbytes = arr.nbytes
+
+    tmp = tempfile.mkdtemp(prefix="bench_load_tensor_")
+    try:
+        Snapshot.take(f"{tmp}/snap", {"t": StateDict(x=arr)})
+        snap = Snapshot(f"{tmp}/snap")
+
+        res: dict = {}
+        with timed_rss(res):
+            out = snap.read_object("0/t/x")
+        assert out.tobytes() == arr.tobytes()
+        del out
+        report("load_tensor/unbudgeted", res, nbytes)
+
+        budget = args.budget_mb * 1024 * 1024
+        dst = np.zeros_like(arr)
+        res = {"budget_mb": args.budget_mb}
+        with timed_rss(res):
+            snap.read_object("0/t/x", obj_out=dst, memory_budget_bytes=budget)
+        assert dst.tobytes() == arr.tobytes()
+        report("load_tensor/budgeted", res, nbytes)
+
+        # naive baseline
+        np.save(f"{tmp}/naive.npy", arr)
+        res = {}
+        with timed_rss(res):
+            loaded = np.load(f"{tmp}/naive.npy")
+        assert loaded.shape == arr.shape
+        del loaded
+        report("load_tensor/naive_npload", res, nbytes)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

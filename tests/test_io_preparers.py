@@ -101,6 +101,33 @@ def test_unchunked_read_into_transposed_view() -> None:
     np.testing.assert_array_equal(backing.T, src)
 
 
+def test_chunked_entry_read_into_strided_view() -> None:
+    """Budgeted ChunkedArrayEntry restore into a non-contiguous dst: per-chunk
+    sub-views of a strided dst can themselves be contiguous, which routed
+    writes directly into dst while the outer assembler's scratch copy-back
+    then clobbered them. All writes must go through the assembler."""
+    from torchsnapshot_tpu.io_preparers.chunked import ChunkedArrayIOPreparer
+
+    src = np.random.default_rng(4).standard_normal((8, 6)).astype(np.float32)
+    chunks = [([0, 0], [4, 6]), ([4, 0], [4, 6])]
+    entry, write_reqs = ChunkedArrayIOPreparer.prepare_write("loc", src, chunks)
+
+    backing = np.zeros((16, 6), dtype=np.float32)
+    dst = backing[::2, :]  # row-strided, non-contiguous
+    assert not dst.flags["C_CONTIGUOUS"]
+    fired = []
+    read_reqs = ChunkedArrayIOPreparer.prepare_read(
+        entry,
+        dst_view=dst,
+        callback=lambda a: fired.append(a),
+        buffer_size_limit_bytes=48,
+    )
+    _fulfill(write_reqs, read_reqs)
+    assert fired, "completion callback did not fire"
+    np.testing.assert_array_equal(backing[::2, :], src)
+    np.testing.assert_array_equal(backing[1::2, :], np.zeros((8, 6), np.float32))
+
+
 # ------------------------------------------------------- partition planning
 
 

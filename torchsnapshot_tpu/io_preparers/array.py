@@ -227,6 +227,14 @@ class ArrayAssembler:
         self._lock = threading.Lock()
         self.callback = callback
 
+    def region_view(self, index: Tuple[slice, ...]) -> np.ndarray:
+        """A writable view of the assembly target for ``index``. Callers that
+        write sub-regions directly (e.g. budgeted chunk reads) MUST write into
+        this view, never into ``dst`` itself: when ``dst`` is non-contiguous
+        the assembly happens in a scratch buffer that is copied back over
+        ``dst`` on completion, which would clobber direct writes."""
+        return self._scratch[index] if index else self._scratch
+
     def fill_flat(self, elem_lo: int, elem_hi: int, values: np.ndarray) -> None:
         np.copyto(self._flat[elem_lo:elem_hi], values, casting="same_kind")
         self.part_done()

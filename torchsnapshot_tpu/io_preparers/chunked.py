@@ -141,7 +141,10 @@ class ChunkedArrayIOPreparer:
             index = tuple(
                 slice(o, o + s) for o, s in zip(chunk.offsets, chunk.sizes)
             )
-            sub_dst = dst_view[index] if chunk.offsets else dst_view
+            # Write through the assembler's target (its scratch when dst_view
+            # is non-contiguous) — direct dst_view writes would be clobbered
+            # by the assembler's completion copy-back.
+            sub_dst = assembler.region_view(index if chunk.offsets else ())
             if buffer_size_limit_bytes is not None and sub_dst.flags["C_CONTIGUOUS"]:
                 # Split this chunk's read into byte ranges under the budget;
                 # the sub-assembler inside prepare_read notifies the outer

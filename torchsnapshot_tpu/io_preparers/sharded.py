@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -154,10 +155,14 @@ class _Completion:
     def __init__(self, num_parts: int, finalize: Callable[[], None]) -> None:
         self._remaining = num_parts
         self._finalize = finalize
+        self._lock = threading.Lock()
 
     def part_done(self) -> None:
-        self._remaining -= 1
-        if self._remaining == 0:
+        # Parts are consumed concurrently from executor threads.
+        with self._lock:
+            self._remaining -= 1
+            remaining = self._remaining
+        if remaining == 0:
             self._finalize()
 
 

@@ -7,3 +7,4 @@ from .transformer import (  # noqa: F401
     make_optimizer,
     param_specs,
 )
+from . import embedding  # noqa: F401

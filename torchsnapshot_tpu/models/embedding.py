@@ -1,0 +1,146 @@
+"""Row-wise sharded embedding model (the torchrec/DLRM checkpointing analogue).
+
+The reference's heaviest real-world workload is torchrec DLRM with row-wise
+sharded embedding tables (tests/gpu_tests/test_torchrec.py:170-241,
+benchmarks/torchrec/main.py:54-151): huge (vocab, dim) tables split along
+the row axis across ranks, saved as shards and reshardable on restore.
+
+TPU-native realization: each table is a `jax.Array` with
+`NamedSharding(mesh, P(('data', 'model'), None))` — rows split over ALL
+mesh devices (the row-wise layout), lookups via `jnp.take` under jit so
+XLA inserts the gather collectives, plus a dense interaction MLP. The
+state-dict level is just sharded arrays, so the snapshot path is identical
+to any GSPMD state — which is the point: checkpointing must not care *why*
+an array is sharded (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class EmbeddingConfig:
+    n_tables: int = 8
+    rows_per_table: int = 100_000
+    dim: int = 64
+    n_dense_features: int = 13
+    mlp_hidden: Tuple[int, ...] = (256, 64)
+    param_dtype: Any = field(default=jnp.float32)
+
+    @property
+    def param_count(self) -> int:
+        n = self.n_tables * self.rows_per_table * self.dim
+        widths = (self.n_dense_features + self.n_tables * self.dim,) + self.mlp_hidden
+        for a, b in zip(widths, widths[1:] + (1,)):
+            n += a * b + b
+        return n
+
+
+def init_params(rng: jax.Array, cfg: EmbeddingConfig) -> Params:
+    keys = jax.random.split(rng, cfg.n_tables + len(cfg.mlp_hidden) + 1)
+    tables = {
+        f"table_{i}": jax.random.normal(
+            keys[i], (cfg.rows_per_table, cfg.dim), cfg.param_dtype
+        )
+        * (cfg.dim**-0.5)
+        for i in range(cfg.n_tables)
+    }
+    widths = (cfg.n_dense_features + cfg.n_tables * cfg.dim,) + cfg.mlp_hidden + (1,)
+    mlp = {}
+    for j, (fan_in, fan_out) in enumerate(zip(widths, widths[1:])):
+        mlp[f"w{j}"] = (
+            jax.random.normal(keys[cfg.n_tables + j], (fan_in, fan_out), cfg.param_dtype)
+            * (fan_in**-0.5)
+        )
+        mlp[f"b{j}"] = jnp.zeros((fan_out,), cfg.param_dtype)
+    return {"tables": tables, "mlp": mlp}
+
+
+def param_specs(cfg: EmbeddingConfig) -> Params:
+    """Row-wise layout: table rows split over every mesh axis; MLP replicated
+    (it is tiny relative to the tables, like DLRM's dense arch)."""
+    return {
+        "tables": {f"table_{i}": P(("data", "model"), None) for i in range(cfg.n_tables)},
+        "mlp": {},  # filled per-key below; all replicated
+    }
+
+
+def full_param_specs(cfg: EmbeddingConfig, params: Params) -> Params:
+    specs = param_specs(cfg)
+    specs["mlp"] = {k: P() for k in params["mlp"]}
+    return specs
+
+
+def forward(params: Params, dense: jax.Array, sparse_ids: jax.Array,
+            cfg: EmbeddingConfig) -> jax.Array:
+    """dense: (B, n_dense_features); sparse_ids: (B, n_tables) int32."""
+    looked_up = [
+        jnp.take(params["tables"][f"table_{i}"], sparse_ids[:, i], axis=0)
+        for i in range(cfg.n_tables)
+    ]
+    x = jnp.concatenate([dense] + looked_up, axis=-1)
+    n_layers = len(cfg.mlp_hidden) + 1
+    for j in range(n_layers):
+        x = x @ params["mlp"][f"w{j}"] + params["mlp"][f"b{j}"]
+        if j < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x[:, 0]
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: EmbeddingConfig) -> jax.Array:
+    logits = forward(params, batch["dense"], batch["sparse_ids"], cfg)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, batch["labels"]))
+
+
+def init_state(
+    rng: jax.Array,
+    cfg: EmbeddingConfig,
+    tx: optax.GradientTransformation,
+    *,
+    mesh: Optional[Mesh] = None,
+) -> Dict[str, Any]:
+    params = init_params(rng, cfg)
+    if mesh is not None:
+        from ..parallel.mesh import shard_pytree
+
+        params = shard_pytree(params, full_param_specs(cfg, params), mesh)
+    return {
+        "params": params,
+        "opt_state": tx.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def make_train_step(cfg: EmbeddingConfig, tx: optax.GradientTransformation,
+                    *, mesh: Optional[Mesh] = None):
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch, cfg)
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    if mesh is None:
+        return train_step
+
+    def sharded_step(state, batch):
+        batch = {
+            k: jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(*(("data",) + (None,) * (v.ndim - 1))))
+            )
+            for k, v in batch.items()
+        }
+        return train_step(state, batch)
+
+    return sharded_step

@@ -72,6 +72,8 @@ def measure_rss_deltas(
     read the same way (reference rss_profiler.py:32-56).
     """
     profiler = RSSProfiler(interval_s=interval_s)
-    with profiler:
-        yield
-    rss_deltas.extend(profiler.rss_deltas)
+    try:
+        with profiler:
+            yield
+    finally:
+        rss_deltas.extend(profiler.rss_deltas)

@@ -1,0 +1,21 @@
+"""Adapters for third-party training stacks (reference: tricks/deepspeed.py).
+
+The reference ships one "trick": an adapter that lets a DeepSpeed ZeRO-3
+engine checkpoint through Snapshot (tricks/deepspeed.py:19-103). The TPU
+ecosystem's counterparts are flax ``TrainState`` objects (immutable pytree
+dataclasses) and orbax checkpoints; adapters for both live here. Imports
+are lazy so the core library never requires flax/orbax.
+"""
+
+from typing import Any
+
+__all__ = ["FlaxTrainStateAdapter", "PytreeAdapter"]
+
+
+def __getattr__(name: str) -> Any:
+    if name in ("FlaxTrainStateAdapter", "PytreeAdapter"):
+        from .flax_train import FlaxTrainStateAdapter, PytreeAdapter
+
+        return {"FlaxTrainStateAdapter": FlaxTrainStateAdapter,
+                "PytreeAdapter": PytreeAdapter}[name]
+    raise AttributeError(name)

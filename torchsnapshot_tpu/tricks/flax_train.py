@@ -1,0 +1,63 @@
+"""Stateful adapters for flax TrainState and generic pytrees.
+
+The reference's trick adapts a third-party engine whose state object is not
+itself Stateful (DeepSpeedEngine + ZeRO-3 optimizer, tricks/deepspeed.py:
+30-66): the adapter exposes state_dict/load_state_dict and reinstalls the
+restored state into the engine. The flax analogue: ``TrainState`` is an
+immutable pytree dataclass, so the adapter holds the current state and
+*replaces* it on load — callers read ``adapter.state`` after restore.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class FlaxTrainStateAdapter:
+    """Checkpoint a ``flax.training.train_state.TrainState`` (or any flax
+    struct dataclass) through Snapshot.
+
+    Uses ``flax.serialization.to_state_dict``/``from_state_dict`` so the
+    on-disk layout is nested dicts of arrays — readable via ``read_object``
+    and stable under flax's own serialization rules. Non-array fields
+    (``apply_fn``, ``tx``) are structural and never stored.
+    """
+
+    def __init__(self, state: Any) -> None:
+        self.state = state
+
+    def state_dict(self) -> Dict[str, Any]:
+        from flax import serialization
+
+        return serialization.to_state_dict(self.state)
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        from flax import serialization
+
+        self.state = serialization.from_state_dict(self.state, state_dict)
+
+
+class PytreeAdapter:
+    """Checkpoint an arbitrary pytree (haiku params, custom nodes, ...).
+
+    Leaves are stored under their ``jax.tree_util.keystr`` paths, so the
+    manifest stays human-readable and entries survive structural no-ops.
+    The destination tree must have the same treedef at restore time; the
+    restored tree replaces ``self.tree``.
+    """
+
+    def __init__(self, tree: Any) -> None:
+        self.tree = tree
+
+    def state_dict(self) -> Dict[str, Any]:
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.tree)
+        return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.tree)
+        leaves = [state_dict[jax.tree_util.keystr(path)] for path, _ in flat]
+        self.tree = jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,50 @@
+"""Migration between orbax checkpoints and snapshots.
+
+Orbax is the incumbent JAX checkpointing library; users switching to this
+framework (or integrating with tools that emit orbax checkpoints) need a
+one-shot migration path, the way the reference's DeepSpeed trick bridged
+an incumbent format (tricks/deepspeed.py:87-103). Imports are lazy: the
+core library never requires orbax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def load_orbax_pytree(orbax_path: str, target: Optional[Any] = None) -> Any:
+    """Read an orbax PyTreeCheckpointer checkpoint into a pytree.
+
+    ``target`` (optional) provides structure/sharding for the restore.
+    """
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(orbax_path, item=target)
+        return ckptr.restore(orbax_path)
+
+
+def migrate_from_orbax(
+    orbax_path: str, snapshot_path: str, target: Optional[Any] = None
+) -> Any:
+    """Convert an orbax checkpoint into a snapshot; returns the Snapshot."""
+    from .. import Snapshot, StateDict
+
+    tree = load_orbax_pytree(orbax_path, target)
+    if not isinstance(tree, dict):
+        tree = {"tree": tree}
+    return Snapshot.take(snapshot_path, {"app": StateDict(**tree)})
+
+
+def migrate_to_orbax(snapshot_path: str, orbax_path: str, target: Any) -> None:
+    """Restore a snapshot into ``target`` (a dict pytree matching the saved
+    app state's 'app' key) and write it as an orbax checkpoint."""
+    import orbax.checkpoint as ocp
+
+    from .. import Snapshot, StateDict
+
+    dst = StateDict(**target)
+    Snapshot(snapshot_path).restore({"app": dst})
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(orbax_path, dict(dst))
