@@ -83,8 +83,17 @@ def main() -> None:
             last_snapshot = (path, pending)
             print(f"step {progress['step']}: snapshot -> {path}")
 
+    if last_snapshot is None or last_snapshot[0] != f"{work_dir}/step_{args.steps}":
+        # Final step didn't land on the cadence — snapshot it synchronously
+        # so the restart below always resumes from step == args.steps.
+        path = f"{work_dir}/step_{args.steps}"
+        Snapshot.take(path, app_state)
+        last_snapshot = (path, None)
+        print(f"step {progress['step']}: final snapshot -> {path}")
+
     path, pending = last_snapshot
-    pending.wait()
+    if pending is not None:
+        pending.wait()
 
     # ----- simulated restart: fresh state, restore, verify
     params_before = app_state["model"]["params"]

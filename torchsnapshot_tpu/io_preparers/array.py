@@ -240,9 +240,7 @@ class ArrayAssembler:
         self.part_done()
 
     def fill_region(self, index: Tuple[slice, ...], values: np.ndarray) -> None:
-        # scratch[()] on a 0-d array yields a scalar, not a view — copy whole-array.
-        target = self._scratch[index] if index else self._scratch
-        np.copyto(target, values, casting="same_kind")
+        np.copyto(self.region_view(index), values, casting="same_kind")
         self.part_done()
 
     def part_done(self) -> None:

@@ -67,17 +67,17 @@ def init_params(rng: jax.Array, cfg: EmbeddingConfig) -> Params:
 
 def param_specs(cfg: EmbeddingConfig) -> Params:
     """Row-wise layout: table rows split over every mesh axis; MLP replicated
-    (it is tiny relative to the tables, like DLRM's dense arch)."""
+    (it is tiny relative to the tables, like DLRM's dense arch). Complete
+    spec pytree — matches init_params' structure exactly."""
+    n_mlp = len(cfg.mlp_hidden) + 1
+    mlp = {}
+    for j in range(n_mlp):
+        mlp[f"w{j}"] = P()
+        mlp[f"b{j}"] = P()
     return {
         "tables": {f"table_{i}": P(("data", "model"), None) for i in range(cfg.n_tables)},
-        "mlp": {},  # filled per-key below; all replicated
+        "mlp": mlp,
     }
-
-
-def full_param_specs(cfg: EmbeddingConfig, params: Params) -> Params:
-    specs = param_specs(cfg)
-    specs["mlp"] = {k: P() for k in params["mlp"]}
-    return specs
 
 
 def forward(params: Params, dense: jax.Array, sparse_ids: jax.Array,
@@ -112,12 +112,31 @@ def init_state(
     if mesh is not None:
         from ..parallel.mesh import shard_pytree
 
-        params = shard_pytree(params, full_param_specs(cfg, params), mesh)
-    return {
+        params = shard_pytree(params, param_specs(cfg), mesh)
+    state = {
         "params": params,
         "opt_state": tx.init(params),
         "step": jnp.zeros((), jnp.int32),
     }
+    if mesh is not None:
+        # Commit the FULL state (scalars replicated) so restored state —
+        # which comes back committed to these shardings — is resumable.
+        state = shard_pytree(state, state_specs(cfg, state), mesh)
+    return state
+
+
+def state_specs(cfg: EmbeddingConfig, state: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpec pytree matching init_state's output: optimizer moments
+    inherit their param's spec, scalars replicated."""
+    p_specs = param_specs(cfg)
+
+    def map_opt(entry):
+        if isinstance(entry, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(count=P(), mu=p_specs, nu=p_specs)
+        return jax.tree_util.tree_map(lambda _: P(), entry)
+
+    opt_spec = tuple(map_opt(e) for e in state["opt_state"])
+    return {"params": p_specs, "opt_state": opt_spec, "step": P()}
 
 
 def make_train_step(cfg: EmbeddingConfig, tx: optax.GradientTransformation,

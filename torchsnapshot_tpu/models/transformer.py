@@ -13,6 +13,11 @@ and training step are annotated for a ('data','model') mesh:
 - sp: the residual stream between blocks is sequence-sharded over 'model'
   (Megatron sequence parallelism), so norm/elementwise work is partitioned
   and XLA materializes all-gather/reduce-scatter at block boundaries.
+- cp: with ``attn_impl="ring"`` and a mesh that has a 'seq' axis, the
+  sequence dimension stays sharded end-to-end (context parallelism):
+  attention runs as ring attention over the 'seq' axis (K/V rotate on the
+  ICI ring, ops/ring_attention.py) and no full-sequence activation is ever
+  gathered — the long-context configuration.
 
 The state it produces (params + optax opt_state + step + PRNG key) is the
 canonical AppState the snapshot layer checkpoints and reshards.
@@ -41,6 +46,10 @@ class TransformerConfig:
     max_seq_len: int = 1024
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # "dense" | "blockwise" (flash-style local) | "ring" (context parallel,
+    # needs a mesh with a 'seq' axis).
+    attn_impl: str = "dense"
+    attn_block_size: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -129,6 +138,24 @@ def forward(
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
+    if c.attn_impl not in ("dense", "blockwise", "ring"):
+        raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
+    # cp (ring) keeps the sequence dim sharded over 'seq' end-to-end; the
+    # Megatron-sp fallback seq-shards the residual over the tp axis instead
+    # and gathers around attention/ffn.
+    has_seq = mesh is not None and "seq" in mesh.axis_names
+    if c.attn_impl == "ring" and mesh is not None and not has_seq:
+        raise ValueError(
+            f"attn_impl='ring' needs a mesh with a 'seq' axis; got "
+            f"{mesh.axis_names}. Build one via make_mesh({{'data': ..., "
+            f"'seq': ..., 'model': ...}})."
+        )
+    # mesh=None (single-device run of a ring-configured model) falls back
+    # to dense attention — same math, no ring to rotate on.
+    ring = c.attn_impl == "ring" and has_seq
+    res_seq_ax = "seq" if has_seq else "model"  # residual-stream seq sharding
+    act_seq_ax = "seq" if ring else None  # in-block activation seq sharding
+
     x = params["embed"].astype(c.dtype)[tokens]  # (B, S, D)
     pos = jnp.arange(S)[None, :, None]
     dims = jnp.arange(c.d_model // 2)[None, None, :]
@@ -138,41 +165,51 @@ def forward(
     pe = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
     x = x + pe.astype(c.dtype)
 
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    def attention(q, k, v):
+        # q, k, v: (B, S, H, hd) — logical shapes; sharding via constraints.
+        if ring:
+            from ..ops.ring_attention import ring_attention_sharded
+
+            return ring_attention_sharded(q, k, v, mesh, causal=True)
+        if c.attn_impl == "blockwise":
+            from ..ops.attention import blockwise_attention
+
+            return blockwise_attention(
+                q, k, v, block_size=min(c.attn_block_size, S), causal=True
+            )
+        from ..ops.attention import dense_attention
+
+        return dense_attention(q, k, v, causal=True)
 
     def block(x, layer):
-        # sp: residual stream sequence-sharded over the tp axis between blocks.
-        x = cs(x, P("data", "model", None))
+        x = cs(x, P("data", res_seq_ax, None))
         h = _rmsnorm(x, layer["ln1_scale"])
-        h = cs(h, P("data", None, None))
+        h = cs(h, P("data", act_seq_ax, None))
         qkv = h @ layer["attn_qkv"].astype(c.dtype)  # (B,S,3D)
-        qkv = cs(qkv, P("data", None, "model"))
+        qkv = cs(qkv, P("data", act_seq_ax, "model"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
-            return t.reshape(B, S, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+            t = t.reshape(B, S, c.n_heads, c.head_dim)
+            return cs(t, P("data", act_seq_ax, "model", None))
 
-        q, k, v = heads(q), heads(k), heads(v)  # (B,H,S,hd)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (c.head_dim**0.5)
-        scores = jnp.where(causal[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, c.d_model)
-        attn = cs(attn, P("data", None, "model"))
-        x = x + cs(attn @ layer["attn_out"].astype(c.dtype), P("data", "model", None))
+        attn = attention(heads(q), heads(k), heads(v))  # (B,S,H,hd)
+        attn = attn.reshape(B, S, c.d_model)
+        attn = cs(attn, P("data", act_seq_ax, "model"))
+        x = x + cs(attn @ layer["attn_out"].astype(c.dtype), P("data", res_seq_ax, None))
 
         h = _rmsnorm(x, layer["ln2_scale"])
-        h = cs(h, P("data", None, None))
+        h = cs(h, P("data", act_seq_ax, None))
         h = jax.nn.gelu(h @ layer["ff_in"].astype(c.dtype))
-        h = cs(h, P("data", None, "model"))
-        x = x + cs(h @ layer["ff_out"].astype(c.dtype), P("data", "model", None))
+        h = cs(h, P("data", act_seq_ax, "model"))
+        x = x + cs(h @ layer["ff_out"].astype(c.dtype), P("data", res_seq_ax, None))
         return x, None
 
     x, _ = jax.lax.scan(block, x, params["layers"])
-    x = cs(x, P("data", None, None))
+    x = cs(x, P("data", act_seq_ax, None))
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = x @ params["embed"].astype(c.dtype).T
-    return cs(logits, P("data", None, "model"))
+    return cs(logits, P("data", act_seq_ax, "model"))
 
 
 def loss_fn(
@@ -226,14 +263,31 @@ def init_state(
     *,
     mesh: Optional[Mesh] = None,
 ) -> Dict[str, Any]:
-    """Initialize {params, opt_state, step}; shard onto `mesh` if given."""
+    """Initialize {params, opt_state, step}; shard onto `mesh` if given.
+
+    The FULL state is placed per ``state_specs`` — including replicated
+    scalars (optimizer count, step). Leaving scalars uncommitted works for
+    the first jit call but breaks resume-after-restore: a restored scalar
+    comes back committed to its destination's sharding, and a
+    single-device scalar next to mesh-committed params is an invalid jit
+    input mix.
+    """
     params = init_params(rng, cfg)
     if mesh is not None:
         from ..parallel.mesh import shard_pytree
 
         params = shard_pytree(params, param_specs(cfg), mesh)
     opt_state = tx.init(params)
-    return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
+    state = {
+        "params": params,
+        "opt_state": opt_state,
+        "step": jnp.zeros((), jnp.int32),
+    }
+    if mesh is not None:
+        from ..parallel.mesh import shard_pytree
+
+        state = shard_pytree(state, state_specs(cfg, state), mesh)
+    return state
 
 
 def state_specs(cfg: TransformerConfig, state: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,20 @@
+"""TPU-native hot ops: attention kernels and context-parallel primitives.
+
+The reference (torchsnapshot) contains no model or attention code — it is a
+checkpointing library (SURVEY.md §5.7 records the absence). This package
+exists because the TPU framework treats long-context and distributed
+execution as first-class: blockwise (flash-style) attention keeps HBM usage
+linear in sequence length, and ring attention shards the sequence dimension
+over a mesh axis with K/V rotating on the ICI ring (`jax.lax.ppermute`),
+so the checkpointing layer has real context-parallel state to snapshot.
+"""
+
+from .attention import blockwise_attention, dense_attention
+from .ring_attention import ring_attention_sharded, ring_self_attention
+
+__all__ = [
+    "blockwise_attention",
+    "dense_attention",
+    "ring_attention_sharded",
+    "ring_self_attention",
+]

@@ -1,0 +1,146 @@
+"""Blockwise (flash-style) causal attention for a single device/shard.
+
+Layout convention throughout: ``q, k, v: (batch, seq, heads, head_dim)``.
+Softmax statistics are carried in float32 regardless of input dtype; the
+output is cast back to the query dtype.
+
+Why blockwise: materializing the (S, S) score matrix is O(S^2) HBM — the
+usual long-context killer. Scanning over K/V blocks with an online softmax
+keeps peak memory at O(S * block) while XLA still sees large static-shape
+matmuls it can tile onto the MXU. ``lax.scan`` (not a Python loop) keeps the
+compiled program size flat as sequence length grows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Effectively -inf for masking without producing NaNs in exp()/max() chains.
+NEG_INF = -1e30
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+    k_offset: int = 0,
+) -> jax.Array:
+    """Reference O(S^2)-memory attention. ``q, k, v: (B, S, H, D)``.
+
+    ``q_offset``/``k_offset`` are the global positions of the first query /
+    key — used when q and k are shards of a longer sequence.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if causal:
+        # A query row with no valid key (reachable via k_offset > q_offset
+        # on sharded calls) must attend to nothing, not uniformly to
+        # everything — softmax of an all-NEG_INF row is uniform.
+        row_valid = mask.any(axis=-1)  # (Sq, Sk) -> (Sq,)
+        p = jnp.where(row_valid[None, None, :, None], p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def attention_block_update(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    k_pos: jax.Array,
+    scale: float,
+    causal: bool,
+    acc: Tuple[jax.Array, jax.Array, jax.Array],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax update of accumulator ``acc = (o, m, l)`` with a
+    (q-block, kv-block) pair.
+
+    o: (B, Sq, H, D) float32 unnormalized output;
+    m: (B, H, Sq) float32 running max; l: (B, H, Sq) float32 running sum.
+    ``q_pos``/``k_pos`` are int32 global positions, shapes (Sq,), (Sk,).
+
+    Masked-out blocks are numerically inert: their scores sit at NEG_INF, so
+    as long as the first block processed for every query row contains at
+    least one valid key (true for causal self-attention, where the diagonal
+    block is always processed first), ``exp(score - m)`` underflows to 0.
+    """
+    o, m, l = acc
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)  # (B, H, Sq)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def _finalize(acc: Tuple[jax.Array, jax.Array, jax.Array], dtype) -> jax.Array:
+    o, _, l = acc
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_size: int = 512,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Flash-style attention: scan over K/V blocks with an online softmax.
+
+    ``q, k, v: (B, S, H, D)`` with S divisible by ``block_size`` (callers pad;
+    a static check enforces it so XLA never sees dynamic shapes).
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D**-0.5
+    block_size = min(block_size, S)
+    if S % block_size != 0:
+        raise ValueError(f"seq len {S} not divisible by block_size {block_size}")
+    n_blocks = S // block_size
+
+    kb = k.reshape(B, n_blocks, block_size, H, D)
+    vb = v.reshape(B, n_blocks, block_size, H, D)
+    q_pos = jnp.arange(S)
+
+    def scan_kv(acc, blk):
+        k_blk, v_blk, j = blk
+        k_pos = j * block_size + jnp.arange(block_size)
+        acc = attention_block_update(
+            q, k_blk, v_blk, q_pos, k_pos, scale, causal, acc
+        )
+        return acc, None
+
+    acc = (
+        jnp.zeros((B, S, H, D), jnp.float32),
+        jnp.full((B, H, S), NEG_INF, jnp.float32),
+        jnp.zeros((B, H, S), jnp.float32),
+    )
+    # Scan from block 0 so the diagonal (always-valid) block is folded in
+    # before any fully-masked block — see attention_block_update.
+    acc, _ = jax.lax.scan(
+        scan_kv,
+        acc,
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4), jnp.arange(n_blocks)),
+    )
+    return _finalize(acc, q.dtype)

@@ -1,0 +1,121 @@
+"""Ring attention: context parallelism over a mesh axis.
+
+The sequence dimension is sharded over a mesh axis (the "ring"). Each device
+keeps its Q shard resident and its K/V shard rotates one hop per step around
+the ring via ``jax.lax.ppermute`` — an ICI-neighbor exchange, the cheapest
+collective pattern on a TPU torus. After ``ring_size`` steps every Q shard
+has attended to every K/V shard; softmax statistics are merged online
+(same accumulator as blockwise attention), so no (S, S) matrix and no
+full-sequence gather ever materializes. Peak per-device memory is
+O(S_local * D) and the K/V transfer fully overlaps with the block matmul
+XLA schedules for the previous step.
+
+``ring_self_attention`` is written to run *inside* ``jax.shard_map`` (it
+uses ``axis_index``/``ppermute``); ``ring_attention_sharded`` is the
+convenience wrapper that applies ``shard_map`` with the canonical specs.
+
+The reference framework has no context parallelism (SURVEY.md §5.7 — its
+checkpoint layer just reshards whatever state such schemes produce); this op
+exists because long-context training is first-class in the TPU build.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import NEG_INF, _finalize, attention_block_update
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard ring attention body. Must run inside ``shard_map``.
+
+    ``q, k, v: (B, S_local, H, D)`` — the local sequence shard; the global
+    sequence is ``ring_size * S_local`` laid out contiguously along the axis
+    (device i owns positions [i*S_local, (i+1)*S_local)).
+    """
+    B, S_loc, H, D = q.shape
+    ring = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = D**-0.5
+
+    q_pos = me * S_loc + jnp.arange(S_loc)
+    # Send K/V to the next device on the ring; after s steps device `me`
+    # holds the shard originally owned by (me - s) mod ring.
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        owner = jax.lax.rem(me - s + ring, ring)
+        k_pos = owner * S_loc + jnp.arange(S_loc)
+        o, m, l = attention_block_update(
+            q, k_cur, v_cur, q_pos, k_pos, scale, causal, (o, m, l)
+        )
+        # Rotate even on the last step (returns K/V to its owner); the
+        # extra hop costs one neighbor exchange and keeps the scan uniform.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    # The scan carry is device-varying over every mesh axis q/k/v vary over
+    # (shard_map tracks this in the type system); derive the initializers
+    # from q so they inherit its varying axes, and add the ring axis
+    # explicitly (the masks depend on axis_index).
+    vma = getattr(jax.typeof(q), "vma", frozenset())
+    if axis_name in vma:
+        qv = q
+    else:
+        qv = jax.lax.pcast(q, (axis_name,), to="varying")
+    qz = qv.astype(jnp.float32) * 0.0
+    zrow = qz[..., 0].transpose(0, 2, 1)  # (B, H, S_loc) of zeros
+    acc = (qz, zrow + NEG_INF, zrow)
+    # Step 0 processes the diagonal block (owner == me), which always
+    # contains valid keys for causal masking — see attention_block_update.
+    (o, m, l, _, _), _ = jax.lax.scan(step, (*acc, k, v), jnp.arange(ring))
+    return _finalize((o, m, l), q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = "model",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Apply ring attention to globally-shaped ``(B, S, H, D)`` arrays.
+
+    Sequence is sharded over ``seq_axis`` (the ring); batch over
+    ``batch_axis`` and heads over ``head_axis`` when those axes exist —
+    heads are embarrassingly parallel in attention, so tensor parallelism
+    composes with the ring at zero extra communication.
+    """
+    axes = set(mesh.axis_names)
+    if seq_axis not in axes:
+        raise ValueError(f"mesh {mesh.axis_names} lacks seq axis {seq_axis!r}")
+    b = batch_axis if batch_axis in axes else None
+    h = head_axis if head_axis in axes else None
+    spec = P(b, seq_axis, h, None)
+    fn = partial(
+        ring_self_attention, axis_name=seq_axis, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
