@@ -86,6 +86,10 @@ def main() -> None:
     if last_snapshot is None or last_snapshot[0] != f"{work_dir}/step_{args.steps}":
         # Final step didn't land on the cadence — snapshot it synchronously
         # so the restart below always resumes from step == args.steps.
+        # Drain the superseded async snapshot first: dropping its handle
+        # would orphan in-flight I/O and swallow its errors.
+        if last_snapshot is not None and last_snapshot[1] is not None:
+            last_snapshot[1].wait()
         path = f"{work_dir}/step_{args.steps}"
         Snapshot.take(path, app_state)
         last_snapshot = (path, None)

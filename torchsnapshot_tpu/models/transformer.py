@@ -174,9 +174,12 @@ def forward(
         if c.attn_impl == "blockwise":
             from ..ops.attention import blockwise_attention
 
-            return blockwise_attention(
-                q, k, v, block_size=min(c.attn_block_size, S), causal=True
-            )
+            # Largest divisor of S within the configured block size —
+            # blockwise_attention requires S % block_size == 0.
+            bs = min(c.attn_block_size, S)
+            while S % bs:
+                bs -= 1
+            return blockwise_attention(q, k, v, block_size=bs, causal=True)
         from ..ops.attention import dense_attention
 
         return dense_attention(q, k, v, causal=True)

@@ -2,9 +2,24 @@
 
 Built on google-cloud-storage's sync client driven through the event loop's
 executor (the TPU-VM-typical setup: writes stream from host RAM to GCS over
-the VM's NIC while the next step runs on device). Transient errors are
-classified and retried with exponential backoff + jitter; ranged reads use
-blob.download_as_bytes(start, end).
+the VM's NIC while the next step runs on device).
+
+Capabilities mirroring the reference, realized independently:
+
+- **Chunked transfers** (reference: 100 MB chunks, gcs.py:41): downloads are
+  split into ranged chunk GETs; uploads delegate to the SDK's resumable
+  protocol via ``blob.chunk_size``.
+- **Upload-recovery rewind** (reference: gcs.py:109-122): the streamed
+  buffer is seekable (MemoryviewStream), and a retried upload rewinds it to
+  zero before resending.
+- **Transient-error classification** (reference: gcs.py:87-107): 429/5xx,
+  connection and timeout failures retry; everything else propagates.
+- **Collective retry strategy** (reference: _RetryStrategy, gcs.py:214-270):
+  all concurrent transfer coroutines share one deadline that is *refreshed
+  by anyone's progress* — a slow-but-advancing fleet never times out, a
+  globally-stalled fleet fails together, and per-attempt waits use
+  exponential backoff with jitter. The strategy is transport-agnostic and
+  single-event-loop only (the reference documents the same constraint).
 """
 
 from __future__ import annotations
@@ -12,14 +27,17 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 logger = logging.getLogger(__name__)
 
-_MAX_ATTEMPTS = 5
+DEFAULT_CHUNK_SIZE_BYTES = 100 * 1024 * 1024
 _BASE_BACKOFF_S = 0.5
+_MAX_BACKOFF_S = 8.0
+_STALL_TIMEOUT_S = 120.0
 
 
 def _is_transient(exc: BaseException) -> bool:
@@ -50,63 +68,168 @@ def _is_transient(exc: BaseException) -> bool:
     return isinstance(exc, (ConnectionError, TimeoutError))
 
 
+class CollectiveRetryStrategy:
+    """Shared-deadline retry for a fleet of concurrent transfer coroutines.
+
+    One instance is shared by every transfer of a snapshot. Any coroutine
+    completing a unit of work calls :meth:`report_progress`, pushing the
+    shared deadline out by ``stall_timeout_s``. A coroutine hitting a
+    transient error calls :meth:`backoff_or_raise`: if the fleet as a whole
+    has made progress recently it sleeps (exponential backoff + jitter) and
+    the caller retries; if nothing anywhere has progressed past the shared
+    deadline, the error is re-raised — the service is down, fail fast
+    together rather than each coroutine burning its own full retry budget
+    serially.
+
+    Not thread-safe by design: all coroutines run on one event loop
+    (the scheduler's), so no locking is needed.
+    """
+
+    def __init__(
+        self,
+        stall_timeout_s: float = _STALL_TIMEOUT_S,
+        base_backoff_s: float = _BASE_BACKOFF_S,
+        max_backoff_s: float = _MAX_BACKOFF_S,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Optional[Callable[[float], Any]] = None,
+    ) -> None:
+        self._stall_timeout_s = stall_timeout_s
+        self._base_backoff_s = base_backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._clock = clock
+        self._sleep = sleep or asyncio.sleep
+        # Armed lazily on first use: arming at construction would count
+        # pre-transfer time (staging, the gap between snapshots) against
+        # the stall budget and fail the first transient error with zero
+        # retries.
+        self._deadline: Optional[float] = None
+
+    def report_progress(self) -> None:
+        self._deadline = self._clock() + self._stall_timeout_s
+
+    def backoff_s(self, attempt: int) -> float:
+        # Cap the exponent before exponentiating: 2**attempt overflows
+        # float conversion near attempt ~1076 in a long-lived retry loop.
+        raw = self._base_backoff_s * (2 ** min(attempt, 16)) * (1.0 + random.random())
+        return min(raw, self._max_backoff_s)
+
+    async def backoff_or_raise(self, exc: BaseException, attempt: int) -> None:
+        if self._deadline is None:
+            self._deadline = self._clock() + self._stall_timeout_s
+        elif self._clock() > self._deadline:
+            logger.error(
+                "No transfer progressed for %.0fs; giving up: %s",
+                self._stall_timeout_s,
+                exc,
+            )
+            raise exc
+        backoff = self.backoff_s(attempt)
+        logger.warning("Transient storage error (%s); retrying in %.1fs", exc, backoff)
+        await self._sleep(backoff)
+
+
 class GCSStoragePlugin(StoragePlugin):
     def __init__(self, root: str, storage_options: Optional[Dict[str, Any]] = None):
+        options = storage_options or {}
+        bucket_name, _, self.prefix = root.partition("/")
+        self.chunk_size_bytes = int(
+            options.get("chunk_size_bytes", DEFAULT_CHUNK_SIZE_BYTES)
+        )
+        self.retry_strategy: CollectiveRetryStrategy = options.get(
+            "retry_strategy"
+        ) or CollectiveRetryStrategy()
+        self.bucket = options.get("bucket") or self._make_bucket(
+            bucket_name, options
+        )
+
+    @staticmethod
+    def _make_bucket(bucket_name: str, options: Dict[str, Any]):
         try:
             from google.cloud import storage as gcs
         except ImportError as e:  # pragma: no cover
             raise RuntimeError(
                 "GCS support requires the google-cloud-storage package."
             ) from e
-        bucket_name, _, self.prefix = root.partition("/")
-        options = storage_options or {}
         client = gcs.Client(**options.get("client_options", {}))
-        self.bucket = client.bucket(bucket_name)
+        return client.bucket(bucket_name)
 
     def _blob_path(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
-    async def _with_retries(self, fn, *args):
+    async def _retrying(self, fn: Callable[[], Any]) -> Any:
+        """Run blocking ``fn`` in the loop executor under the collective
+        retry strategy; successful completion reports fleet progress."""
         loop = asyncio.get_running_loop()
-        for attempt in range(_MAX_ATTEMPTS):
+        attempt = 0
+        while True:
             try:
-                return await loop.run_in_executor(None, fn, *args)
+                result = await loop.run_in_executor(None, fn)
+                self.retry_strategy.report_progress()
+                return result
             except BaseException as e:  # noqa: B036
-                if attempt + 1 >= _MAX_ATTEMPTS or not _is_transient(e):
+                if not _is_transient(e):
                     raise
-                backoff = _BASE_BACKOFF_S * (2**attempt) * (1 + random.random())
-                logger.warning(
-                    "Transient GCS error (%s); retrying in %.1fs", e, backoff
-                )
-                await asyncio.sleep(backoff)
+                await self.retry_strategy.backoff_or_raise(e, attempt)
+                attempt += 1
 
     async def write(self, write_io: WriteIO) -> None:
+        from ..memoryview_stream import MemoryviewStream
+
         blob = self.bucket.blob(self._blob_path(write_io.path))
-        buf = write_io.buf
+        mv = memoryview(write_io.buf)
+        if mv.nbytes > self.chunk_size_bytes:
+            # The SDK switches to the resumable protocol when chunk_size is
+            # set, uploading chunk_size pieces with its own per-chunk
+            # recovery — the chunked-upload path.
+            blob.chunk_size = self.chunk_size_bytes
+        stream = MemoryviewStream(mv)
 
         def upload() -> None:
-            from ..memoryview_stream import MemoryviewStream
+            # Rewind before every attempt: a failed attempt may have
+            # consumed part of the stream (upload-recovery rewind).
+            stream.seek(0)
+            blob.upload_from_file(stream, size=mv.nbytes)
 
-            # stream without copying — bytearray slabs included
-            mv = memoryview(buf)
-            blob.upload_from_file(MemoryviewStream(mv), size=mv.nbytes)
-
-        await self._with_retries(upload)
+        await self._retrying(upload)
 
     async def read(self, read_io: ReadIO) -> None:
         blob = self.bucket.blob(self._blob_path(read_io.path))
 
-        def download() -> bytes:
-            if read_io.byte_range is None:
-                return blob.download_as_bytes()
+        if read_io.byte_range is not None:
             lo, hi = read_io.byte_range
-            return blob.download_as_bytes(start=lo, end=hi - 1)  # inclusive end
+        else:
+            lo, hi = 0, None
 
-        read_io.buf = bytearray(await self._with_retries(download))
+        if hi is None:
+            # Unknown size: fetch metadata first so we can chunk the body.
+            size = await self._retrying(lambda: (blob.reload(), blob.size)[1])
+            hi = size
+
+        out = bytearray(hi - lo)
+        pos = lo
+        while pos < hi:
+            chunk_hi = min(pos + self.chunk_size_bytes, hi)
+
+            def download(p: int = pos, q: int = chunk_hi) -> bytes:
+                # GCS byte ranges are end-inclusive.
+                return blob.download_as_bytes(start=p, end=q - 1)
+
+            chunk = await self._retrying(download)
+            if len(chunk) != chunk_hi - pos:
+                # A short ranged response means the object changed or was
+                # truncated mid-read; silently zero-filling the gap would
+                # corrupt restored data.
+                raise IOError(
+                    f"short read on {read_io.path}: got {len(chunk)} bytes "
+                    f"for range [{pos}, {chunk_hi})"
+                )
+            out[pos - lo : pos - lo + len(chunk)] = chunk
+            pos = chunk_hi
+        read_io.buf = out
 
     async def delete(self, path: str) -> None:
         blob = self.bucket.blob(self._blob_path(path))
-        await self._with_retries(blob.delete)
+        await self._retrying(blob.delete)
 
     async def close(self) -> None:
         pass
