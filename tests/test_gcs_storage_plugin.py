@@ -96,18 +96,16 @@ def test_write_read_roundtrip_small() -> None:
     assert bytes(read_io.buf) == payload
 
 
-def test_chunked_download_assembles_and_ranges() -> None:
+def test_full_read_is_single_get() -> None:
+    """No-range reads go out as one streamed GET — no metadata round-trip."""
     bucket = FakeBucket()
     plugin = make_plugin(bucket, chunk_size_bytes=1000)
-    payload = bytes(range(256)) * 20  # 5120 bytes -> 6 chunks
+    payload = bytes(range(256)) * 20  # 5120 bytes
     run(plugin.write(WriteIO(path="big", buf=memoryview(payload))))
     read_io = ReadIO(path="big")
     run(plugin.read(read_io))
     assert bytes(read_io.buf) == payload
-    blob = bucket.blob("prefix/big")
-    assert len(blob.download_calls) == 6
-    # Every chunk request is end-inclusive and <= chunk size.
-    assert all(e - s + 1 <= 1000 for s, e in blob.download_calls)
+    assert len(bucket.blob("prefix/big").download_calls) == 1
 
 
 def test_ranged_read_chunked() -> None:
@@ -118,6 +116,41 @@ def test_ranged_read_chunked() -> None:
     read_io = ReadIO(path="r", byte_range=(100, 2100))
     run(plugin.read(read_io))
     assert bytes(read_io.buf) == payload[100:2100]
+    blob = bucket.blob("prefix/r")
+    # 2000 bytes in 512-byte chunks -> 4 end-inclusive ranged GETs.
+    assert len(blob.download_calls) == 4
+    assert all(e - s + 1 <= 512 for s, e in blob.download_calls)
+
+
+def test_long_inflight_op_still_gets_a_retry() -> None:
+    """An attempt that STARTED before the shared deadline lapsed retries
+    even if it ran past the deadline — in-flight time is not a stall."""
+    now = [0.0]
+    slept = []
+
+    async def fake_sleep(s):
+        slept.append(s)
+        now[0] += s
+
+    strat = CollectiveRetryStrategy(
+        stall_timeout_s=10.0, base_backoff_s=0.5, clock=lambda: now[0],
+        sleep=fake_sleep,
+    )
+
+    async def scenario():
+        strat.report_progress()  # deadline = 10
+        started = now[0]  # op starts immediately
+        now[0] = 300.0  # ...but runs for 300s before failing
+        await strat.backoff_or_raise(ConnectionError("late"), 0, op_started_at=started)
+        # Second attempt starts after the lapsed deadline and fails -> raise.
+        started2 = now[0]
+        with pytest.raises(ConnectionError):
+            await strat.backoff_or_raise(
+                ConnectionError("still down"), 1, op_started_at=started2
+            )
+
+    run(scenario())
+    assert len(slept) == 1
 
 
 def test_upload_rewinds_on_retry() -> None:

@@ -1,0 +1,194 @@
+"""End-to-end integrity: CRC32C checksums recorded on save, verified on load.
+
+Fault injection follows the reference's pattern (SURVEY.md §4.4) but at the
+storage level: corrupt bytes on disk after a committed save, then assert the
+restore fails loudly instead of returning corrupt tensors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu._native as native_mod
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu._native import _crc32c_py, crc32c, native_available, scatter_copy
+from torchsnapshot_tpu.integrity import IntegrityError, VERIFY_ENV_VAR
+from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+
+# ------------------------------------------------------------------ crc32c
+
+def test_crc32c_known_answer() -> None:
+    # RFC 3720 test vector.
+    assert crc32c(b"123456789") == 0xE3069283
+    assert _crc32c_py(b"123456789") == 0xE3069283
+
+
+def test_crc32c_chaining_and_empty() -> None:
+    a, b = b"hello ", b"world"
+    assert crc32c(b, crc32c(a)) == crc32c(a + b)
+    assert crc32c(b"") == 0
+
+
+def test_crc32c_native_matches_python() -> None:
+    data = np.random.default_rng(0).integers(0, 256, 65537, np.uint8).tobytes()
+    assert crc32c(data) == _crc32c_py(data)
+
+
+def test_crc32c_python_fallback_used_when_native_disabled(monkeypatch) -> None:
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_load_attempted", True)
+    assert not native_available()
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+# ------------------------------------------------------------- scatter copy
+
+def test_scatter_copy_matches_slicing() -> None:
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 256, 4096, np.uint8).tobytes()
+    regions = [(0, 100, 50), (60, 0, 60), (1000, 2000, 1024), (3000, 500, 7)]
+    dst_native = bytearray(4096)
+    scatter_copy(dst_native, src, regions)
+    dst_py = bytearray(4096)
+    for d, s, n in regions:
+        dst_py[d : d + n] = src[s : s + n]
+    assert dst_native == dst_py
+
+
+def test_scatter_copy_bounds_checked() -> None:
+    if not native_available():
+        pytest.skip("bounds check lives on the native path")
+    with pytest.raises(ValueError, match="out of bounds"):
+        scatter_copy(bytearray(10), b"x" * 10, [(0, 0, 5)] * 4 + [(8, 0, 5)])
+
+
+# ------------------------------------------------- snapshot-level integrity
+
+def _entry_checksums(snapshot: Snapshot):
+    out = {}
+    for path, entry in snapshot.get_manifest().items():
+        subs = [entry]
+        for part in list(getattr(entry, "chunks", [])) + list(
+            getattr(entry, "shards", [])
+        ):
+            subs.append(part.array)
+        for sub in subs:
+            checksum = getattr(sub, "checksum", None)
+            if checksum is not None:
+                out[f"{path}@{sub.location}" if sub is not entry else path] = checksum
+    return out
+
+
+def test_checksums_recorded_on_save(tmp_path) -> None:
+    state = StateDict(
+        arr=np.arange(1000, dtype=np.float32),
+        obj={"nested": [1, 2, 3]},
+    )
+    snap = Snapshot.take(str(tmp_path / "s"), {"app": state})
+    checksums = _entry_checksums(snap)
+    assert any("arr" in p for p in checksums)
+    assert all(c.startswith("crc32c:") for c in checksums.values())
+    # Checksums survive the YAML round trip.
+    meta = SnapshotMetadata.from_yaml(
+        (tmp_path / "s" / ".snapshot_metadata").read_text()
+    )
+    round_tripped = []
+    for e in meta.manifest.values():
+        for part in list(getattr(e, "chunks", [])) + list(getattr(e, "shards", [])):
+            if part.array.checksum:
+                round_tripped.append(part.array.checksum)
+        if getattr(e, "checksum", None):
+            round_tripped.append(e.checksum)
+    assert round_tripped
+
+
+def _corrupt_one_file(root, match: str) -> str:
+    """Flip a byte in the first payload file whose path contains ``match``."""
+    for f in sorted(root.rglob("*")):
+        if f.is_file() and match in str(f) and ".snapshot_metadata" not in f.name:
+            data = bytearray(f.read_bytes())
+            data[len(data) // 2] ^= 0xFF
+            f.write_bytes(bytes(data))
+            return str(f)
+    raise AssertionError(f"no payload file matching {match}")
+
+
+def test_corrupt_array_detected_on_restore(tmp_path) -> None:
+    state = StateDict(w=np.random.default_rng(0).standard_normal(500))
+    Snapshot.take(str(tmp_path / "s"), {"app": state})
+    _corrupt_one_file(tmp_path / "s", "w")
+    dst = StateDict(w=np.zeros(500))
+    with pytest.raises(IntegrityError, match="checksum mismatch"):
+        Snapshot(str(tmp_path / "s")).restore({"app": dst})
+
+
+def test_corrupt_object_detected_on_restore(tmp_path) -> None:
+    state = StateDict(blob=set(range(100)))  # sets pickle as ObjectEntry
+    Snapshot.take(str(tmp_path / "s"), {"app": state})
+    _corrupt_one_file(tmp_path / "s", "blob")
+    dst = StateDict(blob=None)
+    with pytest.raises(IntegrityError, match="checksum mismatch"):
+        Snapshot(str(tmp_path / "s")).restore({"app": dst})
+
+
+def test_truncation_detected_on_restore(tmp_path) -> None:
+    state = StateDict(w=np.arange(4096, dtype=np.float64))
+    Snapshot.take(str(tmp_path / "s"), {"app": state})
+    for f in sorted((tmp_path / "s").rglob("*")):
+        if f.is_file() and "w" in str(f) and ".snapshot_metadata" not in f.name:
+            f.write_bytes(f.read_bytes()[:-512])
+            break
+    dst = StateDict(w=np.zeros(4096))
+    with pytest.raises(Exception):  # IntegrityError (or size mismatch)
+        Snapshot(str(tmp_path / "s")).restore({"app": dst})
+
+
+def test_verification_can_be_disabled(tmp_path, monkeypatch) -> None:
+    state = StateDict(w=np.arange(256, dtype=np.float32))
+    Snapshot.take(str(tmp_path / "s"), {"app": state})
+    _corrupt_one_file(tmp_path / "s", "w")
+    monkeypatch.setenv(VERIFY_ENV_VAR, "0")
+    dst = StateDict(w=np.zeros(256, dtype=np.float32))
+    Snapshot(str(tmp_path / "s")).restore({"app": dst})  # no raise
+    assert not np.array_equal(dst["w"], state["w"])  # silently corrupt
+
+
+def test_checksum_recording_can_be_disabled(tmp_path, monkeypatch) -> None:
+    from torchsnapshot_tpu.integrity import CHECKSUM_ENV_VAR
+
+    monkeypatch.setenv(CHECKSUM_ENV_VAR, "0")
+    state = StateDict(w=np.arange(256, dtype=np.float32))
+    snap = Snapshot.take(str(tmp_path / "s"), {"app": state})
+    assert not _entry_checksums(snap)
+    # Restores of checksum-less snapshots still work (backward compat).
+    dst = StateDict(w=np.zeros(256, dtype=np.float32))
+    snap.restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state["w"])
+
+
+def test_sharded_array_checksums(tmp_path) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("x",))
+    arr = jax.device_put(
+        jnp.arange(64 * 8, dtype=jnp.float32).reshape(64, 8),
+        NamedSharding(mesh, P("x", None)),
+    )
+    Snapshot.take(str(tmp_path / "s"), {"app": StateDict(arr=arr)})
+    # every shard sub-entry carries a checksum
+    snap = Snapshot(str(tmp_path / "s"))
+    sharded = [
+        e for e in snap.get_manifest().values()
+        if getattr(e, "shards", None)
+    ]
+    assert sharded
+    assert all(s.array.checksum for e in sharded for s in e.shards)
+    # corrupt one shard file -> restore fails
+    _corrupt_one_file(tmp_path / "s", "arr")
+    dst = jax.device_put(jnp.zeros((64, 8)), NamedSharding(mesh, P("x", None)))
+    with pytest.raises(IntegrityError):
+        snap.restore({"app": StateDict(arr=dst)})

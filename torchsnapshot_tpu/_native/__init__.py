@@ -1,0 +1,169 @@
+"""ctypes loader for the native runtime (see native.cpp).
+
+Compiles lazily with g++ on first use (no pybind11 — the binding surface is
+three C functions), caches the .so next to the source, and degrades to pure
+Python when no toolchain is available:
+
+- ``crc32c(data, crc=0)``   - native (SSE4.2 or slicing-by-8) or a Python
+                              table fallback; identical values either way.
+- ``scatter_copy(dst, src, regions)`` - batched memcpy, falling back to
+                              per-region memoryview slicing.
+- ``native_available()``    - True when the compiled extension is loaded.
+
+Kill switch: ``TORCHSNAPSHOT_TPU_DISABLE_NATIVE=1`` forces the fallbacks
+(used by tests to cover both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+DISABLE_NATIVE_ENV_VAR = "TORCHSNAPSHOT_TPU_DISABLE_NATIVE"
+
+_SRC = os.path.join(os.path.dirname(__file__), "native.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_ts_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-msse4.2",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info("native extension build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get(DISABLE_NATIVE_ENV_VAR, "0") not in ("0", "", "false"):
+        return None
+    fresh = os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    if not fresh and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:  # pragma: no cover
+        logger.info("native extension load failed (%s); using Python fallbacks", e)
+        return None
+    lib.ts_crc32c.restype = ctypes.c_uint32
+    lib.ts_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+    lib.ts_has_hw_crc.restype = ctypes.c_int
+    lib.ts_scatter_copy.restype = None
+    lib.ts_scatter_copy.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------------ crc32c
+
+_PY_TABLE: Optional[List[int]] = None
+
+
+def _py_table() -> List[int]:
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+            table.append(crc)
+        _PY_TABLE = table
+    return _PY_TABLE
+
+
+def _crc32c_py(data, crc: int = 0) -> int:
+    table = _py_table()
+    crc = ~crc & 0xFFFFFFFF
+    for b in bytes(data):
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return ~crc & 0xFFFFFFFF
+
+
+def _as_flat_u8(data):
+    """(numpy u8 view, address) of a contiguous buffer — no copy. numpy is
+    the portable way to take the address of a possibly-readonly buffer."""
+    import numpy as np
+
+    mv = memoryview(data)
+    if not mv.contiguous:
+        mv = memoryview(bytes(mv))
+    arr = np.frombuffer(mv, dtype=np.uint8)
+    return arr, arr.ctypes.data
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data`` (any buffer-protocol object).
+
+    Chainable: ``crc32c(b, crc32c(a)) == crc32c(a + b)``.
+    """
+    lib = _load()
+    if lib is None:
+        return _crc32c_py(memoryview(data).cast("B"), crc)
+    arr, addr = _as_flat_u8(data)
+    if arr.nbytes == 0:
+        return crc
+    return lib.ts_crc32c(
+        ctypes.cast(addr, ctypes.c_char_p), arr.nbytes, ctypes.c_uint32(crc)
+    )
+
+
+# ------------------------------------------------------------- scatter copy
+
+Region = Tuple[int, int, int]  # (dst_off, src_off, nbytes)
+
+
+def scatter_copy(dst, src, regions: Sequence[Region]) -> None:
+    """Batched ``dst[d:d+n] = src[s:s+n]`` for every region in one call."""
+    if not regions:
+        return
+    lib = _load()
+    if lib is None or len(regions) < 4:
+        dst_mv = memoryview(dst).cast("B")
+        src_mv = memoryview(src).cast("B")
+        for d, s, n in regions:
+            dst_mv[d : d + n] = src_mv[s : s + n]
+        return
+    n = len(regions)
+    dst_arr, dst_addr = _as_flat_u8(dst)
+    src_arr, src_addr = _as_flat_u8(src)
+    if dst_arr.flags["WRITEABLE"] is False:
+        raise ValueError("scatter_copy destination buffer is read-only")
+    dst_off = (ctypes.c_uint64 * n)(*(r[0] for r in regions))
+    src_off = (ctypes.c_uint64 * n)(*(r[1] for r in regions))
+    sizes = (ctypes.c_uint64 * n)(*(r[2] for r in regions))
+    for d, s, sz in regions:
+        if d + sz > dst_arr.nbytes or s + sz > src_arr.nbytes:
+            raise ValueError(
+                f"scatter_copy region out of bounds: dst[{d}:{d+sz}) "
+                f"src[{s}:{s+sz}) for dst={dst_arr.nbytes}B src={src_arr.nbytes}B"
+            )
+    lib.ts_scatter_copy(
+        ctypes.c_void_p(dst_addr), ctypes.c_void_p(src_addr),
+        dst_off, src_off, sizes, n,
+    )

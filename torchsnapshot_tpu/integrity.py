@@ -1,0 +1,67 @@
+"""End-to-end snapshot integrity: per-entry CRC32C checksums.
+
+A capability the reference does not have (its only corruption defense is the
+metadata-last commit protocol, snapshot.py:230-237 — torn writes are
+invisible, but bit rot and truncation inside a committed snapshot are not
+detected). Every serialized buffer gets a CRC32C recorded in its manifest
+entry at stage time and verified at consume time on restore; cost is
+negligible with the native SSE4.2 path (GB/s-scale, see _native).
+
+Checksums are written by default and verified by default when present.
+Partial (byte-range sub-chunk) reads of an entry can't be verified — only
+complete-payload reads are checked (the common restore path).
+
+Env:
+  TORCHSNAPSHOT_TPU_CHECKSUM=0  - don't record checksums on save
+  TORCHSNAPSHOT_TPU_VERIFY=0    - don't verify checksums on restore
+"""
+
+from __future__ import annotations
+
+import os
+
+from ._native import crc32c
+
+CHECKSUM_ENV_VAR = "TORCHSNAPSHOT_TPU_CHECKSUM"
+VERIFY_ENV_VAR = "TORCHSNAPSHOT_TPU_VERIFY"
+
+_ALGO = "crc32c"
+
+
+class IntegrityError(RuntimeError):
+    """A restored buffer's checksum did not match the manifest."""
+
+
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "1") not in ("0", "false", "")
+
+
+def checksums_enabled() -> bool:
+    return _env_on(CHECKSUM_ENV_VAR)
+
+
+def verification_enabled() -> bool:
+    return _env_on(VERIFY_ENV_VAR)
+
+
+def compute_checksum(buf) -> str:
+    return f"{_ALGO}:{crc32c(buf):08x}"
+
+
+def verify_checksum(buf, expected: str, path: str) -> None:
+    """Raise IntegrityError if ``buf`` doesn't hash to ``expected``.
+
+    Unknown algorithms are skipped (forward compatibility: a newer writer
+    may record an algorithm this build doesn't know).
+    """
+    algo, _, digest = expected.partition(":")
+    if algo != _ALGO:
+        return
+    actual = f"{crc32c(buf):08x}"
+    if actual != digest:
+        raise IntegrityError(
+            f"checksum mismatch reading {path!r}: manifest records "
+            f"{_ALGO}:{digest}, buffer hashes to {_ALGO}:{actual} — the "
+            f"snapshot data is corrupt (truncated, bit-rotted, or "
+            f"overwritten since save)."
+        )

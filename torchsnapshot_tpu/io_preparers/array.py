@@ -62,8 +62,12 @@ class ArrayBufferStager(BufferStager):
     (and for numpy inputs) an explicit copy is made.
     """
 
-    def __init__(self, arr) -> None:
+    def __init__(self, arr, entry: Optional[ArrayEntry] = None) -> None:
         self.arr = arr
+        # When given, the entry's checksum is recorded at stage time (the
+        # manifest is gathered/committed after staging completes, so the
+        # mutation is visible in the persisted metadata).
+        self.entry = entry
 
     @staticmethod
     def _stage_sync(arr) -> np.ndarray:
@@ -88,7 +92,13 @@ class ArrayBufferStager(BufferStager):
                 pass
         loop = asyncio.get_running_loop()
         host = await loop.run_in_executor(executor, self._stage_sync, arr)
-        return array_as_memoryview(host)
+        buf = array_as_memoryview(host)
+        if self.entry is not None:
+            from ..integrity import checksums_enabled, compute_checksum
+
+            if checksums_enabled():
+                self.entry.checksum = compute_checksum(buf)
+        return buf
 
     def get_staging_cost_bytes(self) -> int:
         return array_nbytes(self.arr)
@@ -109,6 +119,14 @@ class ArrayBufferConsumer(BufferConsumer):
         self.callback = callback
 
     def _consume_sync(self, buf: BufferType) -> None:
+        if self.entry.checksum is not None:
+            from ..integrity import verification_enabled, verify_checksum
+
+            # This consumer always receives the entry's complete payload
+            # (whole file, or the entry's byte_range within a batched slab),
+            # so the recorded checksum applies directly.
+            if verification_enabled():
+                verify_checksum(buf, self.entry.checksum, self.entry.location)
         arr = array_from_buffer(buf, self.entry.dtype, self.entry.shape)
         if self.dst_view is not None:
             np.copyto(self.dst_view, arr, casting="same_kind")
@@ -141,7 +159,7 @@ class ArrayIOPreparer:
             replicated=replicated,
         )
         return entry, [
-            WriteReq(path=storage_path, buffer_stager=ArrayBufferStager(arr))
+            WriteReq(path=storage_path, buffer_stager=ArrayBufferStager(arr, entry))
         ]
 
     @staticmethod

@@ -17,15 +17,23 @@ from ..serialization import Serializer, object_as_bytes, object_from_bytes
 
 
 class ObjectBufferStager(BufferStager):
-    def __init__(self, obj: Any) -> None:
+    def __init__(self, obj: Any, entry: Optional[ObjectEntry] = None) -> None:
         self.obj = obj
+        self.entry = entry  # checksum recorded at stage time when given
         self._size_estimate: Optional[int] = None
 
     async def stage_buffer(self, executor=None) -> BufferType:
         if executor is not None:
             loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(executor, object_as_bytes, self.obj)
-        return object_as_bytes(self.obj)
+            buf = await loop.run_in_executor(executor, object_as_bytes, self.obj)
+        else:
+            buf = object_as_bytes(self.obj)
+        if self.entry is not None:
+            from ..integrity import checksums_enabled, compute_checksum
+
+            if checksums_enabled():
+                self.entry.checksum = compute_checksum(buf)
+        return buf
 
     def get_staging_cost_bytes(self) -> int:
         if self._size_estimate is None:
@@ -47,6 +55,11 @@ class ObjectBufferConsumer(BufferConsumer):
         self._callback = callback
 
     async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+        if self.entry.checksum is not None:
+            from ..integrity import verification_enabled, verify_checksum
+
+            if verification_enabled():
+                verify_checksum(buf, self.entry.checksum, self.entry.location)
         if executor is not None:
             loop = asyncio.get_running_loop()
             obj = await loop.run_in_executor(executor, object_from_bytes, buf)
@@ -71,7 +84,7 @@ class ObjectIOPreparer:
             replicated=replicated,
         )
         return entry, [
-            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj))
+            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj, entry))
         ]
 
     @staticmethod

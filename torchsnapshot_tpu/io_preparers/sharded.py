@@ -132,6 +132,14 @@ class _ShardScatterConsumer(BufferConsumer):
         self.completion = completion
 
     def _consume_sync(self, buf: BufferType) -> None:
+        if self.shard.array.checksum is not None:
+            from ..integrity import verification_enabled, verify_checksum
+
+            # Each saved shard is read exactly once, in full.
+            if verification_enabled():
+                verify_checksum(
+                    buf, self.shard.array.checksum, self.shard.array.location
+                )
         arr = array_from_buffer(
             buf, self.shard.array.dtype, self.shard.array.shape
         )
@@ -224,7 +232,9 @@ class ShardedArrayIOPreparer:
                 )
                 shards.append(Shard(offsets=list(p_off), sizes=list(p_sz), array=entry))
                 write_reqs.append(
-                    WriteReq(path=location, buffer_stager=ArrayBufferStager(piece))
+                    WriteReq(
+                        path=location, buffer_stager=ArrayBufferStager(piece, entry)
+                    )
                 )
         return (
             ShardedArrayEntry(dtype=dtype_str, shape=list(shape), shards=shards),

@@ -51,6 +51,7 @@ class ArrayEntry(Entry):
     shape: List[int]
     replicated: bool
     byte_range: Optional[List[int]] = None  # [lo, hi) within location
+    checksum: Optional[str] = None  # "<algo>:<hexdigest>" of the payload
 
     def __init__(
         self,
@@ -60,6 +61,7 @@ class ArrayEntry(Entry):
         shape: List[int],
         replicated: bool,
         byte_range: Optional[List[int]] = None,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="array")
         self.location = location
@@ -68,6 +70,7 @@ class ArrayEntry(Entry):
         self.shape = list(shape)
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
+        self.checksum = checksum
 
 
 @dataclass
@@ -113,15 +116,22 @@ class ObjectEntry(Entry):
     serializer: str
     obj_type: str
     replicated: bool
+    checksum: Optional[str] = None  # "<algo>:<hexdigest>" of the payload
 
     def __init__(
-        self, location: str, serializer: str, obj_type: str, replicated: bool
+        self,
+        location: str,
+        serializer: str,
+        obj_type: str,
+        replicated: bool,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.obj_type = obj_type
         self.replicated = replicated
+        self.checksum = checksum
 
 
 _PRIMITIVE_TYPES = ("int", "float", "str", "bool", "bytes", "NoneType")

@@ -175,11 +175,15 @@ def forward(
             from ..ops.attention import blockwise_attention
 
             # Largest divisor of S within the configured block size —
-            # blockwise_attention requires S % block_size == 0.
+            # blockwise_attention requires S % block_size == 0. Awkward
+            # lengths (e.g. prime S) only have tiny divisors; below a
+            # quarter of the configured size the O(S^2) dense path is
+            # faster than S/bs tiny scan steps.
             bs = min(c.attn_block_size, S)
             while S % bs:
                 bs -= 1
-            return blockwise_attention(q, k, v, block_size=bs, causal=True)
+            if bs >= max(1, min(c.attn_block_size, S) // 4):
+                return blockwise_attention(q, k, v, block_size=bs, causal=True)
         from ..ops.attention import dense_attention
 
         return dense_attention(q, k, v, causal=True)

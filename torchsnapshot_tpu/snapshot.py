@@ -277,17 +277,22 @@ class Snapshot:
                     list(manifest.values()), write_reqs
                 )
 
-            global_manifest = cls._gather_manifest(manifest, pg_wrapper)
-            metadata = SnapshotMetadata(
-                version=__version__,
-                world_size=world_size,
-                manifest=global_manifest,
-            )
             memory_budget = get_process_memory_budget_bytes(
                 pg_wrapper if world_size > 1 else None
             )
             pending_io_work = event_loop.run_until_complete(
                 execute_write_reqs(write_reqs, storage, memory_budget, rank)
+            )
+            # Gather AFTER execute_write_reqs returns: staging (the
+            # consistency point) is complete by then, so stage-time entry
+            # mutations — notably integrity checksums — are present in the
+            # manifests the ranks exchange. Storage I/O continues in the
+            # background; only metadata rides the collective.
+            global_manifest = cls._gather_manifest(manifest, pg_wrapper)
+            metadata = SnapshotMetadata(
+                version=__version__,
+                world_size=world_size,
+                manifest=global_manifest,
             )
             return pending_io_work, metadata
         finally:
@@ -564,7 +569,35 @@ class Snapshot:
                     global_manifest[f"{rank}/{logical_path}"] = entry
                 else:
                     global_manifest[str(rank)] = entry
+        _propagate_checksums(global_manifest)
         return global_manifest
+
+
+def _propagate_checksums(global_manifest: Manifest) -> None:
+    """Replicated entries are recorded by every rank but staged (and thus
+    checksummed) only by the rank that writes each chunk; copy checksums to
+    the other ranks' copies of the same storage location so every reader
+    can verify."""
+    from .manifest import ArrayEntry, ChunkedArrayEntry, ObjectEntry, ShardedArrayEntry
+
+    def sub_entries(entry):
+        if isinstance(entry, (ArrayEntry, ObjectEntry)):
+            yield entry
+        elif isinstance(entry, (ChunkedArrayEntry, ShardedArrayEntry)):
+            parts = entry.chunks if isinstance(entry, ChunkedArrayEntry) else entry.shards
+            for part in parts:
+                yield part.array
+
+    known: Dict[str, str] = {}
+    blank = []
+    for entry in global_manifest.values():
+        for sub in sub_entries(entry):
+            if sub.checksum is not None:
+                known[sub.location] = sub.checksum
+            else:
+                blank.append(sub)
+    for sub in blank:
+        sub.checksum = known.get(sub.location)
 
 
 def _is_process_replicated_jax_array(obj: Any) -> bool:

@@ -113,10 +113,20 @@ class CollectiveRetryStrategy:
         raw = self._base_backoff_s * (2 ** min(attempt, 16)) * (1.0 + random.random())
         return min(raw, self._max_backoff_s)
 
-    async def backoff_or_raise(self, exc: BaseException, attempt: int) -> None:
+    async def backoff_or_raise(
+        self,
+        exc: BaseException,
+        attempt: int,
+        op_started_at: Optional[float] = None,
+    ) -> None:
+        """``op_started_at``: when this attempt began. An attempt that
+        *started* before the deadline lapsed gets one more retry even if it
+        ran long — time spent inside an active transfer is not a stall."""
         if self._deadline is None:
             self._deadline = self._clock() + self._stall_timeout_s
-        elif self._clock() > self._deadline:
+        elif self._clock() > self._deadline and (
+            op_started_at is None or op_started_at > self._deadline
+        ):
             logger.error(
                 "No transfer progressed for %.0fs; giving up: %s",
                 self._stall_timeout_s,
@@ -162,6 +172,7 @@ class GCSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
         attempt = 0
         while True:
+            started = time.monotonic()
             try:
                 result = await loop.run_in_executor(None, fn)
                 self.retry_strategy.report_progress()
@@ -169,7 +180,9 @@ class GCSStoragePlugin(StoragePlugin):
             except BaseException as e:  # noqa: B036
                 if not _is_transient(e):
                     raise
-                await self.retry_strategy.backoff_or_raise(e, attempt)
+                await self.retry_strategy.backoff_or_raise(
+                    e, attempt, op_started_at=started
+                )
                 attempt += 1
 
     async def write(self, write_io: WriteIO) -> None:
@@ -195,16 +208,16 @@ class GCSStoragePlugin(StoragePlugin):
     async def read(self, read_io: ReadIO) -> None:
         blob = self.bucket.blob(self._blob_path(read_io.path))
 
-        if read_io.byte_range is not None:
-            lo, hi = read_io.byte_range
-        else:
-            lo, hi = 0, None
+        if read_io.byte_range is None:
+            # Unknown size: a single GET (the SDK streams the body) — no
+            # metadata round-trip, and cross-entry concurrency already
+            # keeps the pipe full on the common many-small-files restore.
+            read_io.buf = bytearray(
+                await self._retrying(blob.download_as_bytes)
+            )
+            return
 
-        if hi is None:
-            # Unknown size: fetch metadata first so we can chunk the body.
-            size = await self._retrying(lambda: (blob.reload(), blob.size)[1])
-            hi = size
-
+        lo, hi = read_io.byte_range
         out = bytearray(hi - lo)
         pos = lo
         while pos < hi:
