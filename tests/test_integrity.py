@@ -57,6 +57,27 @@ def test_scatter_copy_matches_slicing() -> None:
     assert dst_native == dst_py
 
 
+def test_gather_copy_packs_sources() -> None:
+    from torchsnapshot_tpu._native import gather_copy
+
+    rng = np.random.default_rng(2)
+    srcs = [rng.integers(0, 256, n, np.uint8).tobytes() for n in (100, 7, 512, 64, 1)]
+    offsets = [0, 100, 120, 700, 800]
+    dst = bytearray(1024)
+    gather_copy(dst, list(zip(offsets, srcs)))
+    for off, src in zip(offsets, srcs):
+        assert bytes(dst[off : off + len(src)]) == src
+
+
+def test_gather_copy_bounds_checked() -> None:
+    from torchsnapshot_tpu._native import gather_copy
+
+    if not native_available():
+        pytest.skip("bounds check lives on the native path")
+    with pytest.raises(ValueError, match="out of bounds"):
+        gather_copy(bytearray(10), [(0, b"123")] * 4 + [(8, b"12345")])
+
+
 def test_scatter_copy_bounds_checked() -> None:
     if not native_available():
         pytest.skip("bounds check lives on the native path")

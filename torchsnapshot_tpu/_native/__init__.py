@@ -20,7 +20,7 @@ import ctypes
 import logging
 import os
 import subprocess
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -34,15 +34,24 @@ _load_attempted = False
 
 
 def _build() -> bool:
+    # Compile to a process-unique temp path and publish atomically with
+    # os.replace: concurrent first-use across ranks/test workers must never
+    # let a CDLL() observe a half-written .so.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-msse4.2",
-        _SRC, "-o", _SO,
+        _SRC, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         logger.info("native extension build failed (%s); using Python fallbacks", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -69,6 +78,12 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+    ]
+    lib.ts_gather_copy.restype = None
+    lib.ts_gather_copy.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_size_t,
     ]
     _lib = lib
     return _lib
@@ -105,13 +120,19 @@ def _crc32c_py(data, crc: int = 0) -> int:
     return ~crc & 0xFFFFFFFF
 
 
-def _as_flat_u8(data):
+def _as_flat_u8(data, writable_target: bool = False):
     """(numpy u8 view, address) of a contiguous buffer — no copy. numpy is
-    the portable way to take the address of a possibly-readonly buffer."""
+    the portable way to take the address of a possibly-readonly buffer.
+
+    ``writable_target=True`` marks a buffer that will be WRITTEN through the
+    returned address; a non-contiguous input would be silently copied and
+    the writes lost, so it is rejected instead."""
     import numpy as np
 
     mv = memoryview(data)
     if not mv.contiguous:
+        if writable_target:
+            raise ValueError("destination buffer must be contiguous")
         mv = memoryview(bytes(mv))
     arr = np.frombuffer(mv, dtype=np.uint8)
     return arr, arr.ctypes.data
@@ -150,7 +171,7 @@ def scatter_copy(dst, src, regions: Sequence[Region]) -> None:
             dst_mv[d : d + n] = src_mv[s : s + n]
         return
     n = len(regions)
-    dst_arr, dst_addr = _as_flat_u8(dst)
+    dst_arr, dst_addr = _as_flat_u8(dst, writable_target=True)
     src_arr, src_addr = _as_flat_u8(src)
     if dst_arr.flags["WRITEABLE"] is False:
         raise ValueError("scatter_copy destination buffer is read-only")
@@ -167,3 +188,34 @@ def scatter_copy(dst, src, regions: Sequence[Region]) -> None:
         ctypes.c_void_p(dst_addr), ctypes.c_void_p(src_addr),
         dst_off, src_off, sizes, n,
     )
+
+
+def gather_copy(dst, sources: Sequence[Tuple[int, Any]]) -> None:
+    """Pack separate source buffers into ``dst``: for each (dst_off, src),
+    ``dst[dst_off : dst_off+len(src)] = src`` — one native call for the
+    write-batcher's slab packing."""
+    if not sources:
+        return
+    lib = _load()
+    if lib is None or len(sources) < 4:
+        dst_mv = memoryview(dst).cast("B")
+        for off, src in sources:
+            mv = memoryview(src).cast("B")
+            dst_mv[off : off + mv.nbytes] = mv
+        return
+    n = len(sources)
+    dst_arr, dst_addr = _as_flat_u8(dst, writable_target=True)
+    if dst_arr.flags["WRITEABLE"] is False:
+        raise ValueError("gather_copy destination buffer is read-only")
+    src_keepalive = [_as_flat_u8(src) for _, src in sources]
+    sizes_list = [arr.nbytes for arr, _ in src_keepalive]
+    for (off, _), sz in zip(sources, sizes_list):
+        if off + sz > dst_arr.nbytes:
+            raise ValueError(
+                f"gather_copy region out of bounds: dst[{off}:{off+sz}) "
+                f"for dst={dst_arr.nbytes}B"
+            )
+    src_ptrs = (ctypes.c_void_p * n)(*(addr for _, addr in src_keepalive))
+    dst_off = (ctypes.c_uint64 * n)(*(off for off, _ in sources))
+    sizes = (ctypes.c_uint64 * n)(*sizes_list)
+    lib.ts_gather_copy(ctypes.c_void_p(dst_addr), src_ptrs, dst_off, sizes, n)

@@ -10,8 +10,9 @@
 //                     CPU has it (~15 GB/s) with a slicing-by-8 software
 //                     fallback (~1-2 GB/s).
 //   ts_scatter_copy - one C call performing many (dst_off, src_off, size)
-//                     memcpys: slab packing and multi-region scatter during
-//                     resharded restores without per-region Python overhead.
+//                     memcpys within a single source buffer.
+//   ts_gather_copy  - one C call packing many separate source buffers into
+//                     one destination (write-batcher slab packing).
 //
 // Built with plain g++ (no pybind11 dependency); loaded via ctypes.
 
@@ -122,6 +123,15 @@ void ts_scatter_copy(uint8_t* dst, const uint8_t* src, const uint64_t* dst_off,
   for (size_t i = 0; i < n; ++i) {
     std::memcpy(dst + dst_off[i], src + src_off[i],
                 static_cast<size_t>(sizes[i]));
+  }
+}
+
+// Pack n separate source buffers into dst: dst[dst_off[i] : +sizes[i]] =
+// srcs[i][0 : sizes[i]]. Caller guarantees bounds and no overlap.
+void ts_gather_copy(uint8_t* dst, const uint8_t* const* srcs,
+                    const uint64_t* dst_off, const uint64_t* sizes, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(dst + dst_off[i], srcs[i], static_cast<size_t>(sizes[i]));
   }
 }
 

@@ -58,17 +58,16 @@ class BatchedBufferStager(BufferStager):
         self.total = total
 
     async def stage_buffer(self, executor=None) -> BufferType:
-        slab = bytearray(self.total)
-        view = memoryview(slab)
+        # Stage all sub-buffers concurrently, then pack the slab in one
+        # native call (gather_copy falls back to per-region slicing when
+        # the extension isn't built).
+        from ._native import gather_copy
 
-        async def fill(stager: BufferStager, lo: int) -> None:
-            buf = await stager.stage_buffer(executor)
-            mv = memoryview(buf).cast("B")
-            view[lo:lo + mv.nbytes] = mv
-
-        await asyncio.gather(
-            *(fill(s, lo) for s, lo in zip(self.stagers, self.offsets))
+        bufs = await asyncio.gather(
+            *(s.stage_buffer(executor) for s in self.stagers)
         )
+        slab = bytearray(self.total)
+        gather_copy(slab, list(zip(self.offsets, bufs)))
         return slab
 
     def get_staging_cost_bytes(self) -> int:

@@ -18,14 +18,16 @@ Env:
 
 from __future__ import annotations
 
+import logging
 import os
+import zlib
 
-from ._native import crc32c
+from ._native import crc32c, native_available
+
+logger = logging.getLogger(__name__)
 
 CHECKSUM_ENV_VAR = "TORCHSNAPSHOT_TPU_CHECKSUM"
 VERIFY_ENV_VAR = "TORCHSNAPSHOT_TPU_VERIFY"
-
-_ALGO = "crc32c"
 
 
 class IntegrityError(RuntimeError):
@@ -45,23 +47,50 @@ def verification_enabled() -> bool:
 
 
 def compute_checksum(buf) -> str:
-    return f"{_ALGO}:{crc32c(buf):08x}"
+    """Hash at C speed whatever the environment: CRC32C via the native
+    extension (SSE4.2, GB/s) when it built, else stdlib zlib CRC32 (still
+    ~GB/s) under its own algorithm tag — never the pure-Python CRC32C loop,
+    which would turn multi-GB saves into minutes of hashing."""
+    if native_available():
+        return f"crc32c:{crc32c(buf):08x}"
+    data = memoryview(buf).cast("B")
+    return f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
+_warned_slow_crc32c = False
 
 
 def verify_checksum(buf, expected: str, path: str) -> None:
     """Raise IntegrityError if ``buf`` doesn't hash to ``expected``.
 
     Unknown algorithms are skipped (forward compatibility: a newer writer
-    may record an algorithm this build doesn't know).
+    may record an algorithm this build doesn't know). A crc32c checksum on
+    a host where the native extension is unavailable is also skipped, with
+    a one-time warning — the pure-Python fallback would slow restores by
+    orders of magnitude.
     """
     algo, _, digest = expected.partition(":")
-    if algo != _ALGO:
+    if algo == "crc32c":
+        if not native_available():
+            global _warned_slow_crc32c
+            if not _warned_slow_crc32c:
+                _warned_slow_crc32c = True
+                logger.warning(
+                    "Snapshot records crc32c checksums but the native "
+                    "extension is unavailable on this host; skipping "
+                    "verification (pure-Python CRC32C is too slow for "
+                    "checkpoint-sized data)."
+                )
+            return
+        actual = f"{crc32c(buf):08x}"
+    elif algo == "crc32":
+        actual = f"{zlib.crc32(memoryview(buf).cast('B')) & 0xFFFFFFFF:08x}"
+    else:
         return
-    actual = f"{crc32c(buf):08x}"
     if actual != digest:
         raise IntegrityError(
             f"checksum mismatch reading {path!r}: manifest records "
-            f"{_ALGO}:{digest}, buffer hashes to {_ALGO}:{actual} — the "
+            f"{algo}:{digest}, buffer hashes to {algo}:{actual} — the "
             f"snapshot data is corrupt (truncated, bit-rotted, or "
             f"overwritten since save)."
         )

@@ -83,6 +83,18 @@ class ArrayBufferStager(BufferStager):
             return host
         return np.array(arr, copy=True)
 
+    def _stage_and_sum(self, arr) -> BufferType:
+        """Runs in an executor thread: DtoH + serialize + (optional) hash —
+        keeping GB-scale hashing off the event-loop thread."""
+        host = self._stage_sync(arr)
+        buf = array_as_memoryview(host)
+        if self.entry is not None:
+            from ..integrity import checksums_enabled, compute_checksum
+
+            if checksums_enabled():
+                self.entry.checksum = compute_checksum(buf)
+        return buf
+
     async def stage_buffer(self, executor=None) -> BufferType:
         arr = self.arr
         if _is_jax_array(arr):
@@ -91,14 +103,7 @@ class ArrayBufferStager(BufferStager):
             except Exception:
                 pass
         loop = asyncio.get_running_loop()
-        host = await loop.run_in_executor(executor, self._stage_sync, arr)
-        buf = array_as_memoryview(host)
-        if self.entry is not None:
-            from ..integrity import checksums_enabled, compute_checksum
-
-            if checksums_enabled():
-                self.entry.checksum = compute_checksum(buf)
-        return buf
+        return await loop.run_in_executor(executor, self._stage_and_sum, arr)
 
     def get_staging_cost_bytes(self) -> int:
         return array_nbytes(self.arr)

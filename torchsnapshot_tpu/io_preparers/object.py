@@ -22,18 +22,20 @@ class ObjectBufferStager(BufferStager):
         self.entry = entry  # checksum recorded at stage time when given
         self._size_estimate: Optional[int] = None
 
-    async def stage_buffer(self, executor=None) -> BufferType:
-        if executor is not None:
-            loop = asyncio.get_running_loop()
-            buf = await loop.run_in_executor(executor, object_as_bytes, self.obj)
-        else:
-            buf = object_as_bytes(self.obj)
+    def _stage_and_sum(self) -> BufferType:
+        buf = object_as_bytes(self.obj)
         if self.entry is not None:
             from ..integrity import checksums_enabled, compute_checksum
 
             if checksums_enabled():
                 self.entry.checksum = compute_checksum(buf)
         return buf
+
+    async def stage_buffer(self, executor=None) -> BufferType:
+        if executor is not None:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(executor, self._stage_and_sum)
+        return self._stage_and_sum()
 
     def get_staging_cost_bytes(self) -> int:
         if self._size_estimate is None:
@@ -54,17 +56,20 @@ class ObjectBufferConsumer(BufferConsumer):
     def set_consume_callback(self, callback: Callable[[Any], None]) -> None:
         self._callback = callback
 
-    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
+    def _verify_and_load(self, buf: BufferType) -> Any:
         if self.entry.checksum is not None:
             from ..integrity import verification_enabled, verify_checksum
 
             if verification_enabled():
                 verify_checksum(buf, self.entry.checksum, self.entry.location)
+        return object_from_bytes(buf)
+
+    async def consume_buffer(self, buf: BufferType, executor=None) -> None:
         if executor is not None:
             loop = asyncio.get_running_loop()
-            obj = await loop.run_in_executor(executor, object_from_bytes, buf)
+            obj = await loop.run_in_executor(executor, self._verify_and_load, buf)
         else:
-            obj = object_from_bytes(buf)
+            obj = self._verify_and_load(buf)
         if self._callback is not None:
             self._callback(obj)
 

@@ -26,10 +26,15 @@ class WriteIO:
 
 @dataclass
 class ReadIO:
-    """A single read of a storage path, optionally a byte range [lo, hi)."""
+    """A single read of a storage path, optionally a byte range [lo, hi).
+
+    ``buf`` holds the fetched payload; consumers only read it (any
+    buffer-protocol object works), so plugins should assign their transport's
+    native buffer (bytes included) rather than copying into a bytearray —
+    the copy would transiently double per-read host memory."""
 
     path: str
-    buf: bytearray = field(default_factory=bytearray)
+    buf: BufferType = b""
     byte_range: Optional[Tuple[int, int]] = None
 
 

@@ -46,8 +46,9 @@ class TransformerConfig:
     max_seq_len: int = 1024
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    # "dense" | "blockwise" (flash-style local) | "ring" (context parallel,
-    # needs a mesh with a 'seq' axis).
+    # "dense" | "blockwise" (flash-style local) | "ring" | "ulysses"
+    # (context parallel; both need a mesh with a 'seq' axis — ring rotates
+    # K/V on the ICI ring, ulysses all-to-alls seq<->head sharding).
     attn_impl: str = "dense"
     attn_block_size: int = 512
 
@@ -138,23 +139,23 @@ def forward(
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
-    if c.attn_impl not in ("dense", "blockwise", "ring"):
+    if c.attn_impl not in ("dense", "blockwise", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
-    # cp (ring) keeps the sequence dim sharded over 'seq' end-to-end; the
-    # Megatron-sp fallback seq-shards the residual over the tp axis instead
-    # and gathers around attention/ffn.
+    # cp (ring/ulysses) keeps the sequence dim sharded over 'seq' end-to-end;
+    # the Megatron-sp fallback seq-shards the residual over the tp axis
+    # instead and gathers around attention/ffn.
     has_seq = mesh is not None and "seq" in mesh.axis_names
-    if c.attn_impl == "ring" and mesh is not None and not has_seq:
+    if c.attn_impl in ("ring", "ulysses") and mesh is not None and not has_seq:
         raise ValueError(
-            f"attn_impl='ring' needs a mesh with a 'seq' axis; got "
+            f"attn_impl={c.attn_impl!r} needs a mesh with a 'seq' axis; got "
             f"{mesh.axis_names}. Build one via make_mesh({{'data': ..., "
             f"'seq': ..., 'model': ...}})."
         )
-    # mesh=None (single-device run of a ring-configured model) falls back
-    # to dense attention — same math, no ring to rotate on.
-    ring = c.attn_impl == "ring" and has_seq
+    # mesh=None (single-device run of a cp-configured model) falls back to
+    # dense attention — same math, no axis to communicate over.
+    cp = c.attn_impl in ("ring", "ulysses") and has_seq
     res_seq_ax = "seq" if has_seq else "model"  # residual-stream seq sharding
-    act_seq_ax = "seq" if ring else None  # in-block activation seq sharding
+    act_seq_ax = "seq" if cp else None  # in-block activation seq sharding
 
     x = params["embed"].astype(c.dtype)[tokens]  # (B, S, D)
     pos = jnp.arange(S)[None, :, None]
@@ -167,7 +168,14 @@ def forward(
 
     def attention(q, k, v):
         # q, k, v: (B, S, H, hd) — logical shapes; sharding via constraints.
-        if ring:
+        if cp:
+            if c.attn_impl == "ulysses":
+                from ..ops.ulysses import ulysses_attention_sharded
+
+                return ulysses_attention_sharded(
+                    q, k, v, mesh, causal=True,
+                    inner_block_size=c.attn_block_size,
+                )
             from ..ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(q, k, v, mesh, causal=True)

@@ -11,10 +11,13 @@ so the checkpointing layer has real context-parallel state to snapshot.
 
 from .attention import blockwise_attention, dense_attention
 from .ring_attention import ring_attention_sharded, ring_self_attention
+from .ulysses import ulysses_attention_sharded, ulysses_self_attention
 
 __all__ = [
     "blockwise_attention",
     "dense_attention",
     "ring_attention_sharded",
     "ring_self_attention",
+    "ulysses_attention_sharded",
+    "ulysses_self_attention",
 ]

@@ -280,15 +280,33 @@ class Snapshot:
             memory_budget = get_process_memory_budget_bytes(
                 pg_wrapper if world_size > 1 else None
             )
-            pending_io_work = event_loop.run_until_complete(
-                execute_write_reqs(write_reqs, storage, memory_budget, rank)
-            )
             # Gather AFTER execute_write_reqs returns: staging (the
             # consistency point) is complete by then, so stage-time entry
             # mutations — notably integrity checksums — are present in the
             # manifests the ranks exchange. Storage I/O continues in the
-            # background; only metadata rides the collective.
-            global_manifest = cls._gather_manifest(manifest, pg_wrapper)
+            # background; only metadata rides the collective. A local
+            # staging failure must still reach the collective (a deserted
+            # all-gather hangs every peer), so the error rides it too and
+            # is raised on every rank afterwards — no rank commits.
+            stage_exc: Optional[BaseException] = None
+            pending_io_work = None
+            try:
+                pending_io_work = event_loop.run_until_complete(
+                    execute_write_reqs(write_reqs, storage, memory_budget, rank)
+                )
+            except BaseException as e:  # noqa: B036
+                stage_exc = e
+            global_manifest, peer_errors = cls._gather_manifest(
+                manifest, pg_wrapper, local_error=repr(stage_exc) if stage_exc else None
+            )
+            if stage_exc is not None:
+                raise stage_exc
+            failed = [f"rank {i}: {e}" for i, e in enumerate(peer_errors) if e]
+            if failed:
+                raise RuntimeError(
+                    "snapshot aborted — staging failed on peer rank(s): "
+                    + "; ".join(failed)
+                )
             metadata = SnapshotMetadata(
                 version=__version__,
                 world_size=world_size,
@@ -556,12 +574,20 @@ class Snapshot:
         return {lp for lp, _, _ in verified}
 
     @staticmethod
-    def _gather_manifest(local_manifest: Manifest, pg_wrapper: PGWrapper) -> Manifest:
-        """All-gather per-rank manifests into the global rank-prefixed
-        manifest (reference: snapshot.py:954-986). Replicated entries are
-        already complete on every rank (each rank records the full chunk set
-        while writing only its stripe), so no stripe merging is needed."""
-        manifests = pg_wrapper.all_gather_object(local_manifest)
+    def _gather_manifest(
+        local_manifest: Manifest,
+        pg_wrapper: PGWrapper,
+        local_error: Optional[str] = None,
+    ) -> Tuple[Manifest, List[Optional[str]]]:
+        """All-gather per-rank (manifest, staging-error) into the global
+        rank-prefixed manifest (reference: snapshot.py:954-986). Replicated
+        entries are already complete on every rank (each rank records the
+        full chunk set while writing only its stripe), so no stripe merging
+        is needed. Errors ride the collective so a failed rank doesn't
+        desert it."""
+        gathered = pg_wrapper.all_gather_object((local_manifest, local_error))
+        manifests = [m for m, _ in gathered]
+        errors = [e for _, e in gathered]
         global_manifest: Manifest = {}
         for rank, m in enumerate(manifests):
             for logical_path, entry in m.items():
@@ -570,7 +596,7 @@ class Snapshot:
                 else:
                     global_manifest[str(rank)] = entry
         _propagate_checksums(global_manifest)
-        return global_manifest
+        return global_manifest, errors
 
 
 def _propagate_checksums(global_manifest: Manifest) -> None:
@@ -634,9 +660,14 @@ def _prepare_chunked_array_write(
     )
     if replicated:
         # Record the full chunk set in the entry (locations are deterministic).
+        # For this rank's own stripe, reuse the sub-entries already wired to
+        # the write stagers — they receive stage-time mutations (integrity
+        # checksums) that must land in the manifest; fresh objects would
+        # orphan them.
         from .manifest import ArrayEntry, Shard
         from .serialization import Serializer
 
+        local_by_loc = {c.array.location: c.array for c in entry.chunks}
         full: List[Shard] = []
         for offsets, sizes in all_chunks:
             suffix = "_".join(str(o) for o in offsets)
@@ -647,7 +678,8 @@ def _prepare_chunked_array_write(
                 Shard(
                     offsets=list(offsets),
                     sizes=list(sizes),
-                    array=ArrayEntry(
+                    array=local_by_loc.get(location)
+                    or ArrayEntry(
                         location=location,
                         serializer=Serializer.BUFFER_PROTOCOL.value,
                         dtype=dtype_str,

@@ -37,11 +37,11 @@ class FSStoragePlugin(StoragePlugin):
         path = os.path.join(self.root, read_io.path)
         async with aiofiles.open(path, "rb") as f:
             if read_io.byte_range is None:
-                read_io.buf = bytearray(await f.read())
+                read_io.buf = await f.read()
             else:
                 lo, hi = read_io.byte_range
                 await f.seek(lo)
-                read_io.buf = bytearray(await f.read(hi - lo))
+                read_io.buf = await f.read(hi - lo)
 
     async def delete(self, path: str) -> None:
         await aiofiles.os.remove(os.path.join(self.root, path))

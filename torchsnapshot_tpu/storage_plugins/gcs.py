@@ -212,9 +212,10 @@ class GCSStoragePlugin(StoragePlugin):
             # Unknown size: a single GET (the SDK streams the body) — no
             # metadata round-trip, and cross-entry concurrency already
             # keeps the pipe full on the common many-small-files restore.
-            read_io.buf = bytearray(
-                await self._retrying(blob.download_as_bytes)
-            )
+            # (Payloads are capped by the 512 MB chunk/shard split upstream,
+            # so whole-GET retry granularity is acceptable; the bytes land
+            # in ReadIO.buf uncopied.)
+            read_io.buf = await self._retrying(blob.download_as_bytes)
             return
 
         lo, hi = read_io.byte_range

@@ -56,7 +56,7 @@ class S3StoragePlugin(StoragePlugin):
         def get() -> bytes:
             return self.client.get_object(**kwargs)["Body"].read()
 
-        read_io.buf = bytearray(await loop.run_in_executor(None, get))
+        read_io.buf = await loop.run_in_executor(None, get)  # uncopied bytes
 
     async def delete(self, path: str) -> None:
         loop = asyncio.get_running_loop()
