@@ -20,6 +20,8 @@ import ctypes
 import logging
 import os
 import subprocess
+import threading
+import uuid
 from typing import Any, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -31,13 +33,15 @@ _SO = os.path.join(os.path.dirname(__file__), "_ts_native.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+_load_lock = threading.Lock()
 
 
 def _build() -> bool:
-    # Compile to a process-unique temp path and publish atomically with
-    # os.replace: concurrent first-use across ranks/test workers must never
-    # let a CDLL() observe a half-written .so.
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+    # Compile to a unique temp path (first use can race across executor
+    # THREADS of one process as well as across processes — pid alone is not
+    # unique enough) and publish atomically with os.replace: a CDLL() must
+    # never observe a half-written .so.
+    tmp = f"{_SO}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-msse4.2",
         _SRC, "-o", tmp,
@@ -58,6 +62,14 @@ def _build() -> bool:
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
     if _load_attempted:
+        return _lib
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:  # raced another thread to the lock
         return _lib
     _load_attempted = True
     if os.environ.get(DISABLE_NATIVE_ENV_VAR, "0") not in ("0", "", "false"):

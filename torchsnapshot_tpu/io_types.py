@@ -10,7 +10,7 @@ perform host->device materialization.
 from __future__ import annotations
 
 import abc
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Awaitable, Callable, List, Optional, Tuple, Union
 
 BufferType = Union[bytes, bytearray, memoryview]

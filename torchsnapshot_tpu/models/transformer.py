@@ -46,9 +46,10 @@ class TransformerConfig:
     max_seq_len: int = 1024
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    # "dense" | "blockwise" (flash-style local) | "ring" | "ulysses"
-    # (context parallel; both need a mesh with a 'seq' axis — ring rotates
-    # K/V on the ICI ring, ulysses all-to-alls seq<->head sharding).
+    # "dense" | "blockwise" (pure-JAX online-softmax scan) | "flash"
+    # (Pallas TPU kernel) | "ring" | "ulysses" (context parallel; the last
+    # two need a mesh with a 'seq' axis — ring rotates K/V on the ICI
+    # ring, ulysses all-to-alls seq<->head sharding).
     attn_impl: str = "dense"
     attn_block_size: int = 512
 
@@ -139,7 +140,7 @@ def forward(
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
-    if c.attn_impl not in ("dense", "blockwise", "ring", "ulysses"):
+    if c.attn_impl not in ("dense", "blockwise", "flash", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
     # cp (ring/ulysses) keeps the sequence dim sharded over 'seq' end-to-end;
     # the Megatron-sp fallback seq-shards the residual over the tp axis
@@ -179,18 +180,17 @@ def forward(
             from ..ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(q, k, v, mesh, causal=True)
-        if c.attn_impl == "blockwise":
-            from ..ops.attention import blockwise_attention
+        if c.attn_impl in ("blockwise", "flash"):
+            from ..ops.attention import pick_block_size
 
-            # Largest divisor of S within the configured block size —
-            # blockwise_attention requires S % block_size == 0. Awkward
-            # lengths (e.g. prime S) only have tiny divisors; below a
-            # quarter of the configured size the O(S^2) dense path is
-            # faster than S/bs tiny scan steps.
-            bs = min(c.attn_block_size, S)
-            while S % bs:
-                bs -= 1
-            if bs >= max(1, min(c.attn_block_size, S) // 4):
+            bs = pick_block_size(S, c.attn_block_size)
+            if bs is not None:
+                if c.attn_impl == "flash":
+                    from ..ops.pallas_attention import flash_attention
+
+                    return flash_attention(q, k, v, causal=True, block_q=bs, block_k=bs)
+                from ..ops.attention import blockwise_attention
+
                 return blockwise_attention(q, k, v, block_size=bs, causal=True)
         from ..ops.attention import dense_attention
 

@@ -10,12 +10,14 @@ so the checkpointing layer has real context-parallel state to snapshot.
 """
 
 from .attention import blockwise_attention, dense_attention
+from .pallas_attention import flash_attention
 from .ring_attention import ring_attention_sharded, ring_self_attention
 from .ulysses import ulysses_attention_sharded, ulysses_self_attention
 
 __all__ = [
     "blockwise_attention",
     "dense_attention",
+    "flash_attention",
     "ring_attention_sharded",
     "ring_self_attention",
     "ulysses_attention_sharded",

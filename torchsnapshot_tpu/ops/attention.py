@@ -22,6 +22,20 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def pick_block_size(seq_len: int, configured: int) -> Optional[int]:
+    """Largest divisor of ``seq_len`` within ``configured`` — the tiled
+    kernels (blockwise, flash) require ``seq_len % block == 0``. Returns
+    None when only tiny divisors exist (e.g. prime lengths): below a
+    quarter of the configured size the O(S^2) dense path beats S/bs tiny
+    blocks, so callers should fall back to dense."""
+    bs = min(configured, seq_len)
+    while seq_len % bs:
+        bs -= 1
+    if bs < max(1, min(configured, seq_len) // 4):
+        return None
+    return bs
+
+
 def dense_attention(
     q: jax.Array,
     k: jax.Array,

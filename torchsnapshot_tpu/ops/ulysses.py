@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .attention import blockwise_attention, dense_attention
+from .attention import blockwise_attention, dense_attention, pick_block_size
 
 
 def ulysses_self_attention(
@@ -67,13 +67,14 @@ def ulysses_self_attention(
 
     qh, kh, vh = to_head_sharded(q), to_head_sharded(k), to_head_sharded(v)
     S = qh.shape[1]
-    bs = min(inner_block_size, S)
-    while S % bs:
-        bs -= 1
-    # Awkward lengths (e.g. prime S) only have tiny divisors; below a
-    # quarter of the configured block size the dense path beats S/bs tiny
-    # scan steps.
-    if inner == "blockwise" and S > inner_block_size and bs >= inner_block_size // 4:
+    bs = pick_block_size(S, inner_block_size)
+    if inner == "flash" and bs is not None:
+        from .pallas_attention import flash_attention
+
+        out = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale, block_q=bs, block_k=bs
+        )
+    elif inner == "blockwise" and bs is not None and S > inner_block_size:
         out = blockwise_attention(qh, kh, vh, block_size=bs, causal=causal, scale=scale)
     else:
         out = dense_attention(qh, kh, vh, causal=causal, scale=scale)
@@ -114,6 +115,12 @@ def ulysses_attention_sharded(
         inner=inner,
         inner_block_size=inner_block_size,
     )
+    # Pallas interpret mode (CPU testing of inner="flash") emits
+    # dynamic_slices whose index operands are unvarying, which trips
+    # shard_map's varying-axes checker — a jax-internal false positive the
+    # error message itself says to silence with check_vma=False.
+    check_vma = inner != "flash"
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=check_vma,
     )(q, k, v)
