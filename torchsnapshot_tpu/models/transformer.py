@@ -52,6 +52,11 @@ class TransformerConfig:
     # ring, ulysses all-to-alls seq<->head sharding).
     attn_impl: str = "dense"
     attn_block_size: int = 512
+    # n_experts > 0 swaps the dense FFN for a top-2 MoE (ops/moe.py) with
+    # expert weights sharded over the 'model' axis — expert parallelism.
+    n_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -80,16 +85,24 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
             jax.random.normal(key, shape, c.param_dtype) * (fan_in**-0.5)
         )
 
+    layers: Dict[str, Any] = {
+        "attn_qkv": norm(k_attn, (L, D, 3 * D), D),
+        "attn_out": norm(k_o, (L, D, D), D),
+        "ln1_scale": jnp.ones((L, D), c.param_dtype),
+        "ln2_scale": jnp.ones((L, D), c.param_dtype),
+    }
+    if c.n_experts > 0:
+        E = c.n_experts
+        k_r, k_ff1, k_ff2 = jax.random.split(k_ff1, 3)
+        layers["moe_router"] = norm(k_r, (L, D, E), D)
+        layers["moe_w_in"] = norm(k_ff1, (L, E, D, F), D)
+        layers["moe_w_out"] = norm(k_ff2, (L, E, F, D), F)
+    else:
+        layers["ff_in"] = norm(k_ff1, (L, D, F), D)
+        layers["ff_out"] = norm(k_ff2, (L, F, D), F)
     return {
         "embed": norm(k_embed, (c.vocab_size, D), D),
-        "layers": {
-            "attn_qkv": norm(k_attn, (L, D, 3 * D), D),
-            "attn_out": norm(k_o, (L, D, D), D),
-            "ff_in": norm(k_ff1, (L, D, F), D),
-            "ff_out": norm(k_ff2, (L, F, D), F),
-            "ln1_scale": jnp.ones((L, D), c.param_dtype),
-            "ln2_scale": jnp.ones((L, D), c.param_dtype),
-        },
+        "layers": layers,
         "ln_f_scale": jnp.ones((D,), c.param_dtype),
     }
 
@@ -101,16 +114,23 @@ def param_specs(cfg: TransformerConfig) -> Params:
     Row-parallel (input dim on 'model'): attn_out, ff_out.
     Norm scales replicated.
     """
+    layers = {
+        "attn_qkv": P(None, None, "model"),
+        "attn_out": P(None, "model", None),
+        "ln1_scale": P(None, None),
+        "ln2_scale": P(None, None),
+    }
+    if cfg.n_experts > 0:
+        # ep: the expert dimension shards over 'model' (router replicated).
+        layers["moe_router"] = P(None, None, None)
+        layers["moe_w_in"] = P(None, "model", None, None)
+        layers["moe_w_out"] = P(None, "model", None, None)
+    else:
+        layers["ff_in"] = P(None, None, "model")
+        layers["ff_out"] = P(None, "model", None)
     return {
         "embed": P(None, "model"),
-        "layers": {
-            "attn_qkv": P(None, None, "model"),
-            "attn_out": P(None, "model", None),
-            "ff_in": P(None, None, "model"),
-            "ff_out": P(None, "model", None),
-            "ln1_scale": P(None, None),
-            "ln2_scale": P(None, None),
-        },
+        "layers": layers,
         "ln_f_scale": P(None),
     }
 
@@ -126,11 +146,14 @@ def forward(
     cfg: TransformerConfig,
     *,
     mesh: Optional[Mesh] = None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Causal LM forward: (batch, seq) int32 -> (batch, seq, vocab) logits.
 
     When `mesh` is given, sharding constraints implement dp/tp/sp; with
-    mesh=None the same code runs single-device.
+    mesh=None the same code runs single-device. With ``with_aux=True``
+    returns (logits, aux_loss) — the MoE load-balancing term (0 for dense
+    FFN configs).
     """
     c = cfg
     B, S = tokens.shape
@@ -196,7 +219,8 @@ def forward(
 
         return dense_attention(q, k, v, causal=True)
 
-    def block(x, layer):
+    def block(carry, layer):
+        x, aux = carry
         x = cs(x, P("data", res_seq_ax, None))
         h = _rmsnorm(x, layer["ln1_scale"])
         h = cs(h, P("data", act_seq_ax, None))
@@ -214,17 +238,36 @@ def forward(
         x = x + cs(attn @ layer["attn_out"].astype(c.dtype), P("data", res_seq_ax, None))
 
         h = _rmsnorm(x, layer["ln2_scale"])
-        h = cs(h, P("data", act_seq_ax, None))
-        h = jax.nn.gelu(h @ layer["ff_in"].astype(c.dtype))
-        h = cs(h, P("data", act_seq_ax, "model"))
-        x = x + cs(h @ layer["ff_out"].astype(c.dtype), P("data", res_seq_ax, None))
-        return x, None
+        if c.n_experts > 0:
+            from ..ops.moe import moe_ffn
 
-    x, _ = jax.lax.scan(block, x, params["layers"])
+            h = cs(h, P("data", act_seq_ax, None))
+            y, l_aux = moe_ffn(
+                {
+                    "router": layer["moe_router"],
+                    "w_in": layer["moe_w_in"],
+                    "w_out": layer["moe_w_out"],
+                },
+                h,
+                capacity_factor=c.moe_capacity_factor,
+            )
+            x = x + cs(y, P("data", res_seq_ax, None))
+            aux = aux + l_aux
+        else:
+            h = cs(h, P("data", act_seq_ax, None))
+            h = jax.nn.gelu(h @ layer["ff_in"].astype(c.dtype))
+            h = cs(h, P("data", act_seq_ax, "model"))
+            x = x + cs(h @ layer["ff_out"].astype(c.dtype), P("data", res_seq_ax, None))
+        return (x, aux), None
+
+    (x, aux), _ = jax.lax.scan(block, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = cs(x, P("data", act_seq_ax, None))
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = x @ params["embed"].astype(c.dtype).T
-    return cs(logits, P("data", act_seq_ax, "model"))
+    logits = cs(logits, P("data", act_seq_ax, "model"))
+    if with_aux:
+        return logits, aux
+    return logits
 
 
 def loss_fn(
@@ -234,11 +277,11 @@ def loss_fn(
     *,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
-    logits = forward(params, batch["tokens"], cfg, mesh=mesh)
+    logits, aux = forward(params, batch["tokens"], cfg, mesh=mesh, with_aux=True)
     targets = batch["targets"]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.moe_aux_weight * aux
 
 
 def make_optimizer(lr: float = 1e-3) -> optax.GradientTransformation:

@@ -1,0 +1,122 @@
+"""Mixture-of-experts FFN with expert parallelism (ep).
+
+GShard-style top-2 routing with static capacity: every shape is fixed at
+trace time (capacity-bounded dispatch via one-hot einsums — no dynamic
+gather/scatter, which XLA cannot tile onto the MXU), so the whole layer
+jits cleanly and the expert dimension shards over a mesh axis with GSPMD
+inserting the all-to-alls. Overflowing tokens are dropped (their FFN
+output is zero and the residual carries them), the standard capacity
+trade-off.
+
+The expert-stacked weights (E, D, F)/(E, F, D) shard over the 'model' axis
+by default — expert parallelism at the state-dict level is just another
+sharded array for the snapshot layer (which is the point: SURVEY.md §2's
+"Parallelism" table, extended to ep).
+
+Auxiliary load-balancing loss follows Switch/GShard: mean(fraction of
+tokens per expert * mean router prob per expert) * E.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(
+    rng: jax.Array,
+    d_model: int,
+    d_ff: int,
+    n_experts: int,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    k_r, k_in, k_out = jax.random.split(rng, 3)
+    return {
+        "router": jax.random.normal(k_r, (d_model, n_experts), dtype) * (d_model**-0.5),
+        "w_in": jax.random.normal(k_in, (n_experts, d_model, d_ff), dtype)
+        * (d_model**-0.5),
+        "w_out": jax.random.normal(k_out, (n_experts, d_ff, d_model), dtype)
+        * (d_ff**-0.5),
+    }
+
+
+def moe_param_specs(expert_axis: Optional[str] = "model") -> Dict[str, Any]:
+    """PartitionSpecs: experts sharded over ``expert_axis``; router replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router": P(None, None),
+        "w_in": P(expert_axis, None, None),
+        "w_out": P(expert_axis, None, None),
+    }
+
+
+def moe_ffn(
+    params: Dict[str, Any],
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    activation=jax.nn.gelu,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-2 MoE FFN. ``x: (..., T, D)`` -> (same shape, aux_loss scalar).
+
+    Leading dims are flattened into one token axis for routing; capacity is
+    per expert: ceil(2 * T / E * capacity_factor).
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    x2 = x.reshape(-1, D)  # (T, D)
+    T = x2.shape[0]
+    E = params["router"].shape[1]
+    cap = int(max(1, (2 * T * capacity_factor) // E))
+
+    logits = (x2 @ params["router"].astype(x.dtype)).astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Top-2 expert choice per token.
+    g1 = jnp.max(probs, axis=-1)
+    e1 = jnp.argmax(probs, axis=-1)
+    probs_wo1 = probs - jax.nn.one_hot(e1, E) * probs
+    g2 = jnp.max(probs_wo1, axis=-1)
+    e2 = jnp.argmax(probs_wo1, axis=-1)
+    # Renormalize the two gates.
+    denom = g1 + g2 + 1e-9
+    g1, g2 = g1 / denom, g2 / denom
+
+    # Position of each token within its expert's capacity buffer (by token
+    # order — deterministic). Overflowing tokens get pos >= cap and a zero
+    # dispatch mask.
+    def dispatch(e, g, prior_load):
+        onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)  # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) - 1 + prior_load[None, :]
+        pos = jnp.sum(pos * onehot, axis=-1)  # (T,)
+        keep = pos < cap
+        # (T, E, cap) one-hot dispatch tensor
+        disp = (
+            jax.nn.one_hot(e, E)[:, :, None]
+            * jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap)[:, None, :]
+            * keep[:, None, None]
+        )
+        return disp, g * keep, prior_load + jnp.sum(onehot, axis=0)
+
+    load0 = jnp.zeros((E,), jnp.int32)
+    disp1, g1k, load1 = dispatch(e1, g1, load0)
+    disp2, g2k, _ = dispatch(e2, g2, load1)
+
+    combine = disp1 * g1k[:, None, None] + disp2 * g2k[:, None, None]  # (T,E,cap)
+    dispatch_mask = (combine > 0).astype(x.dtype)
+
+    # Route tokens to expert buffers, run the expert FFNs, combine back.
+    xe = jnp.einsum("td,tec->ecd", x2.astype(x.dtype), dispatch_mask)  # (E,cap,D)
+    h = activation(jnp.einsum("ecd,edf->ecf", xe, params["w_in"].astype(x.dtype)))
+    ye = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(x.dtype))
+    y = jnp.einsum("ecd,tec->td", ye, combine.astype(x.dtype))  # (T, D)
+
+    # Switch-style load-balancing aux loss.
+    frac_tokens = jnp.mean(jax.nn.one_hot(e1, E, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = jnp.sum(frac_tokens * frac_probs) * E
+
+    return y.reshape(orig_shape), aux_loss.astype(jnp.float32)
