@@ -15,6 +15,8 @@ the array on device with its original sharding.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import contextvars
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -29,6 +31,31 @@ from ..serialization import (
     array_size_bytes,
     dtype_to_string,
 )
+
+
+# When True (the default), stagers copy host-resident buffers so the staged
+# bytes cannot alias caller memory — required by async_take's guarantee that
+# mutations after it returns don't affect the snapshot (reference:
+# snapshot.py:257-262). Snapshot.take blocks the caller until all I/O is
+# drained, so it opts out: zero-copy staging halves host memory traffic.
+# The flag is captured at stager construction (prepare time), so it is
+# unaffected by which thread later runs the staging.
+_copy_for_consistency: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "tsnap_copy_for_consistency", default=True
+)
+
+
+@contextlib.contextmanager
+def zero_copy_staging():
+    """Within this context, prepared stagers may alias caller memory.
+
+    Only safe when the caller blocks until storage I/O completes
+    (synchronous ``Snapshot.take``)."""
+    token = _copy_for_consistency.set(False)
+    try:
+        yield
+    finally:
+        _copy_for_consistency.reset(token)
 
 
 def _is_jax_array(arr) -> bool:
@@ -68,20 +95,26 @@ class ArrayBufferStager(BufferStager):
         # manifest is gathered/committed after staging completes, so the
         # mutation is visible in the persisted metadata).
         self.entry = entry
+        self.copy_for_consistency = _copy_for_consistency.get()
 
-    @staticmethod
-    def _stage_sync(arr) -> np.ndarray:
+    def _stage_sync(self, arr) -> np.ndarray:
         if _is_jax_array(arr):
             host = np.asarray(arr)
             # CPU-backend jax arrays materialize as zero-copy views of the
             # device buffer; copy so donation/deletion can't corrupt the
             # snapshot. On TPU the DtoH transfer already produced host-owned
-            # memory — no extra copy.
+            # memory — no extra copy. Under zero_copy_staging (sync take)
+            # the view is safe: the caller is blocked until I/O drains.
             devices = arr.sharding.device_set
-            if next(iter(devices)).platform == "cpu":
+            if (
+                self.copy_for_consistency
+                and next(iter(devices)).platform == "cpu"
+            ):
                 host = np.array(host, copy=True)
             return host
-        return np.array(arr, copy=True)
+        if self.copy_for_consistency:
+            return np.array(arr, copy=True)
+        return np.asarray(arr)
 
     def _stage_and_sum(self, arr) -> BufferType:
         """Runs in an executor thread: DtoH + serialize + (optional) hash —

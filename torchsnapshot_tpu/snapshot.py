@@ -58,6 +58,7 @@ from .io_preparers import (
     is_sharded_jax_array,
     prepare_read,
 )
+from .io_preparers.array import zero_copy_staging
 from .io_preparers.prepare import is_jax_array
 from .manifest import (
     ChunkedArrayEntry,
@@ -120,14 +121,18 @@ class Snapshot:
             path, event_loop, storage_options
         )
         try:
-            pending_io_work, metadata = cls._take_impl(
-                path=path,
-                app_state=app_state,
-                replicated=replicated or [],
-                pg_wrapper=pg_wrapper,
-                storage=storage,
-                event_loop=event_loop,
-            )
+            # Synchronous take blocks the caller until I/O drains, so staged
+            # buffers may alias caller memory — halves host memory traffic
+            # vs async_take's consistency copy.
+            with zero_copy_staging():
+                pending_io_work, metadata = cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    replicated=replicated or [],
+                    pg_wrapper=pg_wrapper,
+                    storage=storage,
+                    event_loop=event_loop,
+                )
             pending_io_work.sync_complete(event_loop)
             pg_wrapper.barrier()
             if pg_wrapper.get_rank() == 0:
