@@ -206,3 +206,29 @@ def test_replication_glob_negotiation(tmp_path) -> None:
     for r in results.values():
         assert r["a_replicated"] is True
         assert r["b_replicated"] is False
+
+
+def _sequential_snapshots_worker(rank: int, world_size: int, base_path: str):
+    """50 sequential snapshots must not grow the KV store unboundedly
+    (PGWrapper retire/GC protocol)."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.pg_wrapper import get_default_pg
+
+    store = get_default_pg().store
+    app_state = {
+        "model": StateDict(w=np.ones((16, 16), dtype=np.float32)),
+        "local": StateDict(step=rank),
+    }
+    counts = []
+    for i in range(50):
+        Snapshot.take(f"{base_path}/snap_{i}", app_state)
+        counts.append(store.num_keys())
+    assert counts[-1] < 60, f"store grew unbounded: tail={counts[-10:]}"
+    return counts[-1]
+
+
+def test_sequential_snapshots_store_bounded(tmp_path) -> None:
+    results = run_with_subprocesses(
+        _sequential_snapshots_worker, 2, str(tmp_path), timeout=300.0
+    )
+    assert all(v < 60 for v in results.values())

@@ -1,0 +1,174 @@
+"""Golden-data manifest tests: pin the on-disk YAML format and the
+elasticity rules against a hand-maintained fixture covering every entry
+type (reference: tests/test_manifest.py:21-441, incl. the rank-42
+larger-world case).
+
+The YAML metadata is the snapshot commit point — its format is the
+compatibility contract between releases. If a change breaks byte-exact
+round-trip of the fixture, it breaks restores of existing snapshots:
+regenerate the fixture ONLY for deliberate, versioned format changes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict
+
+import pytest
+
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    ObjectEntry,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_available_entries,
+    get_manifest_for_rank,
+)
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "data", "golden_manifest.yaml")
+
+
+@pytest.fixture()
+def golden_text() -> str:
+    with open(GOLDEN_PATH) as f:
+        return f.read()
+
+
+@pytest.fixture()
+def metadata(golden_text: str) -> SnapshotMetadata:
+    return SnapshotMetadata.from_yaml(golden_text)
+
+
+def test_yaml_round_trip_is_byte_exact(golden_text, metadata) -> None:
+    assert metadata.to_yaml() == golden_text
+
+
+def test_all_entry_types_parse(metadata) -> None:
+    m = metadata.manifest
+    assert type(m["0/model"]).__name__ == "DictEntry"
+    assert type(m["0/model/layers"]).__name__ == "ListEntry"
+    assert type(m["0/counters"]).__name__ == "TupleEntry"
+    assert type(m["0/extra"]).__name__ == "OrderedDictEntry"
+    assert isinstance(m["0/model/weight"], ArrayEntry)
+    assert isinstance(m["0/model/big"], ChunkedArrayEntry)
+    assert isinstance(m["0/model/sharded_w"], ShardedArrayEntry)
+    assert isinstance(m["0/extra/blob"], ObjectEntry)
+    opt = m["0/model/opt"]
+    assert (opt.module, opt.qualname) == ("optax", "ScaleByAdamState")
+    assert opt.fields == ["count", "mu", "nu"]
+
+    # field-level pins
+    w = m["0/model/weight"]
+    assert (w.dtype, w.shape, w.replicated) == ("bfloat16", [64, 64], True)
+    assert w.checksum == "crc32c:deadbeef"
+    buf = m["0/model/buf"]
+    assert buf.byte_range == [128, 144]
+    blob = m["0/extra/blob"]
+    assert (blob.size, blob.obj_type) == (4096, "set")
+    big = m["0/model/big"]
+    assert [c.offsets for c in big.chunks] == [[0, 0], [512, 0]]
+
+
+def test_primitive_values_restore_bit_exact(metadata) -> None:
+    m = metadata.manifest
+    assert m["0/counters/0"].get_value() == 7
+    assert m["0/counters/1"].get_value() == 0.5
+    assert m["0/counters/2"].get_value() == "step-name"
+    assert m["0/counters/3"].get_value() is True
+    assert m["0/counters/4"].get_value() == b"\x00\x01"
+    assert m["0/counters/5"].get_value() is None
+
+
+def test_availability_same_world(metadata) -> None:
+    avail0 = get_available_entries(metadata.manifest, 0)
+    avail1 = get_available_entries(metadata.manifest, 1)
+
+    # per-rank entries go to their owner only
+    assert avail0["rank_local"].location == "0/rank_local"
+    assert avail1["rank_local"].location == "1/rank_local"
+
+    # replicated entries go to everyone; a saver reads its own copy
+    assert avail0["model/weight"].location == "replicated/model/weight"
+    assert avail1["model/weight"].location == "replicated/model/weight"
+
+    # rank 1 did not save model/buf (per-rank, not replicated) -> absent
+    assert "model/buf" in avail0
+    assert "model/buf" not in avail1
+
+    # sharded entries merge all ranks' shards for everyone
+    for avail in (avail0, avail1):
+        merged = avail["model/sharded_w"]
+        assert sorted(s.offsets for s in merged.shards) == [[0, 0], [64, 0]]
+
+    # container entries are structural only
+    assert "model" not in avail0
+    assert "counters" not in avail0
+
+
+def test_availability_larger_world_rank_beyond_savers(metadata) -> None:
+    # Restoring with world size 43: rank 42 saved nothing.
+    avail = get_available_entries(metadata.manifest, 42)
+    # replicated + sharded available
+    assert avail["model/weight"].location == "replicated/model/weight"
+    assert isinstance(avail["model/big"], ChunkedArrayEntry)
+    assert len(avail["model/sharded_w"].shards) == 2
+    # primitives saved replicated=False belong to their rank
+    assert "counters/0" not in avail
+    # per-rank entries are NOT available
+    assert "rank_local" not in avail
+    assert "model/buf" not in avail
+
+
+def test_manifest_for_rank_includes_rank0_containers_for_new_ranks(metadata) -> None:
+    m42 = get_manifest_for_rank(metadata, 42)
+    # container structure borrowed from rank 0 so inflate can rebuild
+    assert type(m42["model"]).__name__ == "DictEntry"
+    assert m42["model"].keys == ["weight", "buf", "opt", "layers"]
+
+
+def test_asdict_field_order_is_stable(metadata) -> None:
+    # Serialization order is part of the format: type first, then fields in
+    # declaration order.
+    d = asdict(metadata.manifest["0/model/weight"])
+    assert list(d.keys()) == [
+        "type",
+        "location",
+        "serializer",
+        "dtype",
+        "shape",
+        "replicated",
+        "byte_range",
+        "checksum",
+    ]
+    d = asdict(metadata.manifest["0/extra/blob"])
+    assert list(d.keys()) == [
+        "type",
+        "location",
+        "serializer",
+        "obj_type",
+        "replicated",
+        "checksum",
+        "size",
+    ]
+
+
+def test_legacy_manifest_without_new_fields_parses() -> None:
+    # Forward compatibility: manifests written before ObjectEntry.size was
+    # introduced must keep loading.
+    legacy = """\
+version: 0.1.0
+world_size: 1
+manifest:
+  0/obj:
+    type: object
+    location: 0/obj
+    serializer: pickle
+    obj_type: dict
+    replicated: false
+"""
+    md = SnapshotMetadata.from_yaml(legacy)
+    entry = md.manifest["0/obj"]
+    assert isinstance(entry, ObjectEntry)
+    assert entry.size is None and entry.checksum is None

@@ -39,6 +39,71 @@ def test_collectives(world_size: int) -> None:
     assert all(v == "ok" for v in results.values())
 
 
+def _extra_wrapper_worker(rank: int, world_size: int):
+    # One rank constructs extra wrappers (e.g. on an exception path) that
+    # never perform collectives. The lazy namespace handshake means they
+    # consume nothing, so peers stay in sync.
+    pg = PGWrapper()
+    if rank == 1:
+        _unused_a = PGWrapper()  # noqa: F841
+        _unused_b = PGWrapper()  # noqa: F841
+    assert pg.broadcast_object(rank, src=0) == 0
+    pg2 = PGWrapper()
+    assert pg2.all_gather_object(rank) == list(range(world_size))
+    pg.barrier()
+    return "ok"
+
+
+def test_extra_wrapper_does_not_desync() -> None:
+    results = run_with_subprocesses(_extra_wrapper_worker, 2)
+    assert all(v == "ok" for v in results.values())
+
+
+def _error_channel_worker(rank: int, world_size: int):
+    pg = PGWrapper()
+    pg.barrier()  # establish the namespace on every rank
+    if rank == 0:
+        pg.report_error(ValueError("boom"))
+        return "reported"
+    try:
+        # Rank 0 never broadcasts; without the error channel this would
+        # block for the full store timeout.
+        pg.broadcast_object(None, src=0)
+    except RuntimeError as e:
+        assert isinstance(e.__cause__, ValueError)
+        return "raised"
+    raise AssertionError("collective did not observe the peer error")
+
+
+def test_error_channel_unblocks_collectives() -> None:
+    results = run_with_subprocesses(_error_channel_worker, 2)
+    assert results[0] == "reported"
+    assert results[1] == "raised"
+
+
+def _store_hygiene_worker(rank: int, world_size: int, n_ops: int):
+    from torchsnapshot_tpu.pg_wrapper import get_default_pg
+
+    store = get_default_pg().store
+    key_counts = []
+    for _ in range(n_ops):
+        pg = PGWrapper()
+        pg.broadcast_object({"plan": list(range(8))}, src=0)
+        pg.all_gather_object({"rank": rank})
+        pg.barrier()
+        pg.retire()
+        key_counts.append(store.num_keys())
+    # Retired namespaces are GCed at later handshakes: the store must not
+    # grow linearly with the number of operations.
+    assert key_counts[-1] < 40, f"store grew unbounded: {key_counts}"
+    return key_counts[-1]
+
+
+def test_store_keys_bounded_over_many_operations() -> None:
+    results = run_with_subprocesses(_store_hygiene_worker, 2, 50)
+    assert all(v < 40 for v in results.values())
+
+
 def test_single_process_trivial_collectives() -> None:
     # No default pg initialized in this process -> single-process semantics.
     w = PGWrapper(pg=None)

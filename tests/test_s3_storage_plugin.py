@@ -1,0 +1,177 @@
+"""S3 plugin logic tests against an in-memory fake client.
+
+The reference gates its S3 tests on a real bucket + env var
+(tests/test_s3_storage_plugin.py:29-86: write/read/delete + ranged read);
+that covers AWS's SDK more than the plugin. These tests target OUR logic —
+zero-copy streaming, rewind-on-retry, transient classification, ranged
+GETs, and the shared collective retry strategy — with fakes, so they run
+unconditionally (test strategy: SURVEY.md §4.4 fault injection via
+plugin-level fakes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugins.retry import CollectiveRetryStrategy
+from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+
+class FakeBody:
+    def __init__(self, data: bytes):
+        self._data = data
+
+    def read(self) -> bytes:
+        return self._data
+
+
+class FakeS3Client:
+    """Implements the three client calls the plugin makes, with optional
+    transient failures injected before each operation."""
+
+    def __init__(self, fail_times: int = 0):
+        self.store: dict = {}
+        self._fail_times = fail_times
+        self.put_attempts = 0
+        self.get_ranges: list = []
+
+    def _maybe_fail(self):
+        if self._fail_times > 0:
+            self._fail_times -= 1
+            raise ConnectionError("fake transient")
+
+    def put_object(self, Bucket, Key, Body):
+        self.put_attempts += 1
+        # Consume the stream BEFORE failing, so a retry without rewind
+        # would upload a short/corrupt body.
+        data = Body.read()
+        self._maybe_fail()
+        self.store[(Bucket, Key)] = bytes(data)
+
+    def get_object(self, Bucket, Key, Range=None):
+        self._maybe_fail()
+        data = self.store[(Bucket, Key)]
+        if Range is not None:
+            assert Range.startswith("bytes=")
+            lo, _, hi = Range[len("bytes=") :].partition("-")
+            self.get_ranges.append((int(lo), int(hi)))
+            data = data[int(lo) : int(hi) + 1]  # HTTP ranges are inclusive
+        return {"Body": FakeBody(data)}
+
+    def delete_object(self, Bucket, Key):
+        self._maybe_fail()
+        del self.store[(Bucket, Key)]
+
+
+def make_plugin(client: FakeS3Client, **options) -> S3StoragePlugin:
+    return S3StoragePlugin(
+        "fake-bucket/prefix", storage_options={"client": client, **options}
+    )
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_write_read_delete_round_trip() -> None:
+    client = FakeS3Client()
+    plugin = make_plugin(client)
+    payload = bytes(range(256)) * 100
+
+    run(plugin.write(WriteIO(path="0/model/w", buf=memoryview(payload))))
+    assert client.store[("fake-bucket", "prefix/0/model/w")] == payload
+
+    read_io = ReadIO(path="0/model/w")
+    run(plugin.read(read_io))
+    assert bytes(read_io.buf) == payload
+
+    run(plugin.delete("0/model/w"))
+    assert not client.store
+
+
+def test_ranged_read() -> None:
+    client = FakeS3Client()
+    plugin = make_plugin(client)
+    payload = bytes(range(256)) * 4
+    run(plugin.write(WriteIO(path="f", buf=memoryview(payload))))
+
+    read_io = ReadIO(path="f", byte_range=(100, 300))
+    run(plugin.read(read_io))
+    assert bytes(read_io.buf) == payload[100:300]
+    assert client.get_ranges == [(100, 299)]  # inclusive HTTP range header
+
+
+def test_upload_retries_with_rewind() -> None:
+    client = FakeS3Client(fail_times=2)
+    strategy = CollectiveRetryStrategy(sleep=lambda s: asyncio.sleep(0))
+    plugin = make_plugin(client, retry_strategy=strategy)
+    payload = b"x" * 10_000
+
+    run(plugin.write(WriteIO(path="w", buf=memoryview(payload))))
+    assert client.put_attempts == 3  # 2 transient failures + success
+    # A missing rewind would have stored a short body on the final attempt.
+    assert client.store[("fake-bucket", "prefix/w")] == payload
+
+
+def test_nontransient_error_propagates() -> None:
+    client = FakeS3Client()
+    plugin = make_plugin(client)
+    with pytest.raises(KeyError):
+        run(plugin.read(ReadIO(path="missing")))
+
+
+def test_stalled_fleet_fails_together() -> None:
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    async def sleep(s):
+        t[0] += s
+
+    client = FakeS3Client(fail_times=1000)
+    strategy = CollectiveRetryStrategy(
+        stall_timeout_s=10.0, clock=clock, sleep=sleep
+    )
+    plugin = make_plugin(client, retry_strategy=strategy)
+    with pytest.raises(ConnectionError):
+        run(plugin.write(WriteIO(path="w", buf=memoryview(b"data"))))
+
+
+def test_short_ranged_read_raises() -> None:
+    class TruncatingClient(FakeS3Client):
+        def get_object(self, Bucket, Key, Range=None):
+            resp = super().get_object(Bucket, Key, Range)
+            return {"Body": FakeBody(resp["Body"].read()[:-5])}
+
+    client = TruncatingClient()
+    plugin = make_plugin(client)
+    run(plugin.write(WriteIO(path="f", buf=memoryview(b"a" * 100))))
+    with pytest.raises(IOError, match="short read"):
+        run(plugin.read(ReadIO(path="f", byte_range=(0, 50))))
+
+
+def test_end_to_end_snapshot_via_fake_s3(monkeypatch) -> None:
+    """Full Snapshot.take/restore through the s3:// URL scheme."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    client = FakeS3Client()
+    state = {
+        "w": np.arange(1024, dtype=np.float32).reshape(32, 32),
+        "step": 7,
+    }
+    app_state = {"model": StateDict(**state)}
+    Snapshot.take(
+        "s3://fake-bucket/ckpt", app_state, storage_options={"client": client}
+    )
+
+    dst = StateDict(w=np.zeros((32, 32), np.float32), step=-1)
+    Snapshot(
+        "s3://fake-bucket/ckpt", storage_options={"client": client}
+    ).restore({"model": dst})
+    np.testing.assert_array_equal(dst["w"], state["w"])
+    assert dst["step"] == 7

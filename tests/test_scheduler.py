@@ -216,3 +216,65 @@ def test_write_error_propagates() -> None:
     with pytest.raises(RuntimeError, match="injected storage failure"):
         sync_execute_write_reqs(reqs, FaultyStorage(), 10**9, rank=0, event_loop=loop)
     loop.close()
+
+
+def test_progress_reporter_logs_pipeline_table(caplog) -> None:
+    """The reporter emits stage counts / bytes / budget / RSS
+    (reference: _WriteReporter, scheduler.py:96-175)."""
+    import logging
+
+    import torchsnapshot_tpu.scheduler as sched
+
+    budget = sched._MemoryBudget(1 << 30)
+    budget.acquire(1 << 29)
+    reporter = sched._ProgressReporter("write", rank=0, total=8, budget=budget)
+    reporter.inflight_staging = 2
+    reporter.staged_count = 3
+    reporter.staged_bytes = 3 << 20
+    reporter.inflight_io = 1
+    reporter.written_count = 2
+    reporter.written_bytes = 2 << 20
+    with caplog.at_level(logging.INFO, logger="torchsnapshot_tpu.scheduler"):
+        reporter.log_table()
+    assert caplog.records, "no progress table logged"
+    line = caplog.records[-1].message
+    for token in (
+        "8 total",
+        "2 staging",
+        "3 staged",
+        "1 in io",
+        "2 written",
+        "budget free",
+        "rss delta",
+    ):
+        assert token in line, f"missing {token!r} in {line!r}"
+
+
+def test_write_pipeline_wires_progress_reporter(tmp_path) -> None:
+    """execute_write_reqs attaches a periodic reporter that survives into
+    the PendingIOWork drain phase."""
+    import asyncio
+
+    import numpy as np
+
+    import torchsnapshot_tpu.scheduler as sched
+    from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    reqs = []
+    for i in range(3):
+        _, wreqs = ArrayIOPreparer.prepare_write(f"0/p{i}", np.ones((64, 64)))
+        reqs.extend(wreqs)
+    loop = asyncio.new_event_loop()
+    storage = FSStoragePlugin(str(tmp_path))
+    pending = loop.run_until_complete(
+        sched.execute_write_reqs(reqs, storage, 1 << 30, rank=0)
+    )
+    reporter = pending._reporter
+    assert reporter is not None
+    assert reporter.staged_count == 3
+    pending.sync_complete(loop)
+    assert reporter.written_count == 3
+    assert reporter.written_bytes == 3 * 64 * 64 * 8
+    loop.run_until_complete(storage.close())
+    loop.close()

@@ -122,6 +122,8 @@ class _StoreServer:
                     self._cond.wait(timeout=min(remaining, 1.0))
             elif op == "check":
                 return {"ok": True, "value": key in self._data}
+            elif op == "num_keys":
+                return {"ok": True, "value": len(self._data)}
             elif op == "delete":
                 existed = self._data.pop(key, None) is not None
                 return {"ok": True, "value": existed}
@@ -212,6 +214,11 @@ class TCPStore:
 
     def check(self, key: str) -> bool:
         return self._request({"op": "check", "key": key})["value"]
+
+    def num_keys(self) -> int:
+        """Total number of keys currently held by the server (observability /
+        store-hygiene tests)."""
+        return self._request({"op": "num_keys"})["value"]
 
     def delete(self, key: str) -> bool:
         return self._request({"op": "delete", "key": key})["value"]
@@ -318,9 +325,10 @@ class LinearBarrier:
     def depart(self, timeout: Optional[float] = None) -> None:
         if self.rank == self.leader_rank:
             self.store.set(self._key("depart"), b"1")
-            # Leader departs last: safe to reclaim barrier keys would race
-            # with stragglers still waiting on depart — keys are reclaimed by
-            # the next snapshot's delete_prefix instead.
+            # Reclaiming barrier keys here would race stragglers still
+            # waiting on depart; when the prefix is nested under a PGWrapper
+            # namespace, the retire/GC protocol reclaims them once every
+            # rank has acked (pg_wrapper.PGWrapper.retire).
         else:
             key, value = self.store.wait_any(
                 [self._key("depart"), self._err_key()], timeout

@@ -9,6 +9,7 @@ snapshot.py:736-745).
 from __future__ import annotations
 
 import asyncio
+import pickle
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
@@ -16,15 +17,62 @@ from ..manifest import ObjectEntry
 from ..serialization import Serializer, object_as_bytes, object_from_bytes
 
 
+# Below this serialized size the cost probe keeps the pickled bytes for
+# reuse at stage time (most objects are small — one pickle total). Above
+# it, only the size is kept: the probe must not hold GB-scale buffers
+# outside the scheduler's budget accounting, so large objects pay a second
+# pickle at stage time — the price of correct budgeting.
+_PROBE_CACHE_LIMIT_BYTES = 4 * 1024 * 1024
+
+
+class _CountingSink:
+    """A pickle sink that counts bytes, buffering them only while the total
+    stays under ``limit``: measures the true serialized size ahead of
+    staging (the reference's cost model keyed off tensor bytes with a 2x
+    torch.save factor, io_preparer.py:540-548; pickle lets us measure
+    exactly), caching small payloads to avoid a double pickle."""
+
+    __slots__ = ("nbytes", "_parts", "_limit")
+
+    def __init__(self, limit: int = 0) -> None:
+        self.nbytes = 0
+        self._limit = limit
+        self._parts: Optional[list] = [] if limit > 0 else None
+
+    def write(self, b: bytes) -> int:
+        self.nbytes += len(b)
+        if self._parts is not None:
+            if self.nbytes <= self._limit:
+                self._parts.append(bytes(b))
+            else:
+                self._parts = None  # crossed the limit: stop buffering
+        return len(b)
+
+    def payload(self) -> Optional[bytes]:
+        return b"".join(self._parts) if self._parts is not None else None
+
+
+def serialized_size_bytes(obj: Any) -> int:
+    sink = _CountingSink()
+    pickle.dump(obj, sink, protocol=pickle.HIGHEST_PROTOCOL)
+    return sink.nbytes
+
+
 class ObjectBufferStager(BufferStager):
     def __init__(self, obj: Any, entry: Optional[ObjectEntry] = None) -> None:
         self.obj = obj
-        self.entry = entry  # checksum recorded at stage time when given
+        self.entry = entry  # checksum + size recorded at stage time when given
         self._size_estimate: Optional[int] = None
+        self._probed_bytes: Optional[bytes] = None
 
     def _stage_and_sum(self) -> BufferType:
-        buf = object_as_bytes(self.obj)
+        if self._probed_bytes is not None:
+            buf: BufferType = self._probed_bytes
+            self._probed_bytes = None
+        else:
+            buf = object_as_bytes(self.obj)
         if self.entry is not None:
+            self.entry.size = len(buf)
             from ..integrity import checksums_enabled, compute_checksum
 
             if checksums_enabled():
@@ -40,10 +88,13 @@ class ObjectBufferStager(BufferStager):
     def get_staging_cost_bytes(self) -> int:
         if self._size_estimate is None:
             try:
-                import sys
-
-                self._size_estimate = max(sys.getsizeof(self.obj), 1024)
-            except TypeError:  # pragma: no cover
+                sink = _CountingSink(limit=_PROBE_CACHE_LIMIT_BYTES)
+                pickle.dump(self.obj, sink, protocol=pickle.HIGHEST_PROTOCOL)
+                self._size_estimate = max(sink.nbytes, 1024)
+                self._probed_bytes = sink.payload()
+            except Exception:
+                # Unpicklable here -> staging will raise the real error;
+                # don't let the cost probe mask it.
                 self._size_estimate = 1024
         return self._size_estimate
 
@@ -74,7 +125,11 @@ class ObjectBufferConsumer(BufferConsumer):
             self._callback(obj)
 
     def get_consuming_cost_bytes(self) -> int:
-        return 1024  # unknown until deserialized; objects are small in practice
+        # The entry records the exact serialized size at stage time; ~2x for
+        # the deserialized object alive alongside the buffer.
+        if self.entry.size is not None:
+            return max(2 * self.entry.size, 1024)
+        return 1024  # legacy manifest without a recorded size
 
 
 class ObjectIOPreparer:

@@ -9,10 +9,20 @@ from typing import Any
 from ..manifest import PrimitiveEntry
 
 
+# str/bytes above this size take the object (storage I/O) path instead of
+# being inlined: the metadata YAML is gathered by every rank and committed
+# by rank 0, so unbounded inlining would bloat the manifest collective.
+_MAX_INLINE_BYTES = 16 * 1024
+
+
 class PrimitivePreparer:
     @staticmethod
     def should_inline(obj: Any) -> bool:
-        return type(obj).__name__ in PrimitiveEntry.supported_types()
+        if type(obj).__name__ not in PrimitiveEntry.supported_types():
+            return False
+        if isinstance(obj, (str, bytes)) and len(obj) > _MAX_INLINE_BYTES:
+            return False
+        return True
 
     @staticmethod
     def prepare_write(obj: Any, replicated: bool = False) -> PrimitiveEntry:

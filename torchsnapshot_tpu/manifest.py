@@ -117,6 +117,7 @@ class ObjectEntry(Entry):
     obj_type: str
     replicated: bool
     checksum: Optional[str] = None  # "<algo>:<hexdigest>" of the payload
+    size: Optional[int] = None  # serialized bytes, recorded at stage time
 
     def __init__(
         self,
@@ -125,6 +126,7 @@ class ObjectEntry(Entry):
         obj_type: str,
         replicated: bool,
         checksum: Optional[str] = None,
+        size: Optional[int] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
@@ -132,6 +134,7 @@ class ObjectEntry(Entry):
         self.obj_type = obj_type
         self.replicated = replicated
         self.checksum = checksum
+        self.size = size
 
 
 _PRIMITIVE_TYPES = ("int", "float", "str", "bool", "bytes", "NoneType")

@@ -19,6 +19,7 @@ tokens per expert * mean router prob per expert) * E.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -70,7 +71,7 @@ def moe_ffn(
     x2 = x.reshape(-1, D)  # (T, D)
     T = x2.shape[0]
     E = params["router"].shape[1]
-    cap = int(max(1, (2 * T * capacity_factor) // E))
+    cap = int(max(1, math.ceil(2 * T * capacity_factor / E)))
 
     logits = (x2 @ params["router"].astype(x.dtype)).astype(jnp.float32)  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)

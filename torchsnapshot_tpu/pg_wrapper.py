@@ -16,14 +16,37 @@ Process identity comes from ``jax.distributed`` when initialized
 (jax.process_index/process_count), or from an explicit ``ProcessGroup``.
 Single-process (the common notebook / single-host case) needs no store and
 all collectives are trivial.
+
+Namespace protocol
+------------------
+Collectives of one wrapper must not collide with another's store keys, and
+all ranks of one logical operation must agree on the namespace. Agreement is
+established by a *lazy handshake* at the wrapper's FIRST collective (never at
+construction): rank 0 allocates a sequence number via an atomic store counter
+and publishes a fresh UUID-derived namespace under ``pgw/handshake/<seq>``;
+other ranks consume handshakes in order (a per-process, per-store cursor).
+Because the handshake is lazy, a wrapper constructed on one rank only (e.g.
+on an exception path) and never used for collectives consumes nothing and
+cannot desynchronize peers — desync requires actual collective divergence,
+the same contract every ordered-collective system (MPI, NCCL) has.
+
+Store hygiene: ``retire()`` marks a wrapper's operation complete on the
+calling rank (a write, never a read — safe as a final act). Rank 0 deletes a
+retired namespace's keys at a later handshake, once every rank has acked, so
+a long-running job snapshotting every N steps keeps the store bounded.
 """
 
 from __future__ import annotations
 
 import pickle
-from typing import Any, List, Optional
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
 
 from .dist_store import TCPStore
+
+_HANDSHAKE_SEQ_KEY = "pgw/seq"
+_HANDSHAKE_PREFIX = "pgw/handshake"
 
 
 class ProcessGroup:
@@ -69,23 +92,33 @@ def get_default_pg() -> Optional[ProcessGroup]:
     return _default_pg
 
 
+# Per-process handshake cursors, keyed by store address: how many handshakes
+# this process has consumed against that store. Only bumped when a wrapper
+# actually performs its first collective.
+_handshake_cursor: Dict[str, int] = {}
+# Rank-0 bookkeeping: (namespace, handshake_seq, world_size) triples this
+# process allocated that have been locally retired and await cross-rank acks
+# before deletion.
+_retired_namespaces: Dict[str, List[tuple]] = {}
+_handshake_lock = threading.Lock()
+
+
 class PGWrapper:
     """The six-method collective surface used by the snapshot orchestrator
     (reference: pg_wrapper.py:15-89 — rank, world, barrier, broadcast_obj,
-    all_gather_obj, scatter_obj)."""
+    all_gather_obj, scatter_obj), plus an error channel: ``report_error``
+    makes every peer blocked in a collective of this wrapper raise instead
+    of timing out."""
 
-    # Process-local instance counter. All ranks construct PGWrappers in the
-    # same program order (the same assumption ordered collectives make), so
-    # the counter yields a consistent cross-rank namespace per wrapper and
-    # successive operations never collide on store keys.
-    _instance_counter = 0
-    _counter_lock = None
-
-    def __init__(self, pg: Optional[ProcessGroup] = None) -> None:
+    def __init__(
+        self, pg: Optional[ProcessGroup] = None, namespace: Optional[str] = None
+    ) -> None:
         self.pg = pg if pg is not None else get_default_pg()
         self._seq = 0
-        PGWrapper._instance_counter += 1
-        self._ns = f"pg{PGWrapper._instance_counter}"
+        # An explicitly agreed namespace skips the handshake entirely.
+        self._ns: Optional[str] = namespace
+        self._handshake_seq: Optional[int] = None
+        self._retired = False
 
     def get_rank(self) -> int:
         return self.pg.rank if self.pg is not None else 0
@@ -97,27 +130,113 @@ class PGWrapper:
         self._seq += 1
         return self._seq
 
+    # -- namespace handshake ----------------------------------------------
+
+    def _namespace(self) -> str:
+        if self._ns is not None:
+            return self._ns
+        store = self.pg.store
+        with _handshake_lock:
+            if self._ns is not None:  # re-check under the lock
+                return self._ns
+            cursor_key = store.addr
+            if self.get_rank() == 0:
+                self._gc_retired(store)
+                seq = store.add(_HANDSHAKE_SEQ_KEY, 1)
+                ns = f"pgw/ns/{seq}-{uuid.uuid4().hex[:8]}"
+                store.set(f"{_HANDSHAKE_PREFIX}/{seq}", ns.encode())
+            else:
+                seq = _handshake_cursor.get(cursor_key, 0) + 1
+                ns = store.get(f"{_HANDSHAKE_PREFIX}/{seq}").decode()
+            _handshake_cursor[cursor_key] = seq
+            self._handshake_seq = seq
+            self._ns = ns
+        return self._ns
+
+    @staticmethod
+    def _gc_retired(store: TCPStore) -> None:
+        """Rank 0 only: delete namespaces whose every rank has acked
+        retirement. Runs at handshake time (never racing an in-flight op of
+        the namespace being deleted: acks are each rank's final write)."""
+        remaining: List[tuple] = []
+        for item in _retired_namespaces.get(store.addr, []):
+            ns, seq, world_size = item
+            acked = all(
+                store.check(f"{ns}/retired/{r}") for r in range(world_size)
+            )
+            if acked:
+                store.delete(f"{_HANDSHAKE_PREFIX}/{seq}")
+                store.delete_prefix(ns)
+            else:
+                remaining.append(item)
+        _retired_namespaces[store.addr] = remaining
+
+    def retire(self) -> None:
+        """Mark this wrapper's operation complete on this rank.
+
+        A pure write (never blocks on peers) — safe as the final act of an
+        operation. Once every rank has retired, rank 0 reclaims the
+        namespace's store keys at a future handshake."""
+        if self._retired or self.get_world_size() == 1 or self._ns is None:
+            return
+        self._retired = True
+        store = self.pg.store
+        store.set(f"{self._ns}/retired/{self.get_rank()}", b"1")
+        if self.get_rank() == 0:
+            # May run on a background (commit) thread while the main thread
+            # garbage-collects under the handshake lock.
+            with _handshake_lock:
+                _retired_namespaces.setdefault(store.addr, []).append(
+                    (self._ns, self._handshake_seq, self.get_world_size())
+                )
+
+    # -- error channel -----------------------------------------------------
+
+    def _error_key(self) -> str:
+        return f"{self._namespace()}/error"
+
+    def report_error(self, err: BaseException) -> None:
+        """Publish an error so peers blocked in this wrapper's collectives
+        raise immediately instead of timing out. No-op if this wrapper never
+        established a namespace (peers can't be waiting on it)."""
+        if self.get_world_size() == 1 or self._ns is None:
+            return
+        try:
+            payload = pickle.dumps(err)
+        except Exception:
+            payload = pickle.dumps(RuntimeError(repr(err)))
+        self.pg.store.set(self._error_key(), payload)
+
+    def _wait(self, key: str) -> bytes:
+        """Wait for ``key``, racing it against the error channel."""
+        got_key, value = self.pg.store.wait_any([key, self._error_key()])
+        if got_key != key:
+            err = pickle.loads(value)
+            raise RuntimeError(
+                "A peer rank reported an error during a collective."
+            ) from err
+        return value
+
     # -- object collectives over the KV store ------------------------------
 
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         if self.get_world_size() == 1:
             return obj
-        store = self.pg.store
-        key = f"{self._ns}/bcast/{self._next_seq()}"
+        ns = self._namespace()
+        key = f"{ns}/bcast/{self._next_seq()}"
         if self.get_rank() == src:
-            store.set(key, pickle.dumps(obj))
+            self.pg.store.set(key, pickle.dumps(obj))
             return obj
-        else:
-            return pickle.loads(store.get(key))
+        return pickle.loads(self._wait(key))
 
     def all_gather_object(self, obj: Any) -> List[Any]:
         if self.get_world_size() == 1:
             return [obj]
-        store = self.pg.store
+        ns = self._namespace()
         seq = self._next_seq()
-        store.set(f"{self._ns}/gather/{seq}/{self.get_rank()}", pickle.dumps(obj))
+        self.pg.store.set(f"{ns}/gather/{seq}/{self.get_rank()}", pickle.dumps(obj))
         return [
-            pickle.loads(store.get(f"{self._ns}/gather/{seq}/{r}"))
+            pickle.loads(self._wait(f"{ns}/gather/{seq}/{r}"))
             for r in range(self.get_world_size())
         ]
 
@@ -125,23 +244,23 @@ class PGWrapper:
         if self.get_world_size() == 1:
             assert objs is not None and len(objs) == 1
             return objs[0]
-        store = self.pg.store
+        ns = self._namespace()
         seq = self._next_seq()
         rank = self.get_rank()
         if rank == src:
             assert objs is not None and len(objs) == self.get_world_size()
             for r, o in enumerate(objs):
-                store.set(f"{self._ns}/scatter/{seq}/{r}", pickle.dumps(o))
+                self.pg.store.set(f"{ns}/scatter/{seq}/{r}", pickle.dumps(o))
             return objs[src]
-        else:
-            return pickle.loads(store.get(f"{self._ns}/scatter/{seq}/{rank}"))
+        return pickle.loads(self._wait(f"{ns}/scatter/{seq}/{rank}"))
 
     def barrier(self) -> None:
         if self.get_world_size() == 1:
             return
+        ns = self._namespace()
         seq = self._next_seq()
         store = self.pg.store
-        arrived = store.add(f"{self._ns}/barrier/{seq}/count", 1)
+        arrived = store.add(f"{ns}/barrier/{seq}/count", 1)
         if arrived == self.get_world_size():
-            store.set(f"{self._ns}/barrier/{seq}/done", b"1")
-        store.get(f"{self._ns}/barrier/{seq}/done")
+            store.set(f"{ns}/barrier/{seq}/done", b"1")
+        self._wait(f"{ns}/barrier/{seq}/done")

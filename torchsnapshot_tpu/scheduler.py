@@ -96,6 +96,99 @@ class _WritePipeline:
         return self
 
 
+class _ProgressReporter:
+    """Periodic pipeline progress tables (reference: _WriteReporter,
+    scheduler.py:96-175): stage counts, bytes staged/written, budget
+    remaining, and RSS delta — the observability needed to diagnose a stall
+    on a real pod save. Runs as an asyncio task on the pipeline's loop;
+    logs at INFO every ``interval_s``."""
+
+    def __init__(
+        self,
+        op: str,
+        rank: int,
+        total: int,
+        budget: "_MemoryBudget",
+        interval_s: float = 5.0,
+    ) -> None:
+        self.op = op
+        self.rank = rank
+        self.total = total
+        self.budget = budget
+        self.interval_s = interval_s
+        self.staged_count = 0
+        self.staged_bytes = 0
+        self.written_count = 0
+        self.written_bytes = 0
+        self.inflight_staging = 0
+        self.inflight_io = 0
+        self._begin = time.monotonic()
+        try:
+            self._rss_begin = psutil.Process().memory_info().rss
+        except Exception:  # pragma: no cover
+            self._rss_begin = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.interval_s)
+                self.log_table()
+        except asyncio.CancelledError:
+            pass
+
+    def log_table(self) -> None:
+        try:
+            rss_delta = psutil.Process().memory_info().rss - self._rss_begin
+        except Exception:  # pragma: no cover
+            rss_delta = 0
+        elapsed = time.monotonic() - self._begin
+        if self.op == "read":
+            # The read pipeline has no staging phase: report in-flight and
+            # consumed counts with read-appropriate wording.
+            logger.info(
+                "[rank %d] read progress +%.0fs | reqs: %d total, %d in "
+                "flight, %d consumed | %.2f GB consumed | budget free "
+                "%.2f/%.2f GB | rss delta %+.2f GB",
+                self.rank,
+                elapsed,
+                self.total,
+                self.inflight_io,
+                self.written_count,
+                self.written_bytes / 1e9,
+                self.budget.available / 1e9,
+                self.budget.budget_bytes / 1e9,
+                rss_delta / 1e9,
+            )
+            return
+        logger.info(
+            "[rank %d] %s progress +%.0fs | reqs: %d total, %d staging, "
+            "%d staged, %d in io, %d written | %.2f GB staged, %.2f GB "
+            "written | budget free %.2f/%.2f GB | rss delta %+.2f GB",
+            self.rank,
+            self.op,
+            elapsed,
+            self.total,
+            self.inflight_staging,
+            self.staged_count,
+            self.inflight_io,
+            self.written_count,
+            self.staged_bytes / 1e9,
+            self.written_bytes / 1e9,
+            self.budget.available / 1e9,
+            self.budget.budget_bytes / 1e9,
+            rss_delta / 1e9,
+        )
+
+
 class _Throughput:
     """Tracks bytes moved + wall time to log MB/s summaries
     (reference: scheduler.py:96-175,441-442)."""
@@ -133,6 +226,7 @@ class PendingIOWork:
         executor: ThreadPoolExecutor,
         throughput: _Throughput,
         event_loop: asyncio.AbstractEventLoop,
+        reporter: Optional[_ProgressReporter] = None,
     ) -> None:
         self._ready_for_io = ready_for_io
         self._io_tasks = io_tasks
@@ -141,20 +235,44 @@ class PendingIOWork:
         self._executor = executor
         self._throughput = throughput
         self._event_loop = event_loop
+        self._reporter = reporter
 
     async def complete(self) -> None:
-        while self._io_tasks or self._ready_for_io:
-            self._dispatch_io()
-            if not self._io_tasks:
-                continue
-            done, pending = await asyncio.wait(
-                self._io_tasks, return_when=asyncio.FIRST_COMPLETED
-            )
-            self._io_tasks = pending
-            for task in done:
-                pipeline = task.result()
-                self._budget.release(pipeline.buf_size_bytes)
-                self._throughput.add(pipeline.buf_size_bytes)
+        reporter = self._reporter
+        if reporter is not None:
+            reporter.start()
+        try:
+            while self._io_tasks or self._ready_for_io:
+                self._dispatch_io()
+                if not self._io_tasks:
+                    continue
+                done, pending = await asyncio.wait(
+                    self._io_tasks, return_when=asyncio.FIRST_COMPLETED
+                )
+                self._io_tasks = pending
+                for task in done:
+                    pipeline = task.result()
+                    self._budget.release(pipeline.buf_size_bytes)
+                    self._throughput.add(pipeline.buf_size_bytes)
+                    if reporter is not None:
+                        reporter.inflight_io -= 1
+                        reporter.written_count += 1
+                        reporter.written_bytes += pipeline.buf_size_bytes
+        except BaseException:
+            # Same cleanup as execute_write_reqs' failure path: a write
+            # failing during the drain must not orphan sibling writes or
+            # leak the executor's threads.
+            for task in self._io_tasks:
+                task.cancel()
+            if self._io_tasks:
+                await asyncio.gather(*self._io_tasks, return_exceptions=True)
+            self._io_tasks = set()
+            self._ready_for_io.clear()
+            self._executor.shutdown(wait=True)
+            raise
+        finally:
+            if reporter is not None:
+                reporter.stop()
         self._executor.shutdown(wait=True)
         self._throughput.log_summary()
 
@@ -167,9 +285,28 @@ class PendingIOWork:
             self._io_tasks.add(
                 self._event_loop.create_task(pipeline.write_buffer(self._storage))
             )
+            if self._reporter is not None:
+                self._reporter.inflight_io += 1
 
     def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
         event_loop.run_until_complete(self.complete())
+
+    async def abort(self) -> None:
+        """Cancel in-flight storage writes and release resources.
+
+        Used when a peer rank's failure aborts the snapshot: without this,
+        dispatched writes keep running unawaited (orphaned partial objects,
+        swallowed I/O errors) and the executor's threads leak."""
+        self._ready_for_io.clear()
+        for task in self._io_tasks:
+            task.cancel()
+        if self._io_tasks:
+            await asyncio.gather(*self._io_tasks, return_exceptions=True)
+        self._io_tasks = set()
+        self._executor.shutdown(wait=True)
+
+    def sync_abort(self, event_loop: asyncio.AbstractEventLoop) -> None:
+        event_loop.run_until_complete(self.abort())
 
 
 class _MemoryBudget:
@@ -194,6 +331,8 @@ async def execute_write_reqs(
     executor = ThreadPoolExecutor(max_workers=_MAX_PER_RANK_CPU_CONCURRENCY)
     budget = _MemoryBudget(memory_budget_bytes)
     throughput = _Throughput("wrote", rank)
+    reporter = _ProgressReporter("write", rank, len(write_reqs), budget)
+    reporter.start()
 
     ready_for_staging = [_WritePipeline(req) for req in write_reqs]
     # Stage large requests first: improves budget packing and overlaps the
@@ -216,32 +355,56 @@ async def execute_write_reqs(
             staging_tasks.add(
                 event_loop.create_task(pipeline.stage_buffer(executor))
             )
+            reporter.inflight_staging += 1
 
     def dispatch_io() -> None:
         while ready_for_io and len(io_tasks) < _MAX_PER_RANK_IO_CONCURRENCY:
             pipeline = ready_for_io.pop(0)
             io_tasks.add(event_loop.create_task(pipeline.write_buffer(storage)))
+            reporter.inflight_io += 1
 
     dispatch_staging()
-    while staging_tasks or ready_for_staging:
-        done, _ = await asyncio.wait(
-            staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
-        )
-        for task in done:
-            if task in staging_tasks:
-                staging_tasks.discard(task)
-                pipeline = task.result()
-                # The staged buffer may be smaller than the staging cost
-                # (e.g. a strided view); release the difference now.
-                budget.release(pipeline.staging_cost_bytes - pipeline.buf_size_bytes)
-                ready_for_io.append(pipeline)
-            elif task in io_tasks:
-                io_tasks.discard(task)
-                pipeline = task.result()
-                budget.release(pipeline.buf_size_bytes)
-                throughput.add(pipeline.buf_size_bytes)
-        dispatch_io()
-        dispatch_staging()
+    try:
+        while staging_tasks or ready_for_staging:
+            done, _ = await asyncio.wait(
+                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task in staging_tasks:
+                    staging_tasks.discard(task)
+                    pipeline = task.result()
+                    # The staged buffer may be smaller than the staging cost
+                    # (e.g. a strided view); release the difference now.
+                    budget.release(
+                        pipeline.staging_cost_bytes - pipeline.buf_size_bytes
+                    )
+                    ready_for_io.append(pipeline)
+                    reporter.inflight_staging -= 1
+                    reporter.staged_count += 1
+                    reporter.staged_bytes += pipeline.buf_size_bytes
+                elif task in io_tasks:
+                    io_tasks.discard(task)
+                    pipeline = task.result()
+                    budget.release(pipeline.buf_size_bytes)
+                    throughput.add(pipeline.buf_size_bytes)
+                    reporter.inflight_io -= 1
+                    reporter.written_count += 1
+                    reporter.written_bytes += pipeline.buf_size_bytes
+            dispatch_io()
+            dispatch_staging()
+    except BaseException:
+        # A staging/I/O failure aborts the snapshot: cancel siblings and
+        # release the executor so repeated failures don't leak threads.
+        reporter.stop()
+        for task in staging_tasks | io_tasks:
+            task.cancel()
+        if staging_tasks or io_tasks:
+            await asyncio.gather(
+                *(staging_tasks | io_tasks), return_exceptions=True
+            )
+        executor.shutdown(wait=True)
+        raise
+    reporter.stop()
 
     return PendingIOWork(
         ready_for_io=ready_for_io,
@@ -251,6 +414,7 @@ async def execute_write_reqs(
         executor=executor,
         throughput=throughput,
         event_loop=event_loop,
+        reporter=reporter,
     )
 
 
@@ -304,6 +468,8 @@ async def execute_read_reqs(
     executor = ThreadPoolExecutor(max_workers=_MAX_PER_RANK_CPU_CONCURRENCY)
     budget = _MemoryBudget(memory_budget_bytes)
     throughput = _Throughput("read", rank)
+    reporter = _ProgressReporter("read", rank, len(read_reqs), budget)
+    reporter.start()
 
     pending = [_ReadPipeline(req) for req in read_reqs]
     pending.sort(key=lambda p: p.consuming_cost_bytes, reverse=True)
@@ -321,17 +487,31 @@ async def execute_read_reqs(
                     pipeline.read_and_consume(storage, executor, throughput)
                 )
             )
+            reporter.inflight_io += 1
 
     dispatch()
-    while inflight or pending:
-        done, inflight_set = await asyncio.wait(
-            inflight, return_when=asyncio.FIRST_COMPLETED
-        )
-        inflight = inflight_set
-        for task in done:
-            pipeline = task.result()
-            budget.release(pipeline.consuming_cost_bytes)
-        dispatch()
+    try:
+        while inflight or pending:
+            done, inflight_set = await asyncio.wait(
+                inflight, return_when=asyncio.FIRST_COMPLETED
+            )
+            inflight = inflight_set
+            for task in done:
+                pipeline = task.result()
+                budget.release(pipeline.consuming_cost_bytes)
+                reporter.inflight_io -= 1
+                reporter.written_count += 1
+                reporter.written_bytes += pipeline.consuming_cost_bytes
+            dispatch()
+    except BaseException:
+        reporter.stop()
+        for task in inflight:
+            task.cancel()
+        if inflight:
+            await asyncio.gather(*inflight, return_exceptions=True)
+        executor.shutdown(wait=True)
+        raise
+    reporter.stop()
 
     executor.shutdown(wait=True)
     throughput.log_summary()

@@ -139,6 +139,12 @@ class Snapshot:
                 cls._write_snapshot_metadata(metadata, storage, event_loop)
             pg_wrapper.barrier()
         finally:
+            # Retire on failure too (a pure non-blocking write): a training
+            # loop that catches failed takes must not leak store keys.
+            try:
+                pg_wrapper.retire()
+            except Exception:
+                pass
             storage.sync_close(event_loop)
             event_loop.close()
         snapshot = cls(path, pg, storage_options)
@@ -308,6 +314,10 @@ class Snapshot:
                 raise stage_exc
             failed = [f"rank {i}: {e}" for i, e in enumerate(peer_errors) if e]
             if failed:
+                # Cancel/drain local in-flight storage writes before raising:
+                # the abort path must leave no orphaned I/O behind.
+                if pending_io_work is not None:
+                    pending_io_work.sync_abort(event_loop)
                 raise RuntimeError(
                     "snapshot aborted — staging failed on peer rank(s): "
                     + "; ".join(failed)
@@ -371,6 +381,10 @@ class Snapshot:
             if exc is not None:
                 raise exc
         finally:
+            try:
+                pg_wrapper.retire()
+            except Exception:
+                pass
             storage.sync_close(event_loop)
             event_loop.close()
 
@@ -825,8 +839,10 @@ class PendingSnapshot:
         if pg_wrapper.get_world_size() > 1:
             # Own store connection: the main thread keeps using the primary.
             store = pg_wrapper.pg.store.clone()
+            # Nested under the wrapper's namespace so the barrier keys are
+            # reclaimed together with it once every rank retires.
             barrier = LinearBarrier(
-                prefix=f"barrier/{barrier_id}",
+                prefix=f"{pg_wrapper._namespace()}/commit/{barrier_id}",
                 store=store,
                 rank=pg_wrapper.get_rank(),
                 world_size=pg_wrapper.get_world_size(),
@@ -851,6 +867,12 @@ class PendingSnapshot:
             self._exc = e
             logger.exception("async_take failed; snapshot was not committed.")
         finally:
+            try:
+                # Final act on this rank: ack namespace retirement so rank 0
+                # can reclaim this operation's store keys later.
+                pg_wrapper.retire()
+            except Exception:
+                pass
             try:
                 storage.sync_close(event_loop)
                 event_loop.close()

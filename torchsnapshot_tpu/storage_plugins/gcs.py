@@ -26,116 +26,23 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import random
 import time
 from typing import Any, Callable, Dict, Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from .retry import CollectiveRetryStrategy, is_transient_error
+
+# Back-compat aliases: the retry machinery moved to .retry when it became
+# shared with the S3 plugin.
+_is_transient = is_transient_error
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_CHUNK_SIZE_BYTES = 100 * 1024 * 1024
-_BASE_BACKOFF_S = 0.5
-_MAX_BACKOFF_S = 8.0
-_STALL_TIMEOUT_S = 120.0
-
-
-def _is_transient(exc: BaseException) -> bool:
-    try:
-        from google.api_core import exceptions as gexc
-
-        transient = (
-            gexc.TooManyRequests,
-            gexc.InternalServerError,
-            gexc.BadGateway,
-            gexc.ServiceUnavailable,
-            gexc.GatewayTimeout,
-            gexc.DeadlineExceeded,
-        )
-        if isinstance(exc, transient):
-            return True
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        import requests.exceptions as rexc
-
-        # requests.exceptions.ConnectionError subclasses OSError, not the
-        # builtin ConnectionError — check it explicitly.
-        if isinstance(exc, (rexc.ConnectionError, rexc.Timeout, rexc.ChunkedEncodingError)):
-            return True
-    except ImportError:  # pragma: no cover
-        pass
-    return isinstance(exc, (ConnectionError, TimeoutError))
-
-
-class CollectiveRetryStrategy:
-    """Shared-deadline retry for a fleet of concurrent transfer coroutines.
-
-    One instance is shared by every transfer of a snapshot. Any coroutine
-    completing a unit of work calls :meth:`report_progress`, pushing the
-    shared deadline out by ``stall_timeout_s``. A coroutine hitting a
-    transient error calls :meth:`backoff_or_raise`: if the fleet as a whole
-    has made progress recently it sleeps (exponential backoff + jitter) and
-    the caller retries; if nothing anywhere has progressed past the shared
-    deadline, the error is re-raised — the service is down, fail fast
-    together rather than each coroutine burning its own full retry budget
-    serially.
-
-    Not thread-safe by design: all coroutines run on one event loop
-    (the scheduler's), so no locking is needed.
-    """
-
-    def __init__(
-        self,
-        stall_timeout_s: float = _STALL_TIMEOUT_S,
-        base_backoff_s: float = _BASE_BACKOFF_S,
-        max_backoff_s: float = _MAX_BACKOFF_S,
-        clock: Callable[[], float] = time.monotonic,
-        sleep: Optional[Callable[[float], Any]] = None,
-    ) -> None:
-        self._stall_timeout_s = stall_timeout_s
-        self._base_backoff_s = base_backoff_s
-        self._max_backoff_s = max_backoff_s
-        self._clock = clock
-        self._sleep = sleep or asyncio.sleep
-        # Armed lazily on first use: arming at construction would count
-        # pre-transfer time (staging, the gap between snapshots) against
-        # the stall budget and fail the first transient error with zero
-        # retries.
-        self._deadline: Optional[float] = None
-
-    def report_progress(self) -> None:
-        self._deadline = self._clock() + self._stall_timeout_s
-
-    def backoff_s(self, attempt: int) -> float:
-        # Cap the exponent before exponentiating: 2**attempt overflows
-        # float conversion near attempt ~1076 in a long-lived retry loop.
-        raw = self._base_backoff_s * (2 ** min(attempt, 16)) * (1.0 + random.random())
-        return min(raw, self._max_backoff_s)
-
-    async def backoff_or_raise(
-        self,
-        exc: BaseException,
-        attempt: int,
-        op_started_at: Optional[float] = None,
-    ) -> None:
-        """``op_started_at``: when this attempt began. An attempt that
-        *started* before the deadline lapsed gets one more retry even if it
-        ran long — time spent inside an active transfer is not a stall."""
-        if self._deadline is None:
-            self._deadline = self._clock() + self._stall_timeout_s
-        elif self._clock() > self._deadline and (
-            op_started_at is None or op_started_at > self._deadline
-        ):
-            logger.error(
-                "No transfer progressed for %.0fs; giving up: %s",
-                self._stall_timeout_s,
-                exc,
-            )
-            raise exc
-        backoff = self.backoff_s(attempt)
-        logger.warning("Transient storage error (%s); retrying in %.1fs", exc, backoff)
-        await self._sleep(backoff)
+# Concurrent ranged-chunk GETs per entry: single-large-entry restores are
+# otherwise bounded by one HTTP stream (cross-entry concurrency alone
+# doesn't help a 10 GB single-tensor load).
+_RANGED_READ_CONCURRENCY = 4
 
 
 class GCSStoragePlugin(StoragePlugin):
@@ -148,6 +55,9 @@ class GCSStoragePlugin(StoragePlugin):
         self.retry_strategy: CollectiveRetryStrategy = options.get(
             "retry_strategy"
         ) or CollectiveRetryStrategy()
+        # A plugin is constructed per snapshot operation: a strategy reused
+        # across operations must not inherit the previous fleet's deadline.
+        self.retry_strategy.reset()
         self.bucket = options.get("bucket") or self._make_bucket(
             bucket_name, options
         )
@@ -220,25 +130,43 @@ class GCSStoragePlugin(StoragePlugin):
 
         lo, hi = read_io.byte_range
         out = bytearray(hi - lo)
+        ranges = []
         pos = lo
         while pos < hi:
-            chunk_hi = min(pos + self.chunk_size_bytes, hi)
+            ranges.append((pos, min(pos + self.chunk_size_bytes, hi)))
+            pos = ranges[-1][1]
 
-            def download(p: int = pos, q: int = chunk_hi) -> bytes:
+        # Fetch chunks concurrently (bounded): a single large entry is no
+        # longer limited to one stream's throughput.
+        sem = asyncio.Semaphore(_RANGED_READ_CONCURRENCY)
+
+        async def fetch(p: int, q: int) -> None:
+            def download() -> bytes:
                 # GCS byte ranges are end-inclusive.
                 return blob.download_as_bytes(start=p, end=q - 1)
 
-            chunk = await self._retrying(download)
-            if len(chunk) != chunk_hi - pos:
+            async with sem:
+                chunk = await self._retrying(download)
+            if len(chunk) != q - p:
                 # A short ranged response means the object changed or was
                 # truncated mid-read; silently zero-filling the gap would
                 # corrupt restored data.
                 raise IOError(
                     f"short read on {read_io.path}: got {len(chunk)} bytes "
-                    f"for range [{pos}, {chunk_hi})"
+                    f"for range [{p}, {q})"
                 )
-            out[pos - lo : pos - lo + len(chunk)] = chunk
-            pos = chunk_hi
+            out[p - lo : p - lo + len(chunk)] = chunk
+
+        tasks = [asyncio.ensure_future(fetch(p, q)) for p, q in ranges]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # Cancel sibling fetches (and their retry/backoff loops) on the
+            # first failure instead of letting them run unawaited.
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
         read_io.buf = out
 
     async def delete(self, path: str) -> None:

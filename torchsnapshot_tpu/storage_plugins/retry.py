@@ -1,0 +1,164 @@
+"""Shared cloud-storage retry machinery (reference: _RetryStrategy,
+storage_plugins/gcs.py:214-270).
+
+Transport-agnostic: used by both the GCS and S3 plugins. One
+:class:`CollectiveRetryStrategy` instance is shared by every transfer
+coroutine of a snapshot operation; see the class docstring for the
+fleet-deadline semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+BASE_BACKOFF_S = 0.5
+MAX_BACKOFF_S = 8.0
+STALL_TIMEOUT_S = 120.0
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """Classify transport errors worth retrying: 429/5xx-style service
+    hiccups, connection and timeout failures. Everything else (permission
+    denied, not found, invalid request) propagates immediately."""
+    try:
+        from google.api_core import exceptions as gexc
+
+        transient = (
+            gexc.TooManyRequests,
+            gexc.InternalServerError,
+            gexc.BadGateway,
+            gexc.ServiceUnavailable,
+            gexc.GatewayTimeout,
+            gexc.DeadlineExceeded,
+        )
+        if isinstance(exc, transient):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        import requests.exceptions as rexc
+
+        # requests.exceptions.ConnectionError subclasses OSError, not the
+        # builtin ConnectionError — check it explicitly.
+        if isinstance(
+            exc, (rexc.ConnectionError, rexc.Timeout, rexc.ChunkedEncodingError)
+        ):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        import botocore.exceptions as bexc
+
+        if isinstance(
+            exc,
+            (
+                bexc.ConnectionError,
+                bexc.HTTPClientError,
+                bexc.ReadTimeoutError,
+                bexc.ConnectTimeoutError,
+            ),
+        ):
+            return True
+        if isinstance(exc, bexc.ClientError):
+            code = (
+                exc.response.get("ResponseMetadata", {}).get("HTTPStatusCode", 0)
+                if getattr(exc, "response", None)
+                else 0
+            )
+            if code == 429 or 500 <= code < 600:
+                return True
+            if exc.response.get("Error", {}).get("Code") in (
+                "SlowDown",
+                "RequestTimeout",
+                "InternalError",
+                "ServiceUnavailable",
+            ):
+                return True
+    except ImportError:  # pragma: no cover
+        pass
+    return isinstance(exc, (ConnectionError, TimeoutError))
+
+
+class CollectiveRetryStrategy:
+    """Shared-deadline retry for a fleet of concurrent transfer coroutines.
+
+    One instance is shared by every transfer of a snapshot. Any coroutine
+    completing a unit of work calls :meth:`report_progress`, pushing the
+    shared deadline out by ``stall_timeout_s``. A coroutine hitting a
+    transient error calls :meth:`backoff_or_raise`: if the fleet as a whole
+    has made progress recently it sleeps (exponential backoff + jitter) and
+    the caller retries; if nothing anywhere has progressed past the shared
+    deadline, the error is re-raised — the service is down, fail fast
+    together rather than each coroutine burning its own full retry budget
+    serially.
+
+    Not thread-safe by design: all coroutines run on one event loop
+    (the scheduler's), so no locking is needed.
+    """
+
+    def __init__(
+        self,
+        stall_timeout_s: float = STALL_TIMEOUT_S,
+        base_backoff_s: float = BASE_BACKOFF_S,
+        max_backoff_s: float = MAX_BACKOFF_S,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Optional[Callable[[float], Any]] = None,
+    ) -> None:
+        self._stall_timeout_s = stall_timeout_s
+        self._base_backoff_s = base_backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._clock = clock
+        self._sleep = sleep or asyncio.sleep
+        # Armed lazily on first use: arming at construction would count
+        # pre-transfer time (staging, the gap between snapshots) against
+        # the stall budget and fail the first transient error with zero
+        # retries.
+        self._deadline: Optional[float] = None
+
+    def report_progress(self) -> None:
+        self._deadline = self._clock() + self._stall_timeout_s
+
+    def reset(self) -> None:
+        """Disarm the shared deadline for a new transfer fleet.
+
+        An instance reused across snapshots (via storage_options) would
+        otherwise carry the previous fleet's deadline: after an idle gap
+        longer than the stall timeout, the first transient error of the next
+        snapshot would raise with zero retries."""
+        self._deadline = None
+
+    def backoff_s(self, attempt: int) -> float:
+        # Cap the exponent before exponentiating: 2**attempt overflows
+        # float conversion near attempt ~1076 in a long-lived retry loop.
+        raw = self._base_backoff_s * (2 ** min(attempt, 16)) * (1.0 + random.random())
+        return min(raw, self._max_backoff_s)
+
+    async def backoff_or_raise(
+        self,
+        exc: BaseException,
+        attempt: int,
+        op_started_at: Optional[float] = None,
+    ) -> None:
+        """``op_started_at``: when this attempt began. An attempt that
+        *started* before the deadline lapsed gets one more retry even if it
+        ran long — time spent inside an active transfer is not a stall."""
+        if self._deadline is None:
+            self._deadline = self._clock() + self._stall_timeout_s
+        elif self._clock() > self._deadline and (
+            op_started_at is None or op_started_at > self._deadline
+        ):
+            logger.error(
+                "No transfer progressed for %.0fs; giving up: %s",
+                self._stall_timeout_s,
+                exc,
+            )
+            raise exc
+        backoff = self.backoff_s(attempt)
+        logger.warning("Transient storage error (%s); retrying in %.1fs", exc, backoff)
+        await self._sleep(backoff)

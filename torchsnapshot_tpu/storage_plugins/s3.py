@@ -1,49 +1,91 @@
 """S3 storage plugin (reference: storage_plugins/s3.py:15-70).
 
-Uses boto3 (if installed) driven through the event loop's executor; ranged
-GETs use the HTTP Range header. Staged memoryviews are streamed via
-MemoryviewStream without copying.
+boto3's sync client driven through the event loop's executor; ranged GETs
+use the HTTP Range header (reference: s3.py:53-60). Staged memoryviews are
+streamed via MemoryviewStream without copying (reference: s3.py:38-39).
+
+Beyond the reference: transfers run under the same
+:class:`~.retry.CollectiveRetryStrategy` as the GCS plugin — transient
+errors (throttling, 5xx, connection resets) retry with fleet-shared stall
+detection, and a retried upload rewinds its stream before resending.
+
+A pre-built client can be injected via ``storage_options={"client": ...}``
+(used by the fake-backed tests, mirroring the GCS plugin's ``bucket``
+injection).
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from .retry import CollectiveRetryStrategy, is_transient_error
 
 
 class S3StoragePlugin(StoragePlugin):
     def __init__(self, root: str, storage_options: Optional[Dict[str, Any]] = None):
+        options = storage_options or {}
+        self.bucket, _, self.prefix = root.partition("/")
+        self.retry_strategy: CollectiveRetryStrategy = options.get(
+            "retry_strategy"
+        ) or CollectiveRetryStrategy()
+        # A plugin is constructed per snapshot operation: a strategy reused
+        # across operations must not inherit the previous fleet's deadline.
+        self.retry_strategy.reset()
+        self.client = options.get("client") or self._make_client(options)
+
+    @staticmethod
+    def _make_client(options: Dict[str, Any]):
         try:
             import boto3
         except ImportError as e:
             raise RuntimeError(
                 "S3 support requires the boto3 package (not installed in this "
-                "environment). Install boto3 or use fs:// / gs:// storage."
+                "environment). Install boto3, pass a client via "
+                "storage_options={'client': ...}, or use fs:// / gs:// storage."
             ) from e
-        self.bucket, _, self.prefix = root.partition("/")
-        options = storage_options or {}
-        self.client = boto3.client("s3", **options.get("client_options", {}))
+        return boto3.client("s3", **options.get("client_options", {}))
 
     def _key(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
+    async def _retrying(self, fn: Callable[[], Any]) -> Any:
+        """Run blocking ``fn`` in the loop executor under the collective
+        retry strategy; successful completion reports fleet progress."""
+        loop = asyncio.get_running_loop()
+        attempt = 0
+        while True:
+            started = time.monotonic()
+            try:
+                result = await loop.run_in_executor(None, fn)
+                self.retry_strategy.report_progress()
+                return result
+            except BaseException as e:  # noqa: B036
+                if not is_transient_error(e):
+                    raise
+                await self.retry_strategy.backoff_or_raise(
+                    e, attempt, op_started_at=started
+                )
+                attempt += 1
+
     async def write(self, write_io: WriteIO) -> None:
         from ..memoryview_stream import MemoryviewStream
 
-        loop = asyncio.get_running_loop()
-        # stream without copying — bytearray slabs included
-        body: Any = MemoryviewStream(memoryview(write_io.buf))
-        await loop.run_in_executor(
-            None,
-            lambda: self.client.put_object(
-                Bucket=self.bucket, Key=self._key(write_io.path), Body=body
-            ),
-        )
+        # Stream without copying — bytearray slabs included.
+        stream = MemoryviewStream(memoryview(write_io.buf))
+        key = self._key(write_io.path)
+
+        def put() -> None:
+            # Rewind before every attempt: a failed attempt may have
+            # consumed part of the stream (upload-recovery rewind).
+            stream.seek(0)
+            self.client.put_object(Bucket=self.bucket, Key=key, Body=stream)
+
+        await self._retrying(put)
 
     async def read(self, read_io: ReadIO) -> None:
-        loop = asyncio.get_running_loop()
         kwargs: Dict[str, Any] = {
             "Bucket": self.bucket,
             "Key": self._key(read_io.path),
@@ -56,15 +98,22 @@ class S3StoragePlugin(StoragePlugin):
         def get() -> bytes:
             return self.client.get_object(**kwargs)["Body"].read()
 
-        read_io.buf = await loop.run_in_executor(None, get)  # uncopied bytes
+        buf = await self._retrying(get)
+        if read_io.byte_range is not None:
+            lo, hi = read_io.byte_range
+            if len(buf) != hi - lo:
+                # A short ranged response means the object changed or was
+                # truncated mid-read; zero-filling would corrupt data.
+                raise IOError(
+                    f"short read on {read_io.path}: got {len(buf)} bytes "
+                    f"for range [{lo}, {hi})"
+                )
+        read_io.buf = buf  # uncopied bytes
 
     async def delete(self, path: str) -> None:
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
-            None,
-            lambda: self.client.delete_object(
-                Bucket=self.bucket, Key=self._key(path)
-            ),
+        key = self._key(path)
+        await self._retrying(
+            lambda: self.client.delete_object(Bucket=self.bucket, Key=key)
         )
 
     async def close(self) -> None:
