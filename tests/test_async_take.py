@@ -17,8 +17,10 @@ from torchsnapshot_tpu.test_utils import run_with_subprocesses
 
 
 class SlowFSStoragePlugin(FSStoragePlugin):
+    WRITE_DELAY_S = 1.0
+
     async def write(self, write_io: WriteIO) -> None:
-        await asyncio.sleep(0.3)
+        await asyncio.sleep(self.WRITE_DELAY_S)
         await super().write(write_io)
 
 
@@ -40,8 +42,10 @@ def test_async_take_completes(tmp_path, monkeypatch) -> None:
     returned_after = time.monotonic() - t0
     snapshot = pending.wait()
     assert pending.done()
-    # the slow write (0.3s) must not have blocked the caller
-    assert returned_after < 0.3
+    # The slow write must not have blocked the caller. Cold-start overhead
+    # (first event loop, thread pools) can cost a few hundred ms on its own,
+    # so the bound is a margin below the write delay, not near-zero.
+    assert returned_after < SlowFSStoragePlugin.WRITE_DELAY_S * 0.9
     dst = StateDict(w=np.zeros(1000, dtype=np.float32))
     snapshot.restore({"m": dst})
     np.testing.assert_array_equal(dst["w"], app_state["m"]["w"])

@@ -47,19 +47,44 @@ def test_flash_bf16() -> None:
     )
 
 
-def test_flash_gradients_match_dense() -> None:
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("blocks", [(16, 16), (16, 32), (32, 16)])
+def test_flash_gradients_match_dense(causal: bool, blocks) -> None:
+    """Backward runs through the Pallas dq / dkv kernels (not recompute)."""
+    bq, bk = blocks
     q, k, v = make_qkv(seed=3)
 
     def loss_f(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, block_q=16, block_k=16) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk) ** 2
+        )
 
     def loss_d(q, k, v):
-        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
 
     g_f = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
     g_d = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
     for gf, gd in zip(g_f, g_d):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), atol=1e-4)
+
+
+def test_flash_gradients_bf16() -> None:
+    q, k, v = make_qkv(seed=6, dtype=jnp.bfloat16)
+
+    def loss_f(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, block_q=16, block_k=16).astype(jnp.float32) ** 2
+        )
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    g_f = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_f, g_d):
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gd, np.float32), atol=0.1
+        )
 
 
 def test_flash_indivisible_raises() -> None:

@@ -10,6 +10,7 @@ so the checkpointing layer has real context-parallel state to snapshot.
 """
 
 from .attention import blockwise_attention, dense_attention
+from .moe import moe_ffn, moe_ffn_sharded
 from .pallas_attention import flash_attention
 from .ring_attention import ring_attention_sharded, ring_self_attention
 from .ulysses import ulysses_attention_sharded, ulysses_self_attention
@@ -18,6 +19,8 @@ __all__ = [
     "blockwise_attention",
     "dense_attention",
     "flash_attention",
+    "moe_ffn",
+    "moe_ffn_sharded",
     "ring_attention_sharded",
     "ring_self_attention",
     "ulysses_attention_sharded",

@@ -1,12 +1,30 @@
 """Mixture-of-experts FFN with expert parallelism (ep).
 
 GShard-style top-2 routing with static capacity: every shape is fixed at
-trace time (capacity-bounded dispatch via one-hot einsums — no dynamic
-gather/scatter, which XLA cannot tile onto the MXU), so the whole layer
-jits cleanly and the expert dimension shards over a mesh axis with GSPMD
-inserting the all-to-alls. Overflowing tokens are dropped (their FFN
-output is zero and the residual carries them), the standard capacity
-trade-off.
+trace time, so the whole layer jits cleanly and the expert dimension
+shards over a mesh axis. Overflowing tokens are dropped (their FFN output
+is zero and the residual carries them), the standard capacity trade-off.
+
+Two dispatch strategies, same routing semantics:
+
+- ``einsum``: the (T, E, capacity) one-hot dispatch/combine tensors of the
+  GShard paper. All-matmul (MXU-friendly) but the dispatch tensor is
+  O(T * E * cap) ~ O(T^2 * capacity_factor) memory — fine for small T*E,
+  a blow-up at scale.
+- ``sort``: tokens are stably argsorted by expert id; position-in-expert
+  falls out of the sorted order (arange minus each expert's start offset),
+  and dispatch/combine are a 1-D scatter-add / gather of rows. O(T*K)
+  memory, no quadratic tensor. Priority matches the einsum path exactly
+  (all top-1 claims fill capacity before any top-2 claim, in token order),
+  so both paths route identically.
+
+``moe_ffn`` picks per size (``dispatch="auto"``). ``moe_ffn_sharded`` is
+the explicit expert-parallel path: tokens sharded over the expert mesh
+axis, each device sort-dispatches its local tokens into per-expert
+buffers, one ``lax.all_to_all`` swaps buffers so every device holds its
+experts' tokens, local expert FFNs run, and the reverse all-to-all brings
+outputs home for the gather-combine. Capacity is per sending device, so
+buffer shapes stay static regardless of routing skew.
 
 The expert-stacked weights (E, D, F)/(E, F, D) shard over the 'model' axis
 by default — expert parallelism at the state-dict level is just another
@@ -24,6 +42,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# Above this many elements in the (T, E, cap) dispatch tensor, "auto"
+# switches to the sort-based dispatch (2**22 f32 elements = 16 MB).
+_EINSUM_DISPATCH_MAX_ELEMENTS = 1 << 22
 
 
 def init_moe_params(
@@ -54,41 +76,33 @@ def moe_param_specs(expert_axis: Optional[str] = "model") -> Dict[str, Any]:
     }
 
 
-def moe_ffn(
-    params: Dict[str, Any],
-    x: jax.Array,
-    *,
-    capacity_factor: float = 1.25,
-    activation=jax.nn.gelu,
-) -> Tuple[jax.Array, jax.Array]:
-    """Top-2 MoE FFN. ``x: (..., T, D)`` -> (same shape, aux_loss scalar).
-
-    Leading dims are flattened into one token axis for routing; capacity is
-    per expert: ceil(2 * T / E * capacity_factor).
-    """
-    orig_shape = x.shape
-    D = orig_shape[-1]
-    x2 = x.reshape(-1, D)  # (T, D)
-    T = x2.shape[0]
-    E = params["router"].shape[1]
-    cap = int(max(1, math.ceil(2 * T * capacity_factor / E)))
-
-    logits = (x2 @ params["router"].astype(x.dtype)).astype(jnp.float32)  # (T, E)
+def _top2_route(x2: jax.Array, router: jax.Array):
+    """Top-2 routing. Returns (e1, e2 int32 (T,), g1, g2 f32 renormalized
+    gates (T,), probs f32 (T, E))."""
+    E = router.shape[1]
+    logits = (x2 @ router.astype(x2.dtype)).astype(jnp.float32)  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-
-    # Top-2 expert choice per token.
     g1 = jnp.max(probs, axis=-1)
     e1 = jnp.argmax(probs, axis=-1)
     probs_wo1 = probs - jax.nn.one_hot(e1, E) * probs
     g2 = jnp.max(probs_wo1, axis=-1)
     e2 = jnp.argmax(probs_wo1, axis=-1)
-    # Renormalize the two gates.
     denom = g1 + g2 + 1e-9
-    g1, g2 = g1 / denom, g2 / denom
+    return e1, e2, g1 / denom, g2 / denom, probs
 
-    # Position of each token within its expert's capacity buffer (by token
-    # order — deterministic). Overflowing tokens get pos >= cap and a zero
-    # dispatch mask.
+
+def _aux_loss(e1: jax.Array, probs: jax.Array) -> jax.Array:
+    """Switch-style load-balancing loss from top-1 assignments."""
+    E = probs.shape[-1]
+    frac_tokens = jnp.mean(jax.nn.one_hot(e1, E, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    return (jnp.sum(frac_tokens * frac_probs) * E).astype(jnp.float32)
+
+
+def _einsum_dispatch(x2, e1, e2, g1, g2, E, cap):
+    """GShard one-hot dispatch: (E, cap, D) buffers + (T, E, cap) combine."""
+    T = x2.shape[0]
+
     def dispatch(e, g, prior_load):
         onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)  # (T, E)
         pos = jnp.cumsum(onehot, axis=0) - 1 + prior_load[None, :]
@@ -105,19 +119,204 @@ def moe_ffn(
     load0 = jnp.zeros((E,), jnp.int32)
     disp1, g1k, load1 = dispatch(e1, g1, load0)
     disp2, g2k, _ = dispatch(e2, g2, load1)
-
     combine = disp1 * g1k[:, None, None] + disp2 * g2k[:, None, None]  # (T,E,cap)
-    dispatch_mask = (combine > 0).astype(x.dtype)
+    dispatch_mask = (combine > 0).astype(x2.dtype)
+    # precision=HIGHEST: the mask is 0/1, so this einsum is a permutation,
+    # not arithmetic — default TPU bf16 matmul precision would round the
+    # dispatched activations and make the two dispatch paths diverge.
+    xe = jnp.einsum(
+        "td,tec->ecd", x2, dispatch_mask, precision=jax.lax.Precision.HIGHEST
+    )  # (E,cap,D)
+    return xe, combine
 
-    # Route tokens to expert buffers, run the expert FFNs, combine back.
-    xe = jnp.einsum("td,tec->ecd", x2.astype(x.dtype), dispatch_mask)  # (E,cap,D)
-    h = activation(jnp.einsum("ecd,edf->ecf", xe, params["w_in"].astype(x.dtype)))
-    ye = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(x.dtype))
-    y = jnp.einsum("ecd,tec->td", ye, combine.astype(x.dtype))  # (T, D)
 
-    # Switch-style load-balancing aux loss.
-    frac_tokens = jnp.mean(jax.nn.one_hot(e1, E, dtype=jnp.float32), axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
-    aux_loss = jnp.sum(frac_tokens * frac_probs) * E
+def _sort_dispatch(x2, e1, e2, E, cap):
+    """Sort-based dispatch: (E, cap, D) buffers + per-slot buffer rows.
 
-    return y.reshape(orig_shape), aux_loss.astype(jnp.float32)
+    Tokens are stably argsorted by expert id in slot-major order (all top-1
+    claims, by token id, then all top-2 claims), so position-in-expert is
+    just ``arange - expert_start`` over the sorted sequence — identical
+    priority to the einsum path's cumsum-with-prior-load, without the
+    (T, E, cap) tensor. Returns ``(xe, dest)`` where ``dest: (T, 2)`` maps
+    each (token, choice) slot to its row in the flattened (E*cap) buffer,
+    or to E*cap (a zero pad row) when the slot overflowed capacity.
+    """
+    T, D = x2.shape
+    flat_e = jnp.concatenate([e1, e2])  # (2T,) slot-major
+    flat_t = jnp.tile(jnp.arange(T), 2)
+    order = jnp.argsort(flat_e, stable=True)
+    se = flat_e[order]
+    st = flat_t[order]
+    counts = jnp.bincount(flat_e, length=E)  # (E,)
+    start = jnp.cumsum(counts) - counts  # exclusive prefix sum
+    pos = jnp.arange(2 * T) - start[se]  # position within expert run
+    dest_sorted = jnp.where(pos < cap, se * cap + pos, E * cap)
+    # Scatter kept tokens into expert buffers; overflow rows (index E*cap)
+    # fall off the end and are dropped.
+    xe = (
+        jnp.zeros((E * cap, D), x2.dtype)
+        .at[dest_sorted]
+        .add(x2[st], mode="drop")
+        .reshape(E, cap, D)
+    )
+    # Invert the sort so each original slot knows its buffer row.
+    dest = jnp.zeros((2 * T,), jnp.int32).at[order].set(dest_sorted)
+    return xe, dest.reshape(2, T).T  # (T, 2)
+
+
+def _sort_combine(ye, dest, g1, g2, dtype):
+    """Gather each token's (up to) two expert outputs and gate-sum them."""
+    E_cap, D = ye.shape[0] * ye.shape[1], ye.shape[2]
+    # Pad row E*cap is zero — dropped slots contribute nothing.
+    ye_pad = jnp.concatenate(
+        [ye.reshape(E_cap, D), jnp.zeros((1, D), ye.dtype)], axis=0
+    )
+    y = (
+        ye_pad[dest[:, 0]] * g1[:, None].astype(dtype)
+        + ye_pad[dest[:, 1]] * g2[:, None].astype(dtype)
+    )
+    return y
+
+
+def _expert_ffn(params, xe, activation, dtype):
+    """(E, cap, D) -> (E, cap, D) through the per-expert FFNs."""
+    h = activation(jnp.einsum("ecd,edf->ecf", xe, params["w_in"].astype(dtype)))
+    return jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(dtype))
+
+
+def moe_ffn(
+    params: Dict[str, Any],
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    activation=jax.nn.gelu,
+    dispatch: str = "auto",
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-2 MoE FFN. ``x: (..., T, D)`` -> (same shape, aux_loss scalar).
+
+    Leading dims are flattened into one token axis for routing; capacity is
+    per expert: ceil(2 * T / E * capacity_factor). ``dispatch`` is
+    ``"einsum"`` (GShard one-hot, all-matmul), ``"sort"`` (argsort +
+    scatter/gather, no (T, E, cap) tensor), or ``"auto"`` (einsum while the
+    dispatch tensor stays small). Both dispatches route identically.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    x2 = x.reshape(-1, D)  # (T, D)
+    T = x2.shape[0]
+    E = params["router"].shape[1]
+    cap = int(max(1, math.ceil(2 * T * capacity_factor / E)))
+    if dispatch == "auto":
+        dispatch = (
+            "einsum" if T * E * cap <= _EINSUM_DISPATCH_MAX_ELEMENTS else "sort"
+        )
+    if dispatch not in ("einsum", "sort"):
+        raise ValueError(f"unknown dispatch {dispatch!r}")
+
+    e1, e2, g1, g2, probs = _top2_route(x2, params["router"])
+
+    if dispatch == "einsum":
+        xe, combine = _einsum_dispatch(x2, e1, e2, g1, g2, E, cap)
+        ye = _expert_ffn(params, xe, activation, x.dtype)
+        # HIGHEST precision for the same reason as the dispatch einsum: the
+        # combine tensor is a gated permutation, not a real matmul.
+        y = jnp.einsum(
+            "ecd,tec->td", ye, combine.astype(x.dtype),
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (T, D)
+    else:
+        xe, dest = _sort_dispatch(x2, e1, e2, E, cap)
+        ye = _expert_ffn(params, xe, activation, x.dtype)
+        y = _sort_combine(ye, dest, g1, g2, x.dtype)
+
+    return y.reshape(orig_shape), _aux_loss(e1, probs)
+
+
+def moe_ffn_sharded(
+    params: Dict[str, Any],
+    x: jax.Array,
+    mesh,
+    *,
+    expert_axis: str = "model",
+    capacity_factor: float = 1.25,
+    activation=jax.nn.gelu,
+) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel top-2 MoE FFN with explicit all-to-all dispatch.
+
+    ``x: (T, D)`` with T sharded over ``expert_axis``; expert-stacked
+    weights sharded over the same axis (``moe_param_specs``). Each device
+    routes its local tokens, sort-dispatches them into (E, cap_local, D)
+    buffers, and one ``lax.all_to_all`` swaps buffers so each device holds
+    the tokens bound for its E/n local experts; after the local expert
+    FFNs, the reverse all-to-all brings outputs home for the combine.
+    Capacity is per *sending* device (cap_local = ceil(2 * T_local * cf /
+    E)), so buffer shapes are static and per-device memory is O(T_local) —
+    routing skew costs drops, never memory.
+
+    Semantically equivalent to ``moe_ffn`` except capacity is accounted
+    per device rather than globally (with ample ``capacity_factor`` the
+    outputs match exactly).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    import inspect
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    # The replication-check kwarg was renamed check_rep -> check_vma.
+    _check_kwarg = (
+        "check_vma"
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else "check_rep"
+    )
+
+    n_dev = mesh.shape[expert_axis]
+    E = params["router"].shape[1]
+    if E % n_dev:
+        raise ValueError(f"n_experts {E} not divisible by mesh axis {n_dev}")
+    T, D = x.shape
+    if T % n_dev:
+        raise ValueError(f"token count {T} not divisible by mesh axis {n_dev}")
+    cap_l = int(max(1, math.ceil(2 * (T // n_dev) * capacity_factor / E)))
+
+    param_specs = {
+        "router": P(None, None),
+        "w_in": P(expert_axis, None, None),
+        "w_out": P(expert_axis, None, None),
+    }
+
+    def block(params, x_l):
+        # x_l: (T_l, D); w_in/w_out: (E_l, ...) local experts.
+        e1, e2, g1, g2, probs = _top2_route(x_l, params["router"])
+        xe, dest = _sort_dispatch(x_l, e1, e2, E, cap_l)  # (E, cap_l, D)
+        # Swap: every device sends each destination device its tokens for
+        # that device's experts; receives (E_l, n_dev * cap_l, D).
+        xe = jax.lax.all_to_all(
+            xe, expert_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+        ye = _expert_ffn(params, xe, activation, x_l.dtype)
+        ye = jax.lax.all_to_all(
+            ye, expert_axis, split_axis=1, concat_axis=0, tiled=True
+        )  # back to (E, cap_l, D), this device's tokens
+        y_l = _sort_combine(ye, dest, g1, g2, x_l.dtype)
+        # Aux loss over the global batch: the per-expert fractions are
+        # means over ALL tokens, so pmean each factor before the product —
+        # pmean of the per-device products would be a different statistic.
+        frac_tokens = jax.lax.pmean(
+            jnp.mean(jax.nn.one_hot(e1, E, dtype=jnp.float32), axis=0),
+            expert_axis,
+        )
+        frac_probs = jax.lax.pmean(jnp.mean(probs, axis=0), expert_axis)
+        aux = (jnp.sum(frac_tokens * frac_probs) * E).astype(jnp.float32)
+        return y_l, aux
+
+    y, aux = shard_map(
+        block,
+        mesh=mesh,
+        in_specs=(param_specs, P(expert_axis, None)),
+        out_specs=(P(expert_axis, None), P()),
+        **{_check_kwarg: False},
+    )(params, x)
+    return y, aux

@@ -8,12 +8,16 @@ MXU, and carries the (acc, m, l) softmax state in registers — the score
 matrix never touches HBM. Causal programs skip K blocks entirely above the
 diagonal (not just mask them), so the causal kernel does ~half the FLOPs.
 
-Backward: the kernel is wrapped in a custom VJP whose backward pass
-recomputes through the pure-JAX blockwise implementation (standard
-recompute-in-bwd; the fwd stays on the fast kernel path, autodiff
-correctness comes from JAX).
+Backward is also a pair of Pallas kernels (flash-attention backward with
+the standard recompute-p-blocks-in-VMEM scheme): the forward additionally
+emits the per-row log-sum-exp, and the backward recomputes each softmax
+block from (q, k, lse) next to the MXU — dq in a kernel gridded over
+q-blocks streaming K/V, dk/dv in a kernel gridded over k-blocks streaming
+Q/dO. Like the forward, the causal variants skip fully-masked blocks
+rather than masking them. In training, backward is ~2/3 of attention
+FLOPs, so keeping it on the kernel path matters as much as the forward.
 
-On non-TPU backends the kernel runs in Pallas interpret mode (tests), or
+On non-TPU backends the kernels run in Pallas interpret mode (tests), or
 callers can just use blockwise_attention.
 """
 
@@ -25,12 +29,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .attention import blockwise_attention
-
 NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal, scale, seq_len):
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, scale, seq_len):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)  # q-block index within the sequence
@@ -80,64 +82,240 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal, scale, seq_
     l = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc, m, l))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # Per-row log-sum-exp (of the scaled scores): the backward kernels
+    # recompute softmax blocks as exp(s - lse) without re-running the
+    # online max/sum scan.
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+    *, block_q, block_k, causal, scale, seq_len,
+):
+    """dq for one (batch*head, q-block) tile, streaming K/V blocks.
+
+    ds = p * (dp - delta) with p = exp(s - lse), dp = dO @ V^T,
+    delta = rowsum(dO * O); dq = scale * sum_blocks ds @ K.
+    """
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    g = g_ref[0].astype(jnp.float32)  # (block_q, D)
+    lse = lse_ref[0]  # (block_q, 1)
+    delta = delta_ref[0]  # (block_q, 1)
+
+    n_k_blocks = seq_len // block_k
+    if causal:
+        q_end = (qi + 1) * block_q
+        n_k = jnp.minimum(jax.lax.div(q_end + block_k - 1, block_k), n_k_blocks)
+    else:
+        n_k = n_k_blocks
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # masked entries underflow to 0
+        dp = jax.lax.dot_general(
+            g, v_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, n_k, body, dq)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, block_k, causal, scale, seq_len,
+):
+    """dk, dv for one (batch*head, k-block) tile, streaming Q/dO blocks.
+
+    dv = sum_blocks p^T @ dO; dk = scale * sum_blocks ds^T @ Q. Causal
+    programs start at the first q-block that can see this k-block.
+    """
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)  # (block_k, D)
+
+    n_q_blocks = seq_len // block_q
+    qb_start = jax.lax.div(ki * block_k, block_q) if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        g_blk = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]  # (block_q, 1)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = scale * jax.lax.dot_general(
+            q_blk, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jax.lax.dot_general(
+            p, g_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, D)
+        dp = jax.lax.dot_general(
+            g_blk, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, D)
+        return dk_new, dv_new
+
+    dk = jnp.zeros((block_k, k.shape[1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_start, n_q_blocks, body, (dk, dv))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _shape(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-mesh-axes of ``like``: inside
+    shard_map pallas_call output types must declare their vma; outside it
+    vma is None/absent."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 @functools.lru_cache(maxsize=None)
 def _make_flash(causal, scale, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    def fwd_impl(q, k, v):
-        # q, k, v: (BH, S, D)
-        BH, S, D = q.shape
-        kern = functools.partial(
-            _kernel,
+    def kern_opts(D, S):
+        return dict(
             block_q=block_q,
             block_k=block_k,
             causal=causal,
             scale=scale if scale is not None else D**-0.5,
             seq_len=S,
         )
-        grid = (BH, S // block_q)
-        # Inside shard_map the output type must declare its varying mesh
-        # axes; inherit them from q (outside shard_map vma is None/absent).
-        vma = getattr(jax.typeof(q), "vma", None)
-        out_shape = (
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype, vma=vma)
-            if vma
-            else jax.ShapeDtypeStruct((BH, S, D), q.dtype)
-        )
+
+    def fwd_impl(q, k, v):
+        # q, k, v: (BH, S, D) -> (o, lse)
+        BH, S, D = q.shape
+        kern = functools.partial(_kernel, **kern_opts(D, S))
         return pl.pallas_call(
             kern,
-            out_shape=out_shape,
-            grid=grid,
+            # lse rides as (BH, S, 1): TPU Mosaic requires the last two
+            # block dims divisible by (8, 128) or equal to the array dims —
+            # a trailing singleton satisfies that where (1, block_q) cannot.
+            out_shape=(
+                _shape((BH, S, D), q.dtype, q),
+                _shape((BH, S, 1), jnp.float32, q),
+            ),
+            grid=(BH, S // block_q),
             in_specs=[
                 pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            out_specs=(
+                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            ),
             interpret=interpret,
         )(q, k, v)
 
+    def bwd_impl(q, k, v, g, lse, delta):
+        BH, S, D = q.shape
+        full = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
+        full_row = pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0))
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, **kern_opts(D, S)),
+            out_shape=_shape((BH, S, D), q.dtype, q),
+            grid=(BH, S // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
+                full,  # k
+                full,  # v
+                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # dO
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, **kern_opts(D, S)),
+            out_shape=(
+                _shape((BH, S, D), k.dtype, q),
+                _shape((BH, S, D), v.dtype, q),
+            ),
+            grid=(BH, S // block_k),
+            in_specs=[
+                full,  # q
+                pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
+                pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
+                full,  # dO
+                full_row,  # lse
+                full_row,  # delta
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            ),
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        return dq, dk, dv
+
     @jax.custom_vjp
     def flash(q, k, v):
-        return fwd_impl(q, k, v)
+        return fwd_impl(q, k, v)[0]
 
     def flash_fwd(q, k, v):
-        return fwd_impl(q, k, v), (q, k, v)
+        o, lse = fwd_impl(q, k, v)
+        return o, (q, k, v, o, lse)
 
     def flash_bwd(res, g):
-        q, k, v = res
-        # Recompute through the pure-JAX blockwise path for gradients.
-        _, vjp = jax.vjp(
-            lambda q, k, v: blockwise_attention(
-                q[:, :, None, :], k[:, :, None, :], v[:, :, None, :],
-                block_size=block_k, causal=causal, scale=scale,
-            )[:, :, 0, :],
-            q, k, v,
+        q, k, v, o, lse = res
+        # delta = rowsum(dO * O): tiny elementwise reduce; XLA fuses it, no
+        # kernel needed.
+        delta = jnp.sum(
+            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
         )
-        return vjp(g)
+        return bwd_impl(q, k, v, g.astype(q.dtype), lse, delta)
 
     flash.defvjp(flash_fwd, flash_bwd)
     return flash
