@@ -20,15 +20,16 @@ Emits one JSON line via bench_utils.report.
 
 from __future__ import annotations
 
+import os
 import statistics
 import sys
 import time
 
 
 def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench_utils import report
-
-    import os
 
     import jax
 
@@ -82,7 +83,6 @@ def main() -> None:
 
     import shutil
     import tempfile
-    import os
 
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None
     tmp = tempfile.mkdtemp(prefix="tsnap_stall_", dir=base)

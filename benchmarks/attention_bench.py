@@ -1,0 +1,111 @@
+"""Attention kernel benchmark: Pallas flash vs XLA blockwise, fwd+bwd.
+
+Compute-only (scalar outputs), so it is meaningful on a real TPU chip even
+when host<->device bandwidth is poor. Reports per-step wall time for a
+train-shaped loss (forward + backward through attention) and the flash/
+blockwise speedup. The reference has no attention code at all (SURVEY.md
+§5.7) — this benchmarks the beyond-parity kernel path.
+
+Usage: python benchmarks/attention_bench.py [B S H D] (default 4 2048 8 128)
+Emits one JSON line per kernel via bench_utils.report.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench_utils import report
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.ops import blockwise_attention, flash_attention
+
+    args = [int(a) for a in sys.argv[1:5]]
+    B, S, H, D = args + [4, 2048, 8, 128][len(args):]
+    platform = jax.default_backend()
+    print(f"[attention_bench] platform={platform} B={B} S={S} H={H} D={D}",
+          file=sys.stderr, flush=True)
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) for kk in ks)
+
+    def bench(name, attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32))
+
+        grad = jax.grad(loss, argnums=(0, 1, 2))
+
+        @jax.jit
+        def step(q, k, v):
+            # Reduce grads to one scalar: fetching it (4-byte DtoH) forces
+            # the whole computation to finish — block_until_ready alone can
+            # report early through a device relay.
+            dq, dk, dv = grad(q, k, v)
+            return (
+                jnp.sum(dq.astype(jnp.float32))
+                + jnp.sum(dk.astype(jnp.float32))
+                + jnp.sum(dv.astype(jnp.float32))
+            )
+
+        float(step(q, k, v))  # compile + warm
+        times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            float(step(q, k, v))
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+
+    # Dispatch + scalar-fetch roundtrip overhead (can dominate through a
+    # tunneled device relay): time a near-empty step and subtract it.
+    @jax.jit
+    def _noop(q):
+        return jnp.sum(q[0, 0].astype(jnp.float32))
+
+    float(_noop(q))
+    overhead = statistics.median(
+        [(lambda t0: (float(_noop(q)), time.perf_counter() - t0)[1])(time.perf_counter())
+         for _ in range(10)]
+    )
+    print(f"[attention_bench] roundtrip overhead {overhead*1e3:.1f} ms",
+          file=sys.stderr, flush=True)
+
+    t_block = bench(
+        "blockwise",
+        lambda q, k, v: blockwise_attention(q, k, v, block_size=512, causal=True),
+    )
+    t_flash = bench(
+        "flash",
+        lambda q, k, v: flash_attention(q, k, v, causal=True),
+    )
+
+    # Causal attention FLOPs (fwd 2 matmuls + bwd 5) ≈ 3.5 * 4 * B*H*S^2*D / 2.
+    flops = 3.5 * 2 * B * H * S * S * D
+    cb = max(t_block - overhead, 1e-9)
+    cf = max(t_flash - overhead, 1e-9)
+    for name, t, c in (("blockwise", t_block, cb), ("flash", t_flash, cf)):
+        report(
+            f"attention_fwdbwd_{name}",
+            {
+                "platform": platform,
+                "shape": [B, S, H, D],
+                "step_s": round(t, 5),
+                "compute_s": round(c, 5),
+                "tflops": round(flops / c / 1e12, 2),
+                "speedup_vs_blockwise": round(cb / c, 2),
+            },
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+# Sphinx configuration for torchsnapshot_tpu.
+#
+# Mirrors the scope of the reference docs tree (reference: docs/source/conf.py)
+# with autodoc pulling API reference from the package docstrings.
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "torchsnapshot_tpu"
+copyright = "2026, torchsnapshot_tpu authors"
+author = "torchsnapshot_tpu authors"
+
+from torchsnapshot_tpu.version import __version__  # noqa: E402
+
+version = __version__
+release = __version__
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.intersphinx",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+autodoc_member_order = "bysource"
+autodoc_typehints = "description"
+autosummary_generate = True
+
+intersphinx_mapping = {
+    "python": ("https://docs.python.org/3", None),
+    "jax": ("https://docs.jax.dev/en/latest/", None),
+    "numpy": ("https://numpy.org/doc/stable/", None),
+}
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = "alabaster"
+html_static_path = []

@@ -208,6 +208,40 @@ def test_replication_glob_negotiation(tmp_path) -> None:
         assert r["b_replicated"] is False
 
 
+def _materialize_failure_worker(rank: int, world_size: int, snap_path: str):
+    """Rank 1's state_dict() raises during take: every rank must abort (no
+    deadlock on the per-key lockstep barriers, no metadata commit)."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+
+    class ExplodingStateful:
+        def state_dict(self):
+            if rank == 1:
+                raise RuntimeError("injected state_dict failure")
+            return {"w": np.ones(8, dtype=np.float32)}
+
+        def load_state_dict(self, sd):
+            pass
+
+    app_state = {
+        "ok": StateDict(x=np.zeros(4, dtype=np.float32)),
+        "boom": ExplodingStateful(),
+    }
+    try:
+        Snapshot.take(snap_path, app_state)
+        return "unexpected-success"
+    except RuntimeError:
+        assert not os.path.exists(os.path.join(snap_path, SNAPSHOT_METADATA_FNAME))
+        return "aborted"
+
+
+def test_state_dict_failure_aborts_all_ranks(tmp_path) -> None:
+    results = run_with_subprocesses(
+        _materialize_failure_worker, 2, str(tmp_path / "snap"), timeout=120.0
+    )
+    assert all(v == "aborted" for v in results.values())
+
+
 def _sequential_snapshots_worker(rank: int, world_size: int, base_path: str):
     """50 sequential snapshots must not grow the KV store unboundedly
     (PGWrapper retire/GC protocol)."""

@@ -32,6 +32,15 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _apply_causal_mask(s, q_off, k_off, block_q, block_k):
+    """Mask scores above the causal diagonal to NEG_INF. Shared by the
+    forward and both backward kernels so the mask semantics (tie at
+    q_pos == k_pos attends) can never desynchronize between fwd and bwd."""
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, scale, seq_len):
     from jax.experimental import pallas as pl
 
@@ -58,13 +67,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, sc
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_causal_mask(s, qi * block_q, kb * block_k, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -121,13 +124,7 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_causal_mask(s, qi * block_q, kb * block_k, block_q, block_k)
         p = jnp.exp(s - lse)  # masked entries underflow to 0
         dp = jax.lax.dot_general(
             g, v_blk,
@@ -176,13 +173,7 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_causal_mask(s, qb * block_q, ki * block_k, block_q, block_k)
         p = jnp.exp(s - lse)
         dv_new = dv + jax.lax.dot_general(
             p, g_blk,

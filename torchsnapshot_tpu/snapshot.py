@@ -218,17 +218,34 @@ class Snapshot:
 
             manifest: Manifest = {}
             flattened: Dict[str, Any] = {}
+            # Materialize statefuls in cross-rank lockstep: one barrier per
+            # key so a state_dict() that internally runs collectives (e.g. a
+            # device_get of a non-addressable array) can never interleave
+            # with a DIFFERENT stateful's collectives on another rank
+            # (reference: snapshot.py:361-367). On failure, the rank still
+            # *invokes* every remaining stateful's state_dict() (discarding
+            # the result) and still barriers per key: skipping the calls
+            # would desert any collectives inside them and hang healthy
+            # peers mid-state_dict, where no error channel can reach them.
+            # The first error rides the manifest gather's error channel
+            # below, so every rank aborts and no rank commits.
+            materialize_exc: Optional[BaseException] = None
             for key in keys:
-                if key not in app_state:
-                    continue
-                sd = (
-                    rng_captured[key]
-                    if key in rng_captured
-                    else app_state[key].state_dict()
-                )
-                key_manifest, key_flattened = flatten(sd, prefix=key)
-                manifest.update(key_manifest)
-                flattened.update(key_flattened)
+                if key in app_state:
+                    try:
+                        sd = (
+                            rng_captured[key]
+                            if key in rng_captured
+                            else app_state[key].state_dict()
+                        )
+                        if materialize_exc is None:
+                            key_manifest, key_flattened = flatten(sd, prefix=key)
+                            manifest.update(key_manifest)
+                            flattened.update(key_flattened)
+                    except BaseException as e:  # noqa: B036
+                        if materialize_exc is None:
+                            materialize_exc = e
+                pg_wrapper.barrier()
 
             replicated_paths = cls._calculate_replicated_paths(
                 flattened, replicated, pg_wrapper
@@ -299,14 +316,15 @@ class Snapshot:
             # staging failure must still reach the collective (a deserted
             # all-gather hangs every peer), so the error rides it too and
             # is raised on every rank afterwards — no rank commits.
-            stage_exc: Optional[BaseException] = None
+            stage_exc: Optional[BaseException] = materialize_exc
             pending_io_work = None
-            try:
-                pending_io_work = event_loop.run_until_complete(
-                    execute_write_reqs(write_reqs, storage, memory_budget, rank)
-                )
-            except BaseException as e:  # noqa: B036
-                stage_exc = e
+            if stage_exc is None:
+                try:
+                    pending_io_work = event_loop.run_until_complete(
+                        execute_write_reqs(write_reqs, storage, memory_budget, rank)
+                    )
+                except BaseException as e:  # noqa: B036
+                    stage_exc = e
             global_manifest, peer_errors = cls._gather_manifest(
                 manifest, pg_wrapper, local_error=repr(stage_exc) if stage_exc else None
             )
@@ -354,30 +372,45 @@ class Snapshot:
             )
             keys = self._gather_keys(pg_wrapper, sorted(app_state.keys()))
             # RNG states restore last so earlier load side effects can't
-            # perturb them (reference: snapshot.py:489-500).
-            ordered = [k for k in keys if not isinstance(app_state.get(k), RNGState)]
-            ordered += [k for k in keys if isinstance(app_state.get(k), RNGState)]
-            # Defer raising until after the barrier: a rank failing (e.g. a
-            # per-rank entry missing after a world-size change) must not
-            # desert the barrier and deadlock healthy peers.
+            # perturb them (reference: snapshot.py:489-500). Which keys are
+            # RNG is agreed globally (union across ranks): an order derived
+            # from local types alone could pair DIFFERENT keys at the same
+            # lockstep slot on different ranks, which would let two
+            # statefuls' internal collectives interleave — the exact hazard
+            # the per-key barrier exists to prevent.
+            rng_local = sorted(
+                k for k in keys if isinstance(app_state.get(k), RNGState)
+            )
+            rng_keys = set(self._gather_keys(pg_wrapper, rng_local))
+            ordered = [k for k in keys if k not in rng_keys]
+            ordered += [k for k in keys if k in rng_keys]
+            # Load statefuls in cross-rank lockstep: one barrier per key so
+            # a load_state_dict()/state_dict() that internally runs
+            # collectives can't interleave with a different stateful's on
+            # another rank (reference restore: snapshot.py:477-487). After a
+            # failure (e.g. a per-rank entry missing after a world-size
+            # change) the rank still *invokes* the remaining keys' loads and
+            # still barriers — skipping them would desert any collectives
+            # inside and hang healthy peers — then raises the first error
+            # after the last key.
             exc: Optional[BaseException] = None
-            try:
-                for key in ordered:
-                    if key not in app_state:
-                        continue
-                    self._load_stateful(
-                        rank=rank,
-                        stateful=app_state[key],
-                        key=key,
-                        available=available,
-                        metadata=metadata,
-                        storage=storage,
-                        event_loop=event_loop,
-                        memory_budget=memory_budget,
-                    )
-            except BaseException as e:  # noqa: B036
-                exc = e
-            pg_wrapper.barrier()
+            for key in ordered:
+                if key in app_state:
+                    try:
+                        self._load_stateful(
+                            rank=rank,
+                            stateful=app_state[key],
+                            key=key,
+                            available=available,
+                            metadata=metadata,
+                            storage=storage,
+                            event_loop=event_loop,
+                            memory_budget=memory_budget,
+                        )
+                    except BaseException as e:  # noqa: B036
+                        if exc is None:
+                            exc = e
+                pg_wrapper.barrier()
             if exc is not None:
                 raise exc
         finally:
