@@ -163,13 +163,13 @@ def forward(
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
-    if c.attn_impl not in ("dense", "blockwise", "flash", "ring", "ulysses"):
+    if c.attn_impl not in ("dense", "blockwise", "flash", "ring", "zigzag", "ulysses"):
         raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
     # cp (ring/ulysses) keeps the sequence dim sharded over 'seq' end-to-end;
     # the Megatron-sp fallback seq-shards the residual over the tp axis
     # instead and gathers around attention/ffn.
     has_seq = mesh is not None and "seq" in mesh.axis_names
-    if c.attn_impl in ("ring", "ulysses") and mesh is not None and not has_seq:
+    if c.attn_impl in ("ring", "zigzag", "ulysses") and mesh is not None and not has_seq:
         raise ValueError(
             f"attn_impl={c.attn_impl!r} needs a mesh with a 'seq' axis; got "
             f"{mesh.axis_names}. Build one via make_mesh({{'data': ..., "
@@ -177,7 +177,7 @@ def forward(
         )
     # mesh=None (single-device run of a cp-configured model) falls back to
     # dense attention — same math, no axis to communicate over.
-    cp = c.attn_impl in ("ring", "ulysses") and has_seq
+    cp = c.attn_impl in ("ring", "zigzag", "ulysses") and has_seq
     res_seq_ax = "seq" if has_seq else "model"  # residual-stream seq sharding
     act_seq_ax = "seq" if cp else None  # in-block activation seq sharding
 
@@ -200,6 +200,10 @@ def forward(
                     q, k, v, mesh, causal=True,
                     inner_block_size=c.attn_block_size,
                 )
+            if c.attn_impl == "zigzag":
+                from ..ops.ring_attention import zigzag_ring_attention_sharded
+
+                return zigzag_ring_attention_sharded(q, k, v, mesh)
             from ..ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(q, k, v, mesh, causal=True)

@@ -12,7 +12,12 @@ so the checkpointing layer has real context-parallel state to snapshot.
 from .attention import blockwise_attention, dense_attention
 from .moe import moe_ffn, moe_ffn_sharded
 from .pallas_attention import flash_attention
-from .ring_attention import ring_attention_sharded, ring_self_attention
+from .ring_attention import (
+    ring_attention_sharded,
+    ring_self_attention,
+    zigzag_ring_attention_sharded,
+    zigzag_ring_self_attention,
+)
 from .ulysses import ulysses_attention_sharded, ulysses_self_attention
 
 __all__ = [
@@ -25,4 +30,6 @@ __all__ = [
     "ring_self_attention",
     "ulysses_attention_sharded",
     "ulysses_self_attention",
+    "zigzag_ring_attention_sharded",
+    "zigzag_ring_self_attention",
 ]

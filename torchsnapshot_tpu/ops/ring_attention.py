@@ -88,6 +88,166 @@ def ring_self_attention(
     return _finalize((o, m, l), q.dtype)
 
 
+def zigzag_ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Causally load-balanced ring attention. Must run inside ``shard_map``.
+
+    Plain ring attention with a causal mask wastes ~half its FLOPs: at ring
+    step ``s`` every device computes a full (S_local x S_local) score block
+    and masks it, even when the incoming K/V shard lies entirely above its
+    queries' diagonal. The zigzag layout (striped/zigzag ring attention)
+    folds the sequence: with ring size n, the global sequence is cut into
+    2n chunks and device i owns chunks ``(i, 2n-1-i)`` concatenated —
+    ``q[:, :half]`` is chunk i ("lo"), ``q[:, half:]`` is chunk 2n-1-i
+    ("hi"). Then at every step exactly one of the four (q-half, kv-half)
+    pairs is fully below the diagonal (q_hi x kv_lo — computed unmasked),
+    one is fully above (skipped entirely), and the remaining work is one
+    full block (off-diagonal steps) or two triangular blocks (the diagonal
+    step) selected by ``lax.switch``. Every device does the same ~2
+    half-blocks of matmul per step: ~2x the causal throughput of the plain
+    ring, with identical numerics.
+
+    ``q, k, v: (B, S_local, H, D)`` in zigzag layout (use
+    ``zigzag_ring_attention_sharded`` to apply the layout from globally
+    ordered arrays, or keep activations zigzag end-to-end in training).
+    """
+    B, S_loc, H, D = q.shape
+    if S_loc % 2:
+        raise ValueError(f"zigzag needs an even local seq length, got {S_loc}")
+    half = S_loc // 2
+    ring = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = D**-0.5
+
+    pos = jnp.arange(half)
+    q_lo, q_hi = q[:, :half], q[:, half:]
+    pos_lo = me * half + pos  # global positions of chunk `me`
+    pos_hi = (2 * ring - 1 - me) * half + pos  # chunk 2n-1-me
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def step(carry, s):
+        acc_lo, acc_hi, k_cur, v_cur = carry
+        j = jax.lax.rem(me - s + ring, ring)  # owner of the incoming shard
+        k_lo, v_lo = k_cur[:, :half], v_cur[:, :half]
+        k_hi, v_hi = k_cur[:, half:], v_cur[:, half:]
+        kpos_lo = j * half + pos
+        kpos_hi = (2 * ring - 1 - j) * half + pos
+
+        # q_hi x kv_lo: chunk 2n-1-me is always strictly after chunk j<n,
+        # so this block is always needed and never masked.
+        acc_hi = attention_block_update(
+            q_hi, k_lo, v_lo, pos_hi, kpos_lo, scale, False, acc_hi
+        )
+
+        def diagonal(acc_lo, acc_hi):  # j == me: two triangular blocks
+            acc_lo = attention_block_update(
+                q_lo, k_lo, v_lo, pos_lo, kpos_lo, scale, True, acc_lo
+            )
+            acc_hi = attention_block_update(
+                q_hi, k_hi, v_hi, pos_hi, kpos_hi, scale, True, acc_hi
+            )
+            return acc_lo, acc_hi
+
+        def below(acc_lo, acc_hi):  # j < me: q_lo x kv_lo, full
+            acc_lo = attention_block_update(
+                q_lo, k_lo, v_lo, pos_lo, kpos_lo, scale, False, acc_lo
+            )
+            return acc_lo, acc_hi
+
+        def above(acc_lo, acc_hi):  # j > me: q_hi x kv_hi, full
+            acc_hi = attention_block_update(
+                q_hi, k_hi, v_hi, pos_hi, kpos_hi, scale, False, acc_hi
+            )
+            return acc_lo, acc_hi
+
+        branch = jnp.where(j == me, 0, jnp.where(j < me, 1, 2))
+        acc_lo, acc_hi = jax.lax.switch(
+            branch, (diagonal, below, above), acc_lo, acc_hi
+        )
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc_lo, acc_hi, k_nxt, v_nxt), None
+
+    vma = getattr(jax.typeof(q), "vma", frozenset())
+    if axis_name in vma:
+        qv = q
+    else:
+        qv = jax.lax.pcast(q, (axis_name,), to="varying")
+    hz = qv[:, :half].astype(jnp.float32) * 0.0  # (B, half, H, D) zeros
+    zrow = hz[..., 0].transpose(0, 2, 1)  # (B, H, half) zeros
+    acc0 = lambda: (hz, zrow + NEG_INF, zrow)  # noqa: E731
+    # Step 0 is the diagonal (j == me): both accumulators fold in a block
+    # containing their diagonal first, so the NEG_INF init never leaks.
+    (acc_lo, acc_hi, _, _), _ = jax.lax.scan(
+        step, (acc0(), acc0(), k, v), jnp.arange(ring)
+    )
+    out_lo = _finalize(acc_lo, q.dtype)
+    out_hi = _finalize(acc_hi, q.dtype)
+    return jnp.concatenate([out_lo, out_hi], axis=1)
+
+
+def zigzag_layout_indices(seq_len: int, ring: int) -> "jnp.ndarray":
+    """Permutation mapping a globally ordered sequence to zigzag layout.
+
+    ``take(x, idx, axis=seq)`` then sharding over the ring axis gives
+    device i chunks (i, 2n-1-i). Invert with ``argsort(idx)``.
+    """
+    if seq_len % (2 * ring):
+        raise ValueError(f"seq {seq_len} not divisible by 2*ring={2 * ring}")
+    chunk = seq_len // (2 * ring)
+    order = []
+    for i in range(ring):
+        order.extend([i, 2 * ring - 1 - i])
+    idx = jnp.concatenate(
+        [jnp.arange(c * chunk, (c + 1) * chunk) for c in order]
+    )
+    return idx
+
+
+def zigzag_ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = "model",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Zigzag ring attention on globally ordered ``(B, S, H, D)`` arrays.
+
+    Convenience wrapper: permutes the sequence into zigzag layout (one
+    resharding collective), runs the balanced ring, and permutes back.
+    Training loops that keep activations in zigzag layout end-to-end skip
+    both permutes — the layout is self-inverse under the residual stream
+    since every position-wise op commutes with it.
+    """
+    axes = set(mesh.axis_names)
+    if seq_axis not in axes:
+        raise ValueError(f"mesh {mesh.axis_names} lacks seq axis {seq_axis!r}")
+    ring = mesh.shape[seq_axis]
+    idx = zigzag_layout_indices(q.shape[1], ring)
+    inv = jnp.argsort(idx)
+    b = batch_axis if batch_axis in axes else None
+    h = head_axis if head_axis in axes else None
+    spec = P(b, seq_axis, h, None)
+    fn = partial(zigzag_ring_self_attention, axis_name=seq_axis, scale=scale)
+    qp, kp, vp = (jnp.take(x, idx, axis=1) for x in (q, k, v))
+    out = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(qp, kp, vp)
+    return jnp.take(out, inv, axis=1)
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,

@@ -1,1 +1,6 @@
 from .mesh import make_mesh, shard_pytree  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_param_sharding,
+    pipeline_spmd,
+    pipelined_apply,
+)

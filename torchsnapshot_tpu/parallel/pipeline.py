@@ -1,0 +1,171 @@
+"""GPipe-style SPMD pipeline parallelism over a mesh axis.
+
+Layer-stacked parameters (leading dim = layers) shard over the ``pipe``
+mesh axis so each device owns a contiguous stage of ``L/P`` layers. The
+input batch is split into ``n_micro`` microbatches that flow through the
+stages: at tick ``t`` stage ``s`` processes microbatch ``t - s``, then
+hands its activation to stage ``s+1`` via a neighbor ``ppermute`` — the
+cheapest collective on a TPU torus, and the schedule is a ``lax.scan``
+(static length ``n_micro + P - 1``), so XLA sees one compiled tick body.
+
+Bubble ticks (the pipeline fill/drain) run the same computation with a
+validity mask instead of data-dependent control flow — standard SPMD
+pipelining: every device executes the identical program every tick, which
+is what keeps it one XLA computation with static shapes.
+
+Backward is just ``jax.grad`` through the scan: autodiff reverses the
+``ppermute`` s (activations forward, gradients backward) and produces the
+standard 1F1B-free GPipe backward schedule automatically.
+
+The reference framework has no pipeline parallelism (SURVEY.md §2's
+parallelism table records the absence); at the state-dict level a
+pipelined model's parameters are just layer-stacked arrays sharded over
+``pipe`` — another sharded entry for the snapshot layer, restorable onto
+any other stage count via overlap resharding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LayerFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def _stage_apply(stage_params: Any, x: jax.Array, layer_fn: LayerFn) -> jax.Array:
+    """Apply this stage's layers (leading dim = local layers) in order."""
+
+    def body(h, layer_params):
+        return layer_fn(layer_params, h), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def pipeline_spmd(
+    stage_params: Any,
+    x_micro: jax.Array,
+    *,
+    axis_name: str,
+    layer_fn: LayerFn,
+) -> jax.Array:
+    """Pipeline body. Must run inside ``shard_map``.
+
+    ``stage_params``: pytree whose leaves have leading dim ``L_local``
+    (this stage's layers). ``x_micro: (M, Bm, ...)`` microbatched input
+    (every stage receives it; only stage 0 reads it). Returns the
+    pipelined output ``(M, Bm, ...)``, identical on every stage.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    n_ticks = n_micro + n_stages - 1
+    # Activations move stage s -> s+1; no wraparound (stage 0 feeds from
+    # x_micro, the last stage's sends are discarded by validity masking).
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        act, outs = carry
+        mb = t - stage  # microbatch index this stage handles at tick t
+        valid = (mb >= 0) & (mb < n_micro)
+        y = _stage_apply(stage_params, act, layer_fn)
+        # Last stage banks its result at microbatch slot mb. The masked
+        # dynamic_update_slice keeps every stage's program identical.
+        write = valid & (stage == n_stages - 1)
+        slot = jnp.clip(mb, 0, n_micro - 1)
+        upd = jnp.where(write, y, jax.lax.dynamic_index_in_dim(outs, slot, 0, False))
+        outs = jax.lax.dynamic_update_index_in_dim(outs, upd, slot, 0)
+        # Hand activations to the next stage; stage 0 ingests the next
+        # microbatch instead of the (meaningless) wraparound receive.
+        recv = jax.lax.ppermute(y, axis_name, fwd_perm)
+        nxt = jnp.clip(t + 1, 0, n_micro - 1)
+        act_next = jnp.where(
+            stage == 0, jax.lax.dynamic_index_in_dim(x_micro, nxt, 0, False), recv
+        )
+        return (act_next, outs), None
+
+    act0 = jnp.where(
+        stage == 0, x_micro[0], jnp.zeros_like(x_micro[0])
+    )
+    # The carry becomes device-varying over the pipe axis inside the scan
+    # (ppermute + axis_index); the initializers must declare that too.
+    outs0 = jnp.zeros_like(x_micro)
+    vma = getattr(jax.typeof(outs0), "vma", frozenset())
+    if axis_name not in vma:
+        outs0 = jax.lax.pcast(outs0, (axis_name,), to="varying")
+    vma = getattr(jax.typeof(act0), "vma", frozenset())
+    if axis_name not in vma:
+        act0 = jax.lax.pcast(act0, (axis_name,), to="varying")
+    (_, outs), _ = jax.lax.scan(tick, (act0, outs0), jnp.arange(n_ticks))
+    # Everyone needs the outputs (e.g. for a replicated loss): zero out all
+    # but the last stage's banked copy and sum over the pipe axis.
+    outs = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs, axis_name)
+
+
+def pipelined_apply(
+    params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    layer_fn: LayerFn,
+    n_micro: int,
+    pipe_axis: str = "pipe",
+    batch_axis: Optional[str] = "data",
+) -> jax.Array:
+    """Apply layer-stacked ``params`` to ``x: (B, ...)`` through a pipeline.
+
+    ``params`` leaves have leading dim L (total layers), sharded over
+    ``pipe_axis`` (L divisible by the axis size); the batch splits into
+    ``n_micro`` microbatches (B divisible by ``n_micro`` and, when present,
+    by the ``batch_axis`` size — dp composes with pp on an orthogonal mesh
+    axis). Output matches ``x``'s leading shape.
+    """
+    axes = set(mesh.axis_names)
+    if pipe_axis not in axes:
+        raise ValueError(f"mesh {mesh.axis_names} lacks pipe axis {pipe_axis!r}")
+    n_stages = mesh.shape[pipe_axis]
+    L = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by n_micro {n_micro}")
+
+    b = batch_axis if batch_axis in axes else None
+    if b is not None and (B // n_micro) % mesh.shape[b]:
+        raise ValueError(
+            f"per-microbatch size {B // n_micro} not divisible by the "
+            f"{b!r} axis size {mesh.shape[b]} (batch {B}, n_micro {n_micro})"
+        )
+    x_micro = x.reshape(n_micro, B // n_micro, *x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda leaf: P(pipe_axis, *([None] * (leaf.ndim - 1))), params
+    )
+    fn = partial(pipeline_spmd, axis_name=pipe_axis, layer_fn=layer_fn)
+    out = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(param_specs, P(None, b)),
+        out_specs=P(None, b),
+    )(params, x_micro)
+    return out.reshape(B, *x.shape[1:])
+
+
+def pipeline_param_sharding(
+    params: Any, mesh: Mesh, pipe_axis: str = "pipe"
+) -> Any:
+    """NamedShardings placing layer-stacked params on their pipeline stages
+    (what ``init`` should ``device_put`` with, and exactly what the
+    snapshot layer sees as sharded entries)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(
+            mesh, P(pipe_axis, *([None] * (leaf.ndim - 1)))
+        ),
+        params,
+    )
