@@ -190,6 +190,19 @@ def forward(
     pe = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
     x = x + pe.astype(c.dtype)
 
+    # Zigzag context parallelism: apply the folded layout ONCE here and
+    # invert it once at the logits. Everything between is position-wise and
+    # commutes with the permutation; attention runs in-layout, so the 2
+    # permutes per layer the naive integration would pay collapse to 2 per
+    # forward.
+    zz = cp and c.attn_impl == "zigzag"
+    if zz:
+        from ..ops.ring_attention import zigzag_layout_indices
+
+        zz_idx = zigzag_layout_indices(S, mesh.shape["seq"])
+        zz_inv = jnp.argsort(zz_idx)
+        x = jnp.take(x, zz_idx, axis=1)
+
     def attention(q, k, v):
         # q, k, v: (B, S, H, hd) — logical shapes; sharding via constraints.
         if cp:
@@ -203,7 +216,7 @@ def forward(
             if c.attn_impl == "zigzag":
                 from ..ops.ring_attention import zigzag_ring_attention_sharded
 
-                return zigzag_ring_attention_sharded(q, k, v, mesh)
+                return zigzag_ring_attention_sharded(q, k, v, mesh, in_layout=True)
             from ..ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(q, k, v, mesh, causal=True)
@@ -268,6 +281,8 @@ def forward(
     x = cs(x, P("data", act_seq_ax, None))
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = x @ params["embed"].astype(c.dtype).T
+    if zz:
+        logits = jnp.take(logits, zz_inv, axis=1)  # back to global order
     logits = cs(logits, P("data", act_seq_ax, "model"))
     if with_aux:
         return logits, aux

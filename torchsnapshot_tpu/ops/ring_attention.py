@@ -31,6 +31,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .attention import NEG_INF, _finalize, attention_block_update
 
 
+def _ring_acc_init(q: jax.Array, axis_name: str):
+    """Zero (o, m, l) online-softmax accumulator shaped like ``q``.
+
+    The scan carry is device-varying over every mesh axis q varies over
+    plus the ring axis (masks depend on ``axis_index``); shard_map tracks
+    this in the type system, so the initializers must declare it.
+    """
+    vma = getattr(jax.typeof(q), "vma", frozenset())
+    qv = q if axis_name in vma else jax.lax.pcast(q, (axis_name,), to="varying")
+    qz = qv.astype(jnp.float32) * 0.0
+    zrow = qz[..., 0].transpose(0, 2, 1)  # (B, H, S) of zeros
+    return qz, zrow + NEG_INF, zrow
+
+
+def _rotate(x: jax.Array, axis_name: str, ring: int) -> jax.Array:
+    """One hop around the ring (device i -> i+1 mod ring)."""
+    return jax.lax.ppermute(
+        x, axis_name, [(i, (i + 1) % ring) for i in range(ring)]
+    )
+
+
 def ring_self_attention(
     q: jax.Array,
     k: jax.Array,
@@ -53,12 +74,10 @@ def ring_self_attention(
         scale = D**-0.5
 
     q_pos = me * S_loc + jnp.arange(S_loc)
-    # Send K/V to the next device on the ring; after s steps device `me`
-    # holds the shard originally owned by (me - s) mod ring.
-    perm = [(i, (i + 1) % ring) for i in range(ring)]
 
     def step(carry, s):
         o, m, l, k_cur, v_cur = carry
+        # After s hops device `me` holds the shard owned by (me - s) mod ring.
         owner = jax.lax.rem(me - s + ring, ring)
         k_pos = owner * S_loc + jnp.arange(S_loc)
         o, m, l = attention_block_update(
@@ -66,22 +85,11 @@ def ring_self_attention(
         )
         # Rotate even on the last step (returns K/V to its owner); the
         # extra hop costs one neighbor exchange and keeps the scan uniform.
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _rotate(k_cur, axis_name, ring)
+        v_nxt = _rotate(v_cur, axis_name, ring)
         return (o, m, l, k_nxt, v_nxt), None
 
-    # The scan carry is device-varying over every mesh axis q/k/v vary over
-    # (shard_map tracks this in the type system); derive the initializers
-    # from q so they inherit its varying axes, and add the ring axis
-    # explicitly (the masks depend on axis_index).
-    vma = getattr(jax.typeof(q), "vma", frozenset())
-    if axis_name in vma:
-        qv = q
-    else:
-        qv = jax.lax.pcast(q, (axis_name,), to="varying")
-    qz = qv.astype(jnp.float32) * 0.0
-    zrow = qz[..., 0].transpose(0, 2, 1)  # (B, H, S_loc) of zeros
-    acc = (qz, zrow + NEG_INF, zrow)
+    acc = _ring_acc_init(q, axis_name)
     # Step 0 processes the diagonal block (owner == me), which always
     # contains valid keys for causal masking — see attention_block_update.
     (o, m, l, _, _), _ = jax.lax.scan(step, (*acc, k, v), jnp.arange(ring))
@@ -130,7 +138,6 @@ def zigzag_ring_self_attention(
     q_lo, q_hi = q[:, :half], q[:, half:]
     pos_lo = me * half + pos  # global positions of chunk `me`
     pos_hi = (2 * ring - 1 - me) * half + pos  # chunk 2n-1-me
-    perm = [(i, (i + 1) % ring) for i in range(ring)]
 
     def step(carry, s):
         acc_lo, acc_hi, k_cur, v_cur = carry
@@ -172,22 +179,21 @@ def zigzag_ring_self_attention(
             branch, (diagonal, below, above), acc_lo, acc_hi
         )
 
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _rotate(k_cur, axis_name, ring)
+        v_nxt = _rotate(v_cur, axis_name, ring)
         return (acc_lo, acc_hi, k_nxt, v_nxt), None
 
-    vma = getattr(jax.typeof(q), "vma", frozenset())
-    if axis_name in vma:
-        qv = q
-    else:
-        qv = jax.lax.pcast(q, (axis_name,), to="varying")
-    hz = qv[:, :half].astype(jnp.float32) * 0.0  # (B, half, H, D) zeros
-    zrow = hz[..., 0].transpose(0, 2, 1)  # (B, H, half) zeros
-    acc0 = lambda: (hz, zrow + NEG_INF, zrow)  # noqa: E731
     # Step 0 is the diagonal (j == me): both accumulators fold in a block
     # containing their diagonal first, so the NEG_INF init never leaks.
     (acc_lo, acc_hi, _, _), _ = jax.lax.scan(
-        step, (acc0(), acc0(), k, v), jnp.arange(ring)
+        step,
+        (
+            _ring_acc_init(q[:, :half], axis_name),
+            _ring_acc_init(q[:, half:], axis_name),
+            k,
+            v,
+        ),
+        jnp.arange(ring),
     )
     out_lo = _finalize(acc_lo, q.dtype)
     out_hi = _finalize(acc_hi, q.dtype)
@@ -222,30 +228,37 @@ def zigzag_ring_attention_sharded(
     batch_axis: Optional[str] = "data",
     head_axis: Optional[str] = "model",
     scale: Optional[float] = None,
+    in_layout: bool = False,
 ) -> jax.Array:
-    """Zigzag ring attention on globally ordered ``(B, S, H, D)`` arrays.
+    """Zigzag ring attention on ``(B, S, H, D)`` arrays.
 
-    Convenience wrapper: permutes the sequence into zigzag layout (one
-    resharding collective), runs the balanced ring, and permutes back.
-    Training loops that keep activations in zigzag layout end-to-end skip
-    both permutes — the layout is self-inverse under the residual stream
-    since every position-wise op commutes with it.
+    With ``in_layout=False`` (default) the inputs are globally ordered:
+    the wrapper permutes the sequence into zigzag layout (one resharding
+    collective), runs the balanced ring, and permutes back. Training loops
+    that keep activations in zigzag layout end-to-end pass
+    ``in_layout=True`` and skip both permutes — every position-wise op
+    commutes with the layout, so only attention needs to know about it
+    (see models/transformer.py, which permutes once after the position
+    encoding and inverts once at the logits).
     """
     axes = set(mesh.axis_names)
     if seq_axis not in axes:
         raise ValueError(f"mesh {mesh.axis_names} lacks seq axis {seq_axis!r}")
     ring = mesh.shape[seq_axis]
-    idx = zigzag_layout_indices(q.shape[1], ring)
-    inv = jnp.argsort(idx)
     b = batch_axis if batch_axis in axes else None
     h = head_axis if head_axis in axes else None
     spec = P(b, seq_axis, h, None)
     fn = partial(zigzag_ring_self_attention, axis_name=seq_axis, scale=scale)
-    qp, kp, vp = (jnp.take(x, idx, axis=1) for x in (q, k, v))
+    if not in_layout:
+        idx = zigzag_layout_indices(q.shape[1], ring)
+        inv = jnp.argsort(idx)
+        q, k, v = (jnp.take(x, idx, axis=1) for x in (q, k, v))
     out = jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )(qp, kp, vp)
-    return jnp.take(out, inv, axis=1)
+    )(q, k, v)
+    if not in_layout:
+        out = jnp.take(out, inv, axis=1)
+    return out
 
 
 def ring_attention_sharded(

@@ -64,9 +64,10 @@ def pipeline_spmd(
     stage = jax.lax.axis_index(axis_name)
     n_micro = x_micro.shape[0]
     n_ticks = n_micro + n_stages - 1
-    # Activations move stage s -> s+1; no wraparound (stage 0 feeds from
-    # x_micro, the last stage's sends are discarded by validity masking).
-    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    # Activations move stage s -> s+1; no wraparound edge — stage 0 feeds
+    # from x_micro, so the last stage's activation is simply not sent
+    # (ppermute zero-fills receivers with no incoming edge).
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def tick(carry, t):
         act, outs = carry
