@@ -242,3 +242,19 @@ def test_storage_layout(tmp_path) -> None:
     }
     assert ".snapshot_metadata" in files
     assert any(f.startswith("0/m/w") for f in files)
+
+
+def test_phase_timer_logs(tmp_path, caplog) -> None:
+    """take/restore emit a one-line phase-duration summary at INFO."""
+    import logging
+
+    app_state = {"m": StateDict(w=np.ones((16, 16), dtype=np.float32))}
+    with caplog.at_level(logging.INFO, logger="torchsnapshot_tpu.snapshot"):
+        snapshot = Snapshot.take(str(tmp_path / "snap"), app_state)
+        snapshot.restore({"m": StateDict(w=np.zeros((16, 16), dtype=np.float32))})
+    take_lines = [r.message for r in caplog.records if "Snapshot.take" in r.message]
+    restore_lines = [r.message for r in caplog.records if "Snapshot.restore" in r.message]
+    assert take_lines and all(
+        p in take_lines[0] for p in ("materialize=", "stage=", "io_drain=", "commit=")
+    )
+    assert restore_lines and "load=" in restore_lines[0]

@@ -128,7 +128,11 @@ def prepare_read(
                 f"{list(entry.shape)}, destination has {list(obj_out.shape)}."
             )
         sharding = obj_out.sharding
-        dst_view = np.empty(tuple(entry.shape), dtype=string_to_dtype(entry.dtype))
+        # No host scratch here: with dst_view=None the preparers hand the
+        # callback either a zero-copy view over the read buffer (whole-file
+        # reads — saves a full memcpy pass per array) or their own assembly
+        # scratch (budget-split / chunked reads, which genuinely need one).
+        # device_put copies host->device either way.
 
         def _materialize(host: np.ndarray, _cb=callback, _sharding=sharding) -> None:
             restored = jax.device_put(host, _sharding)

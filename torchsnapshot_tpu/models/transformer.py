@@ -191,12 +191,16 @@ def forward(
     x = x + pe.astype(c.dtype)
 
     # Zigzag context parallelism: apply the folded layout ONCE here and
-    # invert it once at the logits. Everything between is position-wise and
-    # commutes with the permutation; attention runs in-layout, so the 2
+    # invert it once at the logits — attention runs in-layout, so the 2
     # permutes per layer the naive integration would pay collapse to 2 per
-    # forward.
+    # forward. Valid only while everything between commutes with the
+    # permutation: true for the dense FFN (position-wise), NOT for MoE,
+    # whose capacity overflow drops tokens in token order — hoisting would
+    # make training numerics depend on the parallelism layout. MoE configs
+    # therefore keep the per-layer permuting wrapper.
     zz = cp and c.attn_impl == "zigzag"
-    if zz:
+    zz_hoist = zz and c.n_experts == 0
+    if zz_hoist:
         from ..ops.ring_attention import zigzag_layout_indices
 
         zz_idx = zigzag_layout_indices(S, mesh.shape["seq"])
@@ -216,7 +220,9 @@ def forward(
             if c.attn_impl == "zigzag":
                 from ..ops.ring_attention import zigzag_ring_attention_sharded
 
-                return zigzag_ring_attention_sharded(q, k, v, mesh, in_layout=True)
+                return zigzag_ring_attention_sharded(
+                    q, k, v, mesh, in_layout=zz_hoist
+                )
             from ..ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(q, k, v, mesh, causal=True)
@@ -281,7 +287,7 @@ def forward(
     x = cs(x, P("data", act_seq_ax, None))
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = x @ params["embed"].astype(c.dtype).T
-    if zz:
+    if zz_hoist:
         logits = jnp.take(logits, zz_inv, axis=1)  # back to global order
     logits = cs(logits, P("data", act_seq_ax, "model"))
     if with_aux:

@@ -40,6 +40,7 @@ import asyncio
 import fnmatch
 import logging
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -85,6 +86,36 @@ from .version import __version__
 
 logger = logging.getLogger(__name__)
 
+
+class _PhaseTimer:
+    """One-line phase-duration summary per take/restore.
+
+    Complements the scheduler's periodic pipeline tables (scheduler.py)
+    with the snapshot-level view: where did the wall time go — state_dict
+    materialization, write planning, staging, storage I/O, commit?
+    (Reference observability is the scheduler progress table only,
+    scheduler.py:96-175; this is the layer above it.)
+    """
+
+    def __init__(self, op: str) -> None:
+        self.op = op
+        self.phases: List[Tuple[str, float]] = []
+        self._t = time.perf_counter()
+
+    def mark(self, name: str) -> None:
+        now = time.perf_counter()
+        self.phases.append((name, now - self._t))
+        self._t = now
+
+    def log(self) -> None:
+        total = sum(dt for _, dt in self.phases)
+        logger.info(
+            "%s completed in %.3fs (%s)",
+            self.op,
+            total,
+            ", ".join(f"{n}={dt:.3f}s" for n, dt in self.phases),
+        )
+
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 
 
@@ -120,6 +151,7 @@ class Snapshot:
         storage = url_to_storage_plugin_in_event_loop(
             path, event_loop, storage_options
         )
+        timer = _PhaseTimer("Snapshot.take")
         try:
             # Synchronous take blocks the caller until I/O drains, so staged
             # buffers may alias caller memory — halves host memory traffic
@@ -132,12 +164,16 @@ class Snapshot:
                     pg_wrapper=pg_wrapper,
                     storage=storage,
                     event_loop=event_loop,
+                    timer=timer,
                 )
             pending_io_work.sync_complete(event_loop)
+            timer.mark("io_drain")
             pg_wrapper.barrier()
             if pg_wrapper.get_rank() == 0:
                 cls._write_snapshot_metadata(metadata, storage, event_loop)
             pg_wrapper.barrier()
+            timer.mark("commit")
+            timer.log()
         finally:
             # Retire on failure too (a pure non-blocking write): a training
             # loop that catches failed takes must not leak store keys.
@@ -172,6 +208,7 @@ class Snapshot:
         storage = url_to_storage_plugin_in_event_loop(
             path, event_loop, storage_options
         )
+        timer = _PhaseTimer("Snapshot.async_take")
         pending_io_work, metadata = cls._take_impl(
             path=path,
             app_state=app_state,
@@ -179,6 +216,7 @@ class Snapshot:
             pg_wrapper=pg_wrapper,
             storage=storage,
             event_loop=event_loop,
+            timer=timer,
         )
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -189,6 +227,7 @@ class Snapshot:
             storage=storage,
             event_loop=event_loop,
             storage_options=storage_options,
+            timer=timer,
         )
 
     @classmethod
@@ -200,7 +239,9 @@ class Snapshot:
         pg_wrapper: PGWrapper,
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
+        timer: Optional[_PhaseTimer] = None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
+        timer = timer or _PhaseTimer("Snapshot.take")  # unlogged unless the caller logs
         rank = pg_wrapper.get_rank()
         world_size = pg_wrapper.get_world_size()
         app_state = dict(app_state)
@@ -246,6 +287,7 @@ class Snapshot:
                         if materialize_exc is None:
                             materialize_exc = e
                 pg_wrapper.barrier()
+            timer.mark("materialize")
 
             replicated_paths = cls._calculate_replicated_paths(
                 flattened, replicated, pg_wrapper
@@ -308,6 +350,7 @@ class Snapshot:
             memory_budget = get_process_memory_budget_bytes(
                 pg_wrapper if world_size > 1 else None
             )
+            timer.mark("plan")
             # Gather AFTER execute_write_reqs returns: staging (the
             # consistency point) is complete by then, so stage-time entry
             # mutations — notably integrity checksums — are present in the
@@ -325,6 +368,7 @@ class Snapshot:
                     )
                 except BaseException as e:  # noqa: B036
                     stage_exc = e
+            timer.mark("stage")
             global_manifest, peer_errors = cls._gather_manifest(
                 manifest, pg_wrapper, local_error=repr(stage_exc) if stage_exc else None
             )
@@ -340,6 +384,7 @@ class Snapshot:
                     "snapshot aborted — staging failed on peer rank(s): "
                     + "; ".join(failed)
                 )
+            timer.mark("gather")
             metadata = SnapshotMetadata(
                 version=__version__,
                 world_size=world_size,
@@ -364,9 +409,11 @@ class Snapshot:
         storage = url_to_storage_plugin_in_event_loop(
             self.path, event_loop, self._storage_options
         )
+        timer = _PhaseTimer("Snapshot.restore")
         try:
             metadata = self._read_metadata(storage, event_loop)
             available = get_manifest_for_rank(metadata, rank)
+            timer.mark("metadata")
             memory_budget = get_process_memory_budget_bytes(
                 pg_wrapper if pg_wrapper.get_world_size() > 1 else None
             )
@@ -411,8 +458,10 @@ class Snapshot:
                         if exc is None:
                             exc = e
                 pg_wrapper.barrier()
+            timer.mark("load")
             if exc is not None:
                 raise exc
+            timer.log()
         finally:
             try:
                 pg_wrapper.retire()
@@ -828,9 +877,11 @@ class PendingSnapshot:
         event_loop: asyncio.AbstractEventLoop,
         storage_options: Optional[Dict[str, Any]] = None,
         barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+        timer: Optional[_PhaseTimer] = None,
     ) -> None:
         self.path = path
         self.pg = pg_wrapper.pg
+        self._timer = timer
         self._storage_options = storage_options
         self._done_event = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -882,12 +933,17 @@ class PendingSnapshot:
             )
         try:
             pending_io_work.sync_complete(event_loop)
+            if self._timer is not None:
+                self._timer.mark("io_drain")
             if barrier is not None:
                 barrier.arrive(timeout=barrier_timeout_s)
             if pg_wrapper.get_rank() == 0:
                 Snapshot._write_snapshot_metadata(metadata, storage, event_loop)
             if barrier is not None:
                 barrier.depart(timeout=barrier_timeout_s)
+            if self._timer is not None:
+                self._timer.mark("commit")
+                self._timer.log()
             snapshot = Snapshot(self.path, self.pg, self._storage_options)
             snapshot._metadata = metadata
             self._snapshot = snapshot
