@@ -70,17 +70,13 @@ def main() -> None:
             restored = ckptr.restore(f"{tmp}/orbax")
             results["orbax_restore"] = time.perf_counter() - t0
 
-        # sanity: both restored trees bit-match the source
+        # sanity: both restored trees bit-match the source, every array
         import numpy as np
 
-        np.testing.assert_array_equal(
-            np.asarray(dst["param_0"], np.float32),
-            np.asarray(state["param_0"], np.float32),
-        )
-        np.testing.assert_array_equal(
-            np.asarray(restored["param_0"], np.float32),
-            np.asarray(state["param_0"], np.float32),
-        )
+        for k, src in state.items():
+            ref = np.asarray(src, np.float32)
+            np.testing.assert_array_equal(np.asarray(dst[k], np.float32), ref)
+            np.testing.assert_array_equal(np.asarray(restored[k], np.float32), ref)
 
         for name, dt in results.items():
             lib, direction = name.split("_")

@@ -21,7 +21,6 @@ from ..manifest import (
     PrimitiveEntry,
     ShardedArrayEntry,
 )
-from ..serialization import string_to_dtype
 from .array import ArrayIOPreparer
 from .chunked import ChunkedArrayIOPreparer
 from .object import ObjectIOPreparer

@@ -496,6 +496,14 @@ class Snapshot:
                     f"(see Snapshot docstring for the elasticity rules)."
                 )
             entry = available[logical_path]
+            if is_container_entry(entry):
+                raise RuntimeError(
+                    f"Structure mismatch restoring {logical_path!r}: the "
+                    f"destination has a leaf there, but the snapshot saved a "
+                    f"container ({type(entry).__name__}). Build the "
+                    f"destination state with the same nested structure it was "
+                    f"saved with (e.g. a dict/list with matching children)."
+                )
             if isinstance(entry, PrimitiveEntry):
                 flattened[logical_path] = entry.get_value()
                 continue
