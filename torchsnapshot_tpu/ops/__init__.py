@@ -18,6 +18,7 @@ from .ring_attention import (
     zigzag_ring_attention_sharded,
     zigzag_ring_self_attention,
 )
+from .ssm import ssm_mix, ssm_mix_sharded, ssm_scan, ssm_scan_sharded
 from .ulysses import ulysses_attention_sharded, ulysses_self_attention
 
 __all__ = [
@@ -28,6 +29,10 @@ __all__ = [
     "moe_ffn_sharded",
     "ring_attention_sharded",
     "ring_self_attention",
+    "ssm_mix",
+    "ssm_mix_sharded",
+    "ssm_scan",
+    "ssm_scan_sharded",
     "ulysses_attention_sharded",
     "ulysses_self_attention",
     "zigzag_ring_attention_sharded",
