@@ -8,3 +8,4 @@ from .transformer import (  # noqa: F401
     param_specs,
 )
 from . import embedding  # noqa: F401
+from . import ssm_lm  # noqa: F401

@@ -378,15 +378,8 @@ def state_specs(cfg: TransformerConfig, state: Dict[str, Any]) -> Dict[str, Any]
 
     Adam moments inherit their param's spec; scalars replicated.
     """
+    from ..parallel.mesh import optax_state_specs
+
     p_specs = param_specs(cfg)
-
-    # optax adamw state: (ScaleByAdamState(count, mu, nu), EmptyState/others)
-    def map_opt(entry):
-        if isinstance(entry, optax.ScaleByAdamState):
-            return optax.ScaleByAdamState(
-                count=P(), mu=p_specs, nu=p_specs
-            )
-        return jax.tree_util.tree_map(lambda _: P(), entry)
-
-    opt_spec = tuple(map_opt(e) for e in state["opt_state"])
+    opt_spec = optax_state_specs(p_specs, state["opt_state"])
     return {"params": p_specs, "opt_state": opt_spec, "step": P()}

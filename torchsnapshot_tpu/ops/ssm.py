@@ -86,10 +86,10 @@ def ssm_scan_sharded(
     a_cum, h_local = jax.lax.associative_scan(_scan_combine, (a, b), axis=1)
     prod = a_cum[:, -1]  # (B, ..., N) cumulative decay of this chunk
     last = h_local[:, -1]  # zero-init final state of this chunk
-    prods = jax.lax.all_gather(prod, axis_name)  # (ring, B, ..., N)
-    lasts = jax.lax.all_gather(last, axis_name)
+    # One collective: all_gather takes a pytree.
+    prods, lasts = jax.lax.all_gather((prod, last), axis_name)  # (ring, B, ..., N)
 
-    zeros = jnp.zeros_like(jnp.broadcast_to(last, lasts.shape[1:]))
+    zeros = jnp.zeros_like(last)
     h_start = zeros if h0 is None else h0 + zeros
 
     # Single pass over the chunk chain: state entering chunk i is the fold

@@ -75,3 +75,22 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(
         _put, tree, specs, is_leaf=lambda x: x is None
     )
+
+
+def optax_state_specs(p_specs: Any, opt_state: Any) -> Tuple[Any, ...]:
+    """PartitionSpecs for an optax optimizer state given the param specs.
+
+    Adam-family moments (mu/nu) inherit their parameter's spec; everything
+    else (counts, empty states, schedule scalars) is replicated. Scalars
+    must be placed ON the mesh, not left uncommitted: a restored scalar
+    comes back committed, and a single-device scalar next to
+    mesh-committed params is an invalid jit input mix.
+    """
+    import optax
+
+    def map_entry(entry):
+        if isinstance(entry, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(count=P(), mu=p_specs, nu=p_specs)
+        return jax.tree_util.tree_map(lambda _: P(), entry)
+
+    return tuple(map_entry(e) for e in opt_state)
