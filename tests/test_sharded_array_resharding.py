@@ -177,3 +177,16 @@ def test_bf16_sharded_roundtrip(tmp_path) -> None:
                                      NamedSharding(mesh, P(None, "x"))))
     snapshot.restore({"m": dst})
     assert np.asarray(dst["w"]).tobytes() == np.asarray(data).tobytes()
+
+
+@pytest.mark.parametrize("src_kind,dst_kind", [("1d_row", "2d"), ("2d_flip", "1d_col")])
+def test_async_take_reshards(tmp_path, src_kind, dst_kind) -> None:
+    """async_take of sharded arrays + restore into a different sharding —
+    the async path must compose with resharding like the sync path."""
+    arr, data = _make_sharded(src_kind, seed=3)
+    pending = Snapshot.async_take(str(tmp_path / "snap"), {"m": StateDict(emb=arr)})
+    snapshot = pending.wait()
+    dst_arr, _ = _make_sharded(dst_kind, seed=4)
+    dst = StateDict(emb=dst_arr)
+    snapshot.restore({"m": dst})
+    np.testing.assert_array_equal(np.asarray(dst["emb"]), data)

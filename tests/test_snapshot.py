@@ -318,3 +318,33 @@ def test_kitchen_sink_all_features(tmp_path, monkeypatch) -> None:
     bad = StateDict(blob=None)  # leaf where the snapshot saved a container
     with pytest.raises(RuntimeError, match="Structure mismatch"):
         snapshot.restore({"m": bad})
+
+
+def test_auto_replication_detection(monkeypatch) -> None:
+    """A fully-replicated multi-process jax.Array is auto-detected as
+    replicated (the DDP-auto-detect analogue); sharded or single-process
+    arrays are not."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu.snapshot import _is_process_replicated_jax_array
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs.reshape(4), ("x",))
+    repl = jax.device_put(jnp.ones(8), NamedSharding(mesh, P(None)))
+    shard = jax.device_put(jnp.ones(8), NamedSharding(mesh, P("x")))
+
+    # Single-process: never auto-replicated (each process is the world).
+    assert not _is_process_replicated_jax_array(repl)
+    # Simulate a 4-process world where the mesh spans all processes.
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(
+        type(next(iter(repl.sharding.device_set))),
+        "process_index",
+        property(lambda d: d.id),
+        raising=False,
+    )
+    assert _is_process_replicated_jax_array(repl)
+    assert not _is_process_replicated_jax_array(shard)  # not fully replicated
+    assert not _is_process_replicated_jax_array(np.ones(8))  # not a jax array
