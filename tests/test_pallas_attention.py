@@ -93,6 +93,16 @@ def test_flash_indivisible_raises() -> None:
         flash_attention(q, k, v, block_q=48, block_k=48)
 
 
+def test_flash_default_blocks_snap_to_divisor() -> None:
+    """Default blocks auto-pick the largest divisor of S (<= 512): a seq
+    len like 160 (divisible by 32, not by 512) must run, not raise."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (1, 160, 2, 16)) for kk in ks)
+    ref = dense_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_transformer_flash_matches_dense() -> None:
     from torchsnapshot_tpu.models import transformer as T
 
