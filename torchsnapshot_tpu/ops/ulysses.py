@@ -78,12 +78,15 @@ def ulysses_self_attention(
     qh, kh, vh = to_head_sharded(q), to_head_sharded(k), to_head_sharded(v)
     S = qh.shape[1]
     bs = pick_block_size(S, inner_block_size)
-    if inner == "flash" and bs is not None:
+    # Gate flash on the KERNEL's own tiling pick (512 target), not the
+    # blockwise knob: the kernel chooses its tuned tiles itself, so the
+    # gate must agree with what it will actually pick or an S the gate
+    # accepts could fail the kernel's divisibility check.
+    if inner == "flash" and pick_block_size(S, 512) is not None:
         from .pallas_attention import flash_attention
 
-        # The kernel picks its own tuned tiling (512-target divisors of S);
-        # inner_block_size is the blockwise scan's memory knob, and
-        # inheriting it here would hand the MXU badly-undersized tiles.
+        # inner_block_size is the blockwise scan's memory knob; inheriting
+        # it here would hand the MXU badly-undersized tiles.
         out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
     elif inner == "blockwise" and bs is not None and S > inner_block_size:
         out = blockwise_attention(qh, kh, vh, block_size=bs, causal=causal, scale=scale)
