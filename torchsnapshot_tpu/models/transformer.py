@@ -141,6 +141,20 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
 
 
+def _flash_mesh_ok(cfg: TransformerConfig, mesh: Mesh, B: int, S: int) -> bool:
+    """Preconditions for routing attention through the shard_mapped flash
+    kernel under a mesh: heads divide the 'model' axis, batch divides the
+    'data' axis, and S has a kernel-viable tile divisor (the kernel picks
+    its own 512-target tiling, so the gate must agree with that pick)."""
+    from ..ops.attention import pick_block_size
+
+    if "model" not in mesh.axis_names or cfg.n_heads % mesh.shape["model"]:
+        return False
+    if "data" in mesh.axis_names and B % mesh.shape["data"]:
+        return False
+    return pick_block_size(S, 512) is not None
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -168,15 +182,17 @@ def forward(
     if c.attn_impl not in impls:
         raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
     if c.attn_impl == "auto":
-        # Backend-aware SINGLE-DEVICE kernel choice: the Pallas flash
-        # kernel on TPU (11.7x over the blockwise scan fwd+bwd, measured),
-        # blockwise once S outgrows one block (O(S*block) memory), dense
-        # for short sequences. Never selects a cp impl (ring/zigzag/
-        # ulysses are mesh topology decisions for the caller), and never
-        # flash under a mesh: a bare pallas_call has no partitioning rule,
-        # so GSPMD would gather the sharded q/k/v it receives — callers
-        # who want the kernel sharded use ulysses (which shard_maps it).
-        if mesh is None and jax.default_backend() == "tpu":
+        # Backend-aware kernel choice: the Pallas flash kernel on TPU
+        # (11.7x over the blockwise scan fwd+bwd, measured) — bare on a
+        # single device, shard_mapped over batch/heads under a mesh when
+        # the preconditions hold (_flash_mesh_ok; a bare pallas_call has
+        # no partitioning rule, so it must never see sharded operands);
+        # blockwise once S outgrows one block (O(S*block) memory); dense
+        # for short sequences. Never selects a cp impl — ring/zigzag/
+        # ulysses are mesh topology decisions for the caller.
+        if jax.default_backend() == "tpu" and (
+            mesh is None or _flash_mesh_ok(c, mesh, B, S)
+        ):
             impl = "flash"
         elif S > c.attn_block_size:
             impl = "blockwise"
@@ -250,9 +266,26 @@ def forward(
             bs = pick_block_size(S, c.attn_block_size)
             if bs is not None:
                 if c.attn_impl == "flash":
-                    from ..ops.pallas_attention import flash_attention
+                    if mesh is not None:
+                        # Under a mesh the bare pallas_call would make
+                        # GSPMD gather the sharded operands; shard_map the
+                        # kernel over batch/heads instead (attention is
+                        # embarrassingly parallel there). Falls through to
+                        # blockwise when the preconditions don't hold.
+                        if _flash_mesh_ok(c, mesh, B, S):
+                            from ..ops.pallas_attention import (
+                                flash_attention_sharded,
+                            )
 
-                    return flash_attention(q, k, v, causal=True, block_q=bs, block_k=bs)
+                            return flash_attention_sharded(
+                                q, k, v, mesh, causal=True
+                            )
+                    else:
+                        from ..ops.pallas_attention import flash_attention
+
+                        return flash_attention(
+                            q, k, v, causal=True, block_q=bs, block_k=bs
+                        )
                 from ..ops.attention import blockwise_attention
 
                 return blockwise_attention(q, k, v, block_size=bs, causal=True)

@@ -11,7 +11,7 @@ so the checkpointing layer has real context-parallel state to snapshot.
 
 from .attention import blockwise_attention, dense_attention
 from .moe import moe_ffn, moe_ffn_sharded
-from .pallas_attention import flash_attention
+from .pallas_attention import flash_attention, flash_attention_sharded
 from .ring_attention import (
     ring_attention_sharded,
     ring_self_attention,
@@ -25,6 +25,7 @@ __all__ = [
     "blockwise_attention",
     "dense_attention",
     "flash_attention",
+    "flash_attention_sharded",
     "moe_ffn",
     "moe_ffn_sharded",
     "ring_attention_sharded",

@@ -360,3 +360,53 @@ def flash_attention(
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     out = flash(qt, kt, vt)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = "model",
+) -> jax.Array:
+    """Flash attention under a ('data','model') mesh via ``shard_map``.
+
+    A bare ``pallas_call`` has no GSPMD partitioning rule, so calling
+    :func:`flash_attention` on sharded operands would make XLA gather
+    them. Attention is embarrassingly parallel over batch and heads, so
+    this wrapper shard_maps the kernel with batch over ``batch_axis`` and
+    heads over ``head_axis`` — each device runs the kernel on its local
+    (B_l, S, H_l, D) block, zero communication. Heads must divide the
+    head-axis size (callers fall back to blockwise otherwise).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    axes = set(mesh.axis_names)
+    b = batch_axis if batch_axis in axes else None
+    h = head_axis if head_axis in axes else None
+    if h is not None and q.shape[2] % mesh.shape[h]:
+        raise ValueError(
+            f"flash_attention_sharded needs heads ({q.shape[2]}) divisible "
+            f"by the {h!r} axis size ({mesh.shape[h]})"
+        )
+    if b is not None and q.shape[0] % mesh.shape[b]:
+        raise ValueError(
+            f"flash_attention_sharded needs batch ({q.shape[0]}) divisible "
+            f"by the {b!r} axis size ({mesh.shape[b]})"
+        )
+    spec = P(b, None, h, None)
+
+    def fn(q, k, v):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    # Interpret mode (off-TPU testing) trips shard_map's varying-axes
+    # checker with a jax-internal false positive (see ulysses.py); the
+    # checker stays on for real TPU compiles.
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=jax.default_backend() == "tpu",
+    )(q, k, v)
