@@ -1,12 +1,21 @@
-"""TPU-native hot ops: attention kernels and context-parallel primitives.
+"""TPU-native hot ops: attention kernels, context/expert parallelism, SSM.
 
 The reference (torchsnapshot) contains no model or attention code — it is a
 checkpointing library (SURVEY.md §5.7 records the absence). This package
 exists because the TPU framework treats long-context and distributed
-execution as first-class: blockwise (flash-style) attention keeps HBM usage
-linear in sequence length, and ring attention shards the sequence dimension
-over a mesh axis with K/V rotating on the ICI ring (`jax.lax.ppermute`),
-so the checkpointing layer has real context-parallel state to snapshot.
+execution as first-class, so the checkpointing layer has real parallel
+state to snapshot:
+
+- blockwise (flash-style) attention in pure JAX, and Pallas TPU flash
+  kernels for forward AND backward (plus a shard_mapped variant for tp
+  meshes);
+- ring attention (K/V rotating on the ICI ring via ``ppermute``) and its
+  causally load-balanced zigzag variant; Ulysses all-to-all sequence
+  parallelism;
+- GShard-style top-2 MoE with einsum and sort-based dispatch, and an
+  explicit all-to-all expert-parallel path;
+- selective-SSM sequence mixing via associative scan, with a
+  sequence-parallel cross-chunk carry.
 """
 
 from .attention import blockwise_attention, dense_attention
