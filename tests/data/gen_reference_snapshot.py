@@ -1,0 +1,59 @@
+"""Regenerate tests/data/reference_snapshot with the reference library.
+
+Run on a machine where the reference (pytorch/torchsnapshot) is importable:
+
+    python tests/data/gen_reference_snapshot.py [/path/to/reference]
+
+The fixture pins the reference's on-disk format (YAML manifest + payload
+files) so tests/test_torchsnapshot_interop.py can verify the migration
+reader without the reference installed. Keep the state tiny — the fixture
+is committed.
+"""
+
+import os
+import shutil
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "reference_snapshot")
+
+
+def main() -> None:
+    ref = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+    sys.path.insert(0, ref)
+    import torch
+    import torchsnapshot
+    from torchsnapshot import Snapshot, StateDict
+
+    # Force multi-chunk output so chunk reassembly is pinned (the default
+    # chunk size is bound at function-definition time, so wrap the method).
+    prep = torchsnapshot.io_preparer.ChunkedTensorIOPreparer
+    orig = prep.chunk_tensor
+    prep.chunk_tensor = staticmethod(
+        lambda tensor, chunking_dim=0, chunk_sz_bytes=64: orig(tensor, chunking_dim, 64)
+    )
+
+    torch.manual_seed(0)
+    sd = StateDict(
+        step=7,
+        lr=0.125,
+        done=False,
+        name="run/alpha",  # exercises %-escaping of '/' in keys? (value only)
+        blob=b"\x00\x01\xff",
+        weights=torch.arange(48, dtype=torch.float32).reshape(6, 8),  # 3 chunks
+        bf=torch.arange(6, dtype=torch.float32).to(torch.bfloat16),
+        nested={
+            "a": [torch.full((2,), 3.0), "mid", 11],
+            "b": {"c": torch.arange(5, dtype=torch.int64)},
+            "esc/key": torch.ones(2, dtype=torch.int8),
+        },
+        opt=dict(momenta=(0.9, 0.999), eps=1e-8),
+    )
+    if os.path.exists(OUT):
+        shutil.rmtree(OUT)
+    Snapshot.take(path=OUT, app_state={"app": sd})
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()

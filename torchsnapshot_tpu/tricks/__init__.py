@@ -3,13 +3,20 @@
 The reference ships one "trick": an adapter that lets a DeepSpeed ZeRO-3
 engine checkpoint through Snapshot (tricks/deepspeed.py:19-103). The TPU
 ecosystem's counterparts are flax ``TrainState`` objects (immutable pytree
-dataclasses) and orbax checkpoints; adapters for both live here. Imports
-are lazy so the core library never requires flax/orbax.
+dataclasses), orbax checkpoints, and — for users migrating from the
+reference itself — its on-disk snapshot format; adapters for all three
+live here. Imports are lazy so the core library never requires
+flax/orbax/torch.
 """
 
 from typing import Any
 
-__all__ = ["FlaxTrainStateAdapter", "PytreeAdapter"]
+__all__ = [
+    "FlaxTrainStateAdapter",
+    "PytreeAdapter",
+    "load_torchsnapshot",
+    "migrate_from_torchsnapshot",
+]
 
 
 def __getattr__(name: str) -> Any:
@@ -18,4 +25,8 @@ def __getattr__(name: str) -> Any:
 
         return {"FlaxTrainStateAdapter": FlaxTrainStateAdapter,
                 "PytreeAdapter": PytreeAdapter}[name]
+    if name in ("load_torchsnapshot", "migrate_from_torchsnapshot"):
+        from . import torchsnapshot_interop as _tsi
+
+        return getattr(_tsi, name)
     raise AttributeError(name)

@@ -1,0 +1,434 @@
+"""Migration from reference-format torchsnapshot snapshots.
+
+A user of the reference library (pytorch/torchsnapshot) switching to this
+framework has existing checkpoints on disk in the reference's on-disk
+format: a ``.snapshot_metadata`` YAML manifest plus one payload file per
+tensor chunk / shard / object (reference: snapshot.py:72, io_preparer.py:
+792-798, manifest.py:255-321). This module reads that format black-box —
+from its documented YAML schema, not from the reference's code — and
+materializes the app state as plain Python/NumPy pytrees, optionally
+re-writing it as a native snapshot.
+
+Covered entry types (reference manifest.py:37-242):
+
+- ``Tensor``: ``buffer_protocol`` payloads are raw little-endian row-major
+  bytes, decoded via a torch-dtype-name -> numpy mapping (bfloat16 and the
+  float8 family via ml_dtypes); ``torch_save`` payloads are decoded with
+  ``torch.load`` (requires torch, imported lazily).
+- ``ChunkedTensor``: chunks are reassembled into the full array by their
+  N-D offsets (reference io_preparer.py:113-141).
+- ``ShardedTensor``: shards from *all* ranks are merged into one dense
+  array (reference manifest.py:324-382 merges shards across ranks).
+- ``object``: unpickled with ``torch.load``; contained torch.Tensors are
+  converted to numpy arrays when ``convert_tensors`` is set.
+- primitives (``int``/``float``/``str``/``bool``/``bytes``): parsed from
+  the inlined ``serialized_value`` (float/bytes are base64; float is a
+  little-endian IEEE-754 double — reference manifest.py:146-242).
+- containers (``dict``/``OrderedDict``/``list``): rebuilt in manifest
+  order; ``%``-escaped path tokens are unescaped the way the reference's
+  flatten layer escapes them (reference flatten.py:158-165).
+
+Quantized-tensor payloads (``per_tensor_affine_qtensor`` /
+``per_channel_affine_qtensor``) are rejected with a clear error: JAX has
+no quantized array type (see serialization.py's documented divergence).
+
+Like the orbax trick, imports are lazy: the core library never requires
+torch or yaml beyond what it already uses.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import struct
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import unquote
+
+import numpy as np
+
+SNAPSHOT_METADATA_FILENAME = ".snapshot_metadata"
+
+# torch dtype-string -> numpy dtype. This is the interop boundary: the
+# reference stamps entries with ``str(tensor.dtype)`` (e.g. "torch.float32"),
+# so the names are pinned by torch's public API, not by the reference's code.
+_TORCH_DTYPE_TO_NP: Dict[str, Any] = {
+    "torch.float32": np.dtype(np.float32),
+    "torch.float": np.dtype(np.float32),
+    "torch.float64": np.dtype(np.float64),
+    "torch.double": np.dtype(np.float64),
+    "torch.float16": np.dtype(np.float16),
+    "torch.half": np.dtype(np.float16),
+    "torch.int8": np.dtype(np.int8),
+    "torch.int16": np.dtype(np.int16),
+    "torch.short": np.dtype(np.int16),
+    "torch.int32": np.dtype(np.int32),
+    "torch.int": np.dtype(np.int32),
+    "torch.int64": np.dtype(np.int64),
+    "torch.long": np.dtype(np.int64),
+    "torch.uint8": np.dtype(np.uint8),
+    "torch.bool": np.dtype(np.bool_),
+    "torch.complex64": np.dtype(np.complex64),
+    "torch.complex128": np.dtype(np.complex128),
+}
+
+try:  # bf16 / fp8 arrive via ml_dtypes (ships with jax)
+    import ml_dtypes
+
+    _TORCH_DTYPE_TO_NP["torch.bfloat16"] = np.dtype(ml_dtypes.bfloat16)
+    _TORCH_DTYPE_TO_NP["torch.float8_e4m3fn"] = np.dtype(ml_dtypes.float8_e4m3fn)
+    _TORCH_DTYPE_TO_NP["torch.float8_e5m2"] = np.dtype(ml_dtypes.float8_e5m2)
+except (ImportError, AttributeError):  # pragma: no cover
+    pass
+
+_QUANTIZED_SERIALIZERS = frozenset(
+    ["per_tensor_affine_qtensor", "per_channel_affine_qtensor"]
+)
+
+
+def _torch_dtype_to_np(dtype_str: str) -> np.dtype:
+    try:
+        return _TORCH_DTYPE_TO_NP[dtype_str]
+    except KeyError:
+        raise ValueError(
+            f"Cannot map torch dtype {dtype_str!r} to a numpy dtype. "
+            "Quantized dtypes have no JAX equivalent; other dtypes may "
+            "need an ml_dtypes upgrade."
+        ) from None
+
+
+def read_metadata(path: str) -> Dict[str, Any]:
+    """Parse a reference snapshot's ``.snapshot_metadata`` YAML.
+
+    Returns ``{"version": str, "world_size": int, "manifest": {path: entry}}``
+    with manifest insertion order preserved (the reference relies on YAML
+    document order for list reconstruction).
+    """
+    import yaml
+
+    with open(os.path.join(path, SNAPSHOT_METADATA_FILENAME), "rb") as f:
+        meta = yaml.safe_load(f.read())
+    if not isinstance(meta, dict) or "manifest" not in meta:
+        raise ValueError(f"{path} does not look like a torchsnapshot snapshot")
+    return meta
+
+
+def _read_file(path: str, location: str, byte_range: Optional[List[int]]) -> bytes:
+    with open(os.path.join(path, location), "rb") as f:
+        if byte_range is None:
+            return f.read()
+        f.seek(byte_range[0])
+        return f.read(byte_range[1] - byte_range[0])
+
+
+def _decode_tensor(path: str, entry: Dict[str, Any]) -> np.ndarray:
+    """Decode a reference ``Tensor`` entry into a writable numpy array."""
+    serializer = entry["serializer"]
+    if serializer == "buffer_protocol":
+        dtype = _torch_dtype_to_np(entry["dtype"])
+        shape = entry["shape"]
+        nelem = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        byte_range = entry.get("byte_range")
+        # np.fromfile reads straight into a fresh writable array (frombuffer
+        # over read() bytes would yield a read-only view).
+        with open(os.path.join(path, entry["location"]), "rb") as f:
+            if byte_range is not None:
+                f.seek(byte_range[0])
+            arr = np.fromfile(f, dtype=dtype, count=nelem)
+        if arr.size != nelem:
+            raise ValueError(
+                f"Payload {entry['location']!r} is truncated: expected "
+                f"{nelem} elements of {dtype}, got {arr.size}"
+            )
+        return arr.reshape(shape)
+    if serializer in _QUANTIZED_SERIALIZERS:
+        raise ValueError(
+            f"Entry at {entry['location']!r} is a quantized tensor "
+            f"({serializer}); JAX has no quantized array type. Dequantize "
+            "in torch before migrating."
+        )
+    if serializer != "torch_save":
+        raise ValueError(f"Unknown serializer {serializer!r}")
+    buf = _read_file(path, entry["location"], entry.get("byte_range"))
+    import io as _io
+
+    import torch
+
+    # The payload is a bare tensor; weights_only keeps unpickling
+    # restricted (no arbitrary-object gadgets from a hostile snapshot).
+    t = torch.load(_io.BytesIO(buf), map_location="cpu", weights_only=True)
+    return _torch_to_np(t)
+
+
+def _torch_to_np(t: Any) -> np.ndarray:
+    """torch.Tensor -> numpy, bridging dtypes numpy can't express natively.
+
+    bf16/fp8 travel through the reference's torch_save serializer (they are
+    not in its buffer-protocol dtype table), so they land here and need a
+    bit-pattern reinterpret into their ml_dtypes equivalents.
+    """
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    for torch_name, ml_name in (
+        ("float8_e4m3fn", "float8_e4m3fn"),
+        ("float8_e5m2", "float8_e5m2"),
+        ("float8_e4m3fnuz", "float8_e4m3fnuz"),
+        ("float8_e5m2fnuz", "float8_e5m2fnuz"),
+    ):
+        if hasattr(torch, torch_name) and t.dtype == getattr(torch, torch_name):
+            import ml_dtypes
+
+            return t.view(torch.uint8).numpy().view(getattr(ml_dtypes, ml_name))
+    return t.numpy()
+
+
+def _fill_region(
+    out: np.ndarray, tensor: np.ndarray, offsets: List[int], sizes: List[int]
+) -> None:
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, sizes))
+    out[idx] = tensor.reshape(sizes)
+
+
+def _check_coverage(
+    boxes: List[Tuple[Tuple[int, ...], Tuple[int, ...]]], shape: List[int], what: str
+) -> None:
+    """Require disjoint (offsets, sizes) boxes to tile ``shape`` exactly.
+
+    Valid reference snapshots partition a tensor into disjoint chunks/
+    shards; a missing box would otherwise leave uninitialized memory in
+    the output (the arrays are allocated with np.empty).
+    """
+    covered = sum(int(np.prod(sz, dtype=np.int64)) for _, sz in boxes)
+    total = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if covered != total:
+        raise ValueError(
+            f"{what} cover {covered} of {total} elements of shape "
+            f"{list(shape)}: the snapshot is missing or duplicating regions"
+        )
+
+
+def _decode_chunked(path: str, entry: Dict[str, Any]) -> np.ndarray:
+    dtype = _torch_dtype_to_np(entry["dtype"])
+    out = np.empty(entry["shape"], dtype=dtype)
+    _check_coverage(
+        [(tuple(c["offsets"]), tuple(c["sizes"])) for c in entry["chunks"]],
+        entry["shape"],
+        "chunks",
+    )
+    for chunk in entry["chunks"]:
+        t = _decode_tensor(path, chunk["tensor"])
+        _fill_region(out, t, chunk["offsets"], chunk["sizes"])
+    return out
+
+
+def _decode_sharded(path: str, shards: List[Dict[str, Any]]) -> np.ndarray:
+    """Merge shards (gathered across all ranks) into one dense array.
+
+    The reference's shard metadata carries no global shape, so it is
+    inferred as the bounding box of the shards; the coverage check then
+    rejects interior gaps. (Loss of ALL trailing shards is undetectable
+    at this level — the bounding box shrinks with them — which is why
+    ``_merge_for_rank`` separately verifies that every rank up to the
+    manifest's world_size contributed entries.) Identical shards saved by
+    multiple ranks are deduplicated by their box first.
+    """
+    if not shards:
+        raise ValueError("ShardedTensor entry with no shards")
+    dedup = {
+        (tuple(s["offsets"]), tuple(s["sizes"])): s for s in shards
+    }
+    ndim = len(shards[0]["offsets"])
+    full_shape = [
+        max(off[d] + sz[d] for off, sz in dedup) for d in range(ndim)
+    ]
+    _check_coverage(list(dedup.keys()), full_shape, "shards")
+    dtype = _torch_dtype_to_np(shards[0]["tensor"]["dtype"])
+    out = np.empty(full_shape, dtype=dtype)
+    for (offsets, sizes), shard in dedup.items():
+        t = _decode_tensor(path, shard["tensor"])
+        _fill_region(out, t, list(offsets), list(sizes))
+    return out
+
+
+def _decode_primitive(entry: Dict[str, Any]) -> Any:
+    typ = entry["type"]
+    val = entry["serialized_value"]
+    if typ == "int":
+        return int(val)
+    if typ == "str":
+        return str(val)
+    if typ == "bool":
+        return val == "True"
+    if typ == "float":
+        # Inlined as base64 little-endian IEEE-754 double for exactness
+        # (reference manifest.py:146-242).
+        return struct.unpack("<d", base64.b64decode(val))[0]
+    if typ == "bytes":
+        return base64.b64decode(val)
+    raise ValueError(f"Unknown primitive type {typ!r}")
+
+
+def _decode_object(path: str, entry: Dict[str, Any], convert_tensors: bool) -> Any:
+    import io as _io
+
+    import torch
+
+    buf = _read_file(path, entry["location"], entry.get("byte_range"))
+    obj = torch.load(_io.BytesIO(buf), map_location="cpu", weights_only=False)
+    if convert_tensors:
+        obj = _convert_tensors_to_np(obj)
+    return obj
+
+
+def _convert_tensors_to_np(obj: Any) -> Any:
+    import torch
+
+    if isinstance(obj, torch.Tensor):
+        return _torch_to_np(obj)
+    if isinstance(obj, (dict, OrderedDict)):
+        return type(obj)((k, _convert_tensors_to_np(v)) for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_convert_tensors_to_np(v) for v in obj)
+    return obj
+
+
+def _merge_for_rank(
+    manifest: Dict[str, Dict[str, Any]], rank: int, world_size: Optional[int] = None
+) -> "OrderedDict[str, Dict[str, Any]]":
+    """Compute the logical-path view for ``rank``, reference-style.
+
+    Mirrors the availability rules of reference manifest.py:324-382:
+    per-rank entries come from ``rank``'s prefix only; replicated entries
+    from any rank (first wins — the gather step already deduplicated their
+    chunk lists); ShardedTensor shards are merged across *all* ranks.
+    Container entries come only from ``rank``'s own prefix — foreign
+    containers would surface other ranks' private subtrees as phantom
+    empty dicts (the reference drops all foreign containers too).
+    """
+    merged: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    sharded: Dict[str, List[Dict[str, Any]]] = {}
+    seen_ranks: set = set()
+    for key, entry in manifest.items():
+        owner_str, _, logical = key.partition("/")
+        try:
+            owner = int(owner_str)
+        except ValueError:
+            raise ValueError(f"Manifest key {key!r} lacks a rank prefix") from None
+        seen_ranks.add(owner)
+        if entry["type"] == "ShardedTensor":
+            sharded.setdefault(logical, []).extend(entry["shards"])
+            merged.setdefault(logical, {"type": "ShardedTensor", "shards": []})
+        elif entry["type"] in ("dict", "OrderedDict", "list"):
+            if owner == rank:
+                merged.setdefault(logical, entry)
+        elif owner == rank or entry.get("replicated", False):
+            merged.setdefault(logical, entry)
+    if rank not in seen_ranks:
+        raise ValueError(
+            f"Rank {rank} did not save this snapshot (saved ranks: "
+            f"{sorted(seen_ranks)}). Pass the rank whose state you want to "
+            "materialize; sharded and replicated entries are identical "
+            "from every saved rank's view."
+        )
+    if world_size is not None and seen_ranks != set(range(world_size)):
+        # Without this, a snapshot that lost every entry of a trailing rank
+        # would load with sharded tensors silently truncated to the
+        # bounding box of the surviving shards.
+        raise ValueError(
+            f"Snapshot metadata says world_size={world_size} but entries "
+            f"exist only for ranks {sorted(seen_ranks)}: the snapshot is "
+            "incomplete (a rank's manifest entries were lost)."
+        )
+    for logical, shards in sharded.items():
+        merged[logical]["shards"] = shards
+    return merged
+
+
+def load_torchsnapshot(
+    path: str, rank: int = 0, convert_tensors: bool = True
+) -> Dict[str, Any]:
+    """Read a reference-format snapshot into nested Python/NumPy state.
+
+    Returns ``{app_state_key: state}`` — e.g. a snapshot taken with
+    ``Snapshot.take(path, {"model": model})`` in the reference yields
+    ``{"model": <state dict>}`` with torch tensors as numpy arrays
+    (bf16/fp8 via ml_dtypes, directly consumable by ``jnp.asarray``).
+
+    ``rank`` selects which rank's per-rank entries to materialize;
+    replicated entries and merged sharded tensors are visible to every
+    rank, matching the reference's elasticity rules.
+
+    .. warning:: Snapshots are code. ``object`` entries are arbitrary
+       pickles and are unpickled with ``torch.load(weights_only=False)``
+       — exactly what the reference's own restore does — so only load
+       snapshots from sources you trust. Tensor payloads, by contrast,
+       are decoded with ``weights_only=True`` / raw-byte reads and are
+       safe on their own.
+    """
+    meta = read_metadata(path)
+    view = _merge_for_rank(meta["manifest"], rank, meta.get("world_size"))
+
+    # Reference paths escape only '%' and '/' (flatten.py:158-165); the
+    # native flattener escapes every URL-special byte. Re-normalize each
+    # token (unquote -> native escape) so the native inflate can be reused
+    # as the container-reconstruction inverse.
+    from ..flatten import _escape_key, inflate
+    from ..manifest import DictEntry, ListEntry, OrderedDictEntry
+
+    def normalize(logical: str) -> str:
+        return "/".join(_escape_key(unquote(t)) for t in logical.split("/"))
+
+    leaves: Dict[str, Any] = {}
+    containers: Dict[str, Any] = {}
+    root_keys: List[str] = []
+    for logical, entry in view.items():
+        typ = entry["type"]
+        norm = normalize(logical)
+        if "/" not in logical:
+            key = unquote(logical)
+            if key not in root_keys:
+                root_keys.append(key)
+        if typ == "dict":
+            containers[norm] = DictEntry(keys=list(entry.get("keys") or []))
+        elif typ == "OrderedDict":
+            containers[norm] = OrderedDictEntry(keys=list(entry.get("keys") or []))
+        elif typ == "list":
+            containers[norm] = ListEntry()
+        elif typ == "Tensor":
+            leaves[norm] = _decode_tensor(path, entry)
+        elif typ == "ChunkedTensor":
+            leaves[norm] = _decode_chunked(path, entry)
+        elif typ == "ShardedTensor":
+            leaves[norm] = _decode_sharded(path, entry["shards"])
+        elif typ == "object":
+            leaves[norm] = _decode_object(path, entry, convert_tensors)
+        else:
+            leaves[norm] = _decode_primitive(entry)
+
+    containers[""] = DictEntry(keys=root_keys)
+    return inflate(containers, leaves, prefix="")
+
+
+def migrate_from_torchsnapshot(
+    src_path: str, dst_path: str, rank: int = 0
+) -> Tuple[Any, Dict[str, Any]]:
+    """Convert a reference-format snapshot into a native snapshot.
+
+    Reads ``src_path`` (reference on-disk format) and takes a native
+    snapshot at ``dst_path`` with the same app-state keys. Returns
+    ``(Snapshot, state)`` so callers can inspect what was migrated.
+    """
+    from .. import Snapshot, StateDict
+
+    state = load_torchsnapshot(src_path, rank=rank)
+    app_state = {
+        # StateDict(mapping), not StateDict(**mapping): loaded dicts may
+        # have non-string (int) top-level keys.
+        key: StateDict(val) if isinstance(val, dict) else StateDict(value=val)
+        for key, val in state.items()
+    }
+    return Snapshot.take(dst_path, app_state), state
