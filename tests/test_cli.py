@@ -1,0 +1,159 @@
+"""CLI: info/ls/cat/verify/migrate over real snapshots.
+
+The reference has no CLI analogue; these commands wrap the manifest,
+read_object, integrity, and interop layers, so the tests double as
+integration coverage for those seams.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.cli import main
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "reference_snapshot")
+
+
+@pytest.fixture()
+def snap_path(tmp_path):
+    sd = StateDict(
+        step=5,
+        weights=np.arange(24, dtype=np.float32).reshape(4, 6),
+        nested={"b": np.ones(3, dtype=np.int64)},
+        note="hello",
+    )
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"app": sd})
+    return path
+
+
+def test_info(snap_path, capsys):
+    assert main(["info", snap_path]) == 0
+    out = capsys.readouterr().out
+    assert "world_size:  1" in out
+    assert "array" in out and "primitive" in out
+    assert "checksums:" in out
+
+
+def test_ls_filters_and_sizes(snap_path, capsys):
+    assert main(["ls", snap_path]) == 0
+    out = capsys.readouterr().out
+    assert "0/app/weights" in out and "float32[4, 6]" in out
+    assert "96" in out  # 24 * 4 bytes
+    # containers hidden by default, shown with --all
+    assert "0/app/nested " not in out
+    assert main(["ls", snap_path, "--all"]) == 0
+    assert "dict" in capsys.readouterr().out
+
+
+def test_cat_array_and_primitive(snap_path, capsys):
+    assert main(["cat", snap_path, "0/app/weights"]) == 0
+    out = capsys.readouterr().out
+    assert "float32[4, 6]" in out
+    assert main(["cat", snap_path, "0/app/note"]) == 0
+    assert "hello" in capsys.readouterr().out
+
+
+def test_verify_clean_and_corrupted(snap_path, capsys):
+    assert main(["verify", snap_path]) == 0
+    out = capsys.readouterr().out
+    assert "0 failed" in out
+
+    # Flip one byte of a payload: verify must fail with nonzero exit.
+    target = None
+    for root, _, files in os.walk(snap_path):
+        for f in files:
+            if f != ".snapshot_metadata" and "weights" in f:
+                target = os.path.join(root, f)
+    assert target is not None
+    blob = bytearray(open(target, "rb").read())
+    blob[0] ^= 0xFF
+    open(target, "wb").write(bytes(blob))
+
+    assert main(["verify", snap_path]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out
+
+
+def test_missing_payload_fails_verify(snap_path, capsys):
+    target = None
+    for root, _, files in os.walk(snap_path):
+        for f in files:
+            if "weights" in f:
+                target = os.path.join(root, f)
+    os.remove(target)
+    assert main(["verify", snap_path]) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+
+def test_migrate_reference_fixture(tmp_path, capsys):
+    dst = str(tmp_path / "native")
+    assert main(["migrate", FIXTURE, dst]) == 0
+    assert "migrated" in capsys.readouterr().out
+    v = Snapshot(dst).read_object("0/app/weights")
+    np.testing.assert_array_equal(
+        np.asarray(v), np.arange(48, dtype=np.float32).reshape(6, 8)
+    )
+    # native snapshots refuse re-migration
+    assert main(["migrate", dst, str(tmp_path / "x")]) == 1
+
+
+def test_error_path_returns_2(tmp_path, capsys):
+    assert main(["info", str(tmp_path / "nope")]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_looks_native_handles_type_name_collisions():
+    from torchsnapshot_tpu.cli import _looks_native
+
+    # Tensor-free reference snapshot: only objects + containers. The
+    # container/object type names collide with native ones; the torch_save
+    # serializer is the discriminator.
+    ref = {
+        "0/app": {"type": "dict", "keys": ["o"]},
+        "0/app/o": {"type": "object", "location": "0/app/o",
+                    "serializer": "torch_save", "obj_type": "builtins.tuple",
+                    "replicated": False},
+    }
+    assert not _looks_native(ref)
+    ref_prim = {"0/app/x": {"type": "int", "serialized_value": "3",
+                            "readable": None, "replicated": False}}
+    assert not _looks_native(ref_prim)
+    native = {
+        "0/app": {"type": "dict", "keys": ["o"]},
+        "0/app/o": {"type": "object", "location": "0/app/o",
+                    "serializer": "pickle", "obj_type": "builtins.tuple",
+                    "replicated": False},
+    }
+    assert _looks_native(native)
+
+
+def test_info_dedups_replicated_payloads(tmp_path, capsys):
+    """A replicated entry appears under every rank prefix but shares one
+    payload on disk; info must count its bytes once, not world_size times."""
+    import yaml as _yaml
+
+    root = tmp_path / "snap"
+    root.mkdir()
+    arr_entry = {
+        "type": "array",
+        "location": "replicated/app/w",
+        "serializer": "buffer_protocol",
+        "dtype": "float32",
+        "shape": [8],
+        "replicated": True,
+        "byte_range": None,
+        "checksum": None,
+    }
+    meta = {
+        "version": "0.1.0",
+        "world_size": 2,
+        "manifest": {"0/app/w": dict(arr_entry), "1/app/w": dict(arr_entry)},
+    }
+    (root / ".snapshot_metadata").write_text(_yaml.safe_dump(meta, sort_keys=False))
+    assert main(["info", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "payload:     32B" in out  # 8 * 4 bytes, once
+    assert "checksums:   0/1 payloads" in out
