@@ -1,0 +1,279 @@
+"""Incremental (deduplicated) snapshots — beyond-reference capability.
+
+take(incremental_base=...) skips storage writes for payloads whose content
+digest matches the base snapshot's; restore reads those payloads from the
+base. See torchsnapshot_tpu/dedup.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.manifest import ChunkedArrayEntry, ObjectEntry
+
+
+def _state(frozen_val=1.0, trainable_val=2.0, obj=frozenset({"a", 1})):
+    return StateDict(
+        frozen=np.full((64, 8), frozen_val, np.float32),
+        trainable=np.full((16, 4), trainable_val, np.float32),
+        meta=obj,
+        step=7,
+    )
+
+
+def _payload_files(root):
+    out = set()
+    for r, _, files in os.walk(root):
+        for f in files:
+            if f != ".snapshot_metadata":
+                out.add(os.path.relpath(os.path.join(r, f), root))
+    return out
+
+
+def test_base_records_digests(tmp_path):
+    base = str(tmp_path / "base")
+    Snapshot.take(base, {"app": _state()}, record_digests=True)
+    meta = Snapshot(base).metadata
+    entry = meta.manifest["0/app/frozen"]
+    assert isinstance(entry, ChunkedArrayEntry)
+    for chunk in entry.chunks:
+        assert chunk.array.digest is not None
+        assert chunk.array.digest.startswith("sha256:")
+        assert chunk.array.origin is None
+    obj_entry = meta.manifest["0/app/meta"]
+    assert isinstance(obj_entry, ObjectEntry) and obj_entry.digest is not None
+
+
+def test_incremental_skips_unchanged_and_restores(tmp_path):
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": _state()}, record_digests=True)
+    # trainable changed; frozen + meta unchanged
+    Snapshot.take(
+        inc,
+        {"app": _state(trainable_val=9.0)},
+        incremental_base=base,
+    )
+
+    files = _payload_files(inc)
+    assert not any("frozen" in f for f in files), files  # deduped
+    assert not any("meta" in f for f in files), files
+    assert any("trainable" in f for f in files), files  # rewritten
+
+    meta = Snapshot(inc).metadata
+    frozen = meta.manifest["0/app/frozen"]
+    for chunk in frozen.chunks:
+        assert chunk.array.origin == base
+    trainable = meta.manifest["0/app/trainable"]
+    for chunk in trainable.chunks:
+        assert chunk.array.origin is None
+
+    dst = _state(frozen_val=0.0, trainable_val=0.0, obj=None)
+    Snapshot(inc).restore({"app": dst})
+    np.testing.assert_array_equal(dst["frozen"], np.full((64, 8), 1.0, np.float32))
+    np.testing.assert_array_equal(dst["trainable"], np.full((16, 4), 9.0, np.float32))
+    assert dst["meta"] == frozenset({"a", 1})
+    assert dst["step"] == 7
+
+
+def test_chained_incrementals_resolve_origin_transitively(tmp_path):
+    a, b, c = (str(tmp_path / n) for n in "abc")
+    Snapshot.take(a, {"app": _state()}, record_digests=True)
+    Snapshot.take(b, {"app": _state(trainable_val=5.0)}, incremental_base=a)
+    Snapshot.take(c, {"app": _state(trainable_val=6.0)}, incremental_base=b)
+
+    meta = Snapshot(c).metadata
+    # frozen was written once, in A; C points straight at A (not at B)
+    for chunk in meta.manifest["0/app/frozen"].chunks:
+        assert chunk.array.origin == a
+    # trainable changed at every link: written locally in C
+    for chunk in meta.manifest["0/app/trainable"].chunks:
+        assert chunk.array.origin is None
+
+    dst = _state(0.0, 0.0, None)
+    Snapshot(c).restore({"app": dst})
+    np.testing.assert_array_equal(dst["frozen"], np.full((64, 8), 1.0, np.float32))
+    np.testing.assert_array_equal(dst["trainable"], np.full((16, 4), 6.0, np.float32))
+
+
+def test_async_take_incremental(tmp_path):
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": _state()}, record_digests=True)
+    pending = Snapshot.async_take(
+        inc, {"app": _state(trainable_val=3.5)}, incremental_base=base
+    )
+    pending.wait()
+    assert not any("frozen" in f for f in _payload_files(inc))
+    dst = _state(0.0, 0.0, None)
+    Snapshot(inc).restore({"app": dst})
+    np.testing.assert_array_equal(dst["frozen"], np.full((64, 8), 1.0, np.float32))
+    np.testing.assert_array_equal(dst["trainable"], np.full((16, 4), 3.5, np.float32))
+
+
+def test_read_object_follows_origin(tmp_path):
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": _state()}, record_digests=True)
+    Snapshot.take(inc, {"app": _state(trainable_val=4.0)}, incremental_base=base)
+    v = Snapshot(inc).read_object("0/app/frozen")
+    np.testing.assert_array_equal(np.asarray(v), np.full((64, 8), 1.0, np.float32))
+
+
+def test_missing_base_raises_actionable_error(tmp_path):
+    import shutil
+
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": _state()}, record_digests=True)
+    Snapshot.take(inc, {"app": _state(trainable_val=8.0)}, incremental_base=base)
+    shutil.rmtree(base)
+    dst = _state(0.0, 0.0, None)
+    with pytest.raises((RuntimeError, FileNotFoundError)):
+        Snapshot(inc).restore({"app": dst})
+
+
+def test_base_without_digests_rewrites_everything(tmp_path, caplog):
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": _state()})  # no record_digests
+    Snapshot.take(inc, {"app": _state()}, incremental_base=base)
+    # nothing to dedup against: every payload written locally
+    assert any("frozen" in f for f in _payload_files(inc))
+    dst = _state(0.0, 0.0, None)
+    Snapshot(inc).restore({"app": dst})
+    np.testing.assert_array_equal(dst["frozen"], np.full((64, 8), 1.0, np.float32))
+
+
+def test_cli_info_and_verify_on_incremental(tmp_path, capsys):
+    from torchsnapshot_tpu.cli import main
+
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": _state()}, record_digests=True)
+    Snapshot.take(inc, {"app": _state(trainable_val=2.5)}, incremental_base=base)
+
+    assert main(["info", inc]) == 0
+    out = capsys.readouterr().out
+    assert "external:" in out and base in out
+
+    assert main(["verify", inc]) == 0
+    out = capsys.readouterr().out
+    assert "0 failed" in out
+
+    # corrupt the payload in the BASE; verifying the incremental must fail
+    target = None
+    for r, _, files in os.walk(base):
+        for f in files:
+            if "frozen" in f:
+                target = os.path.join(r, f)
+    blob = bytearray(open(target, "rb").read())
+    blob[0] ^= 0xFF
+    open(target, "wb").write(bytes(blob))
+    assert main(["verify", inc]) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+
+def test_sharded_array_dedup(tmp_path):
+    """GSPMD-sharded arrays dedup per shard: sharded/... locations are
+    rank- and writer-independent, so an unchanged sharded param is skipped
+    even though a hash-elected writer stages it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+
+    def make(frozen_val, trainable_val):
+        return StateDict(
+            emb=jax.device_put(
+                jnp.full((8, 4), frozen_val, jnp.float32), sharding
+            ),
+            head=jax.device_put(
+                jnp.full((8, 4), trainable_val, jnp.float32), sharding
+            ),
+        )
+
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"app": make(1.0, 2.0)}, record_digests=True)
+    Snapshot.take(inc, {"app": make(1.0, 9.0)}, incremental_base=base)
+
+    files = _payload_files(inc)
+    assert not any("emb" in f for f in files), files
+    assert any("head" in f for f in files), files
+
+    dst = make(0.0, 0.0)
+    Snapshot(inc).restore({"app": dst})
+    np.testing.assert_array_equal(
+        np.asarray(dst["emb"]), np.full((8, 4), 1.0, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dst["head"]), np.full((8, 4), 9.0, np.float32)
+    )
+
+
+def _multiproc_incremental_worker(rank, world_size, base_path, inc_path):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    def make(trainable_val):
+        return {
+            "model": StateDict(
+                frozen=np.arange(2048, dtype=np.float32).reshape(64, 32),
+                head=np.full((16,), trainable_val, np.float32),
+            ),
+            "local": StateDict(rank_data=np.full((4,), rank, np.int32)),
+        }
+
+    Snapshot.take(
+        base_path, make(1.0), replicated=["model/*"], record_digests=True
+    )
+    Snapshot.take(
+        inc_path, make(2.0), replicated=["model/*"], incremental_base=base_path
+    )
+
+    meta = Snapshot(inc_path).metadata
+    # EVERY rank's copy of the replicated deduped entry must carry origin —
+    # each rank restores its own copy (regression: origin was only set on
+    # the writing rank before _propagate_checksums learned about it).
+    for r in range(world_size):
+        for chunk in meta.manifest[f"{r}/model/frozen"].chunks:
+            assert chunk.array.origin == base_path, (r, chunk.array)
+
+    dst = make(0.0)
+    dst["model"]["frozen"][:] = 0
+    Snapshot(inc_path).restore(dst)
+    np.testing.assert_array_equal(
+        dst["model"]["frozen"], np.arange(2048, dtype=np.float32).reshape(64, 32)
+    )
+    np.testing.assert_array_equal(dst["model"]["head"], np.full((16,), 2.0, np.float32))
+    np.testing.assert_array_equal(dst["local"]["rank_data"], np.full((4,), rank, np.int32))
+    return "ok"
+
+
+def test_multiprocess_replicated_incremental(tmp_path):
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _multiproc_incremental_worker,
+        2,
+        str(tmp_path / "base"),
+        str(tmp_path / "inc"),
+    )
+    assert all(v == "ok" for v in results.values())
+    # the deduplicated replicated payload must not exist in the incremental
+    inc_files = _payload_files(tmp_path / "inc")
+    assert not any("frozen" in f for f in inc_files), inc_files
+    assert any("head" in f for f in inc_files)
+
+
+def test_non_incremental_format_unchanged(tmp_path):
+    """Snapshots taken without digest recording must not carry the new
+    fields in their YAML (on-disk format stability)."""
+    p = str(tmp_path / "plain")
+    Snapshot.take(p, {"app": _state()})
+    raw = open(os.path.join(p, ".snapshot_metadata")).read()
+    assert "digest" not in raw and "origin" not in raw

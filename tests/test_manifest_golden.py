@@ -141,6 +141,8 @@ def test_asdict_field_order_is_stable(metadata) -> None:
         "replicated",
         "byte_range",
         "checksum",
+        "digest",
+        "origin",
     ]
     d = asdict(metadata.manifest["0/extra/blob"])
     assert list(d.keys()) == [
@@ -151,7 +153,12 @@ def test_asdict_field_order_is_stable(metadata) -> None:
         "replicated",
         "checksum",
         "size",
+        "digest",
+        "origin",
     ]
+    # The incremental-snapshot fields are serialization-suppressed while
+    # None (SnapshotMetadata.to_yaml), so the YAML golden files above—and
+    # every non-incremental snapshot's on-disk format—are unchanged.
 
 
 def test_legacy_manifest_without_new_fields_parses() -> None:

@@ -178,16 +178,19 @@ class BatchedBufferConsumer(BufferConsumer):
 
 
 def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
-    """Merge byte-range reads of the same file into spanning reads."""
-    by_path: Dict[str, List[ReadReq]] = {}
+    """Merge byte-range reads of the same file into spanning reads.
+
+    Grouping includes the payload origin (incremental snapshots): reads of
+    a same-named location in different snapshots must never merge."""
+    by_path: Dict[tuple, List[ReadReq]] = {}
     out: List[ReadReq] = []
     for req in read_reqs:
         if req.byte_range is None:
             out.append(req)
         else:
-            by_path.setdefault(req.path, []).append(req)
+            by_path.setdefault((req.path, req.origin), []).append(req)
 
-    for path, reqs in by_path.items():
+    for (path, origin), reqs in by_path.items():
         if len(reqs) == 1:
             out.extend(reqs)
             continue
@@ -211,6 +214,7 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
                         [(r.byte_range[0] - lo, r.byte_range[1] - lo) for r in group],
                     ),
                     byte_range=(lo, hi),
+                    origin=origin,
                 )
             )
 

@@ -53,22 +53,29 @@ def _array_nbytes(entry: ArrayEntry) -> Optional[int]:
 
 def _entry_payloads(
     entry: Entry,
-) -> List[Tuple[str, Optional[List[int]], Optional[str], Optional[int]]]:
-    """(location, byte_range, checksum, nbytes) per payload the entry owns."""
+) -> List[Tuple[str, Optional[List[int]], Optional[str], Optional[int], Optional[str]]]:
+    """(location, byte_range, checksum, nbytes, origin) per payload the
+    entry owns. ``origin`` is the base snapshot holding the bytes when the
+    entry was deduplicated by an incremental take."""
     if isinstance(entry, ArrayEntry):
-        return [(entry.location, entry.byte_range, entry.checksum, _array_nbytes(entry))]
+        return [
+            (entry.location, entry.byte_range, entry.checksum,
+             _array_nbytes(entry), entry.origin)
+        ]
     if isinstance(entry, ChunkedArrayEntry):
         return [
-            (c.array.location, c.array.byte_range, c.array.checksum, _array_nbytes(c.array))
+            (c.array.location, c.array.byte_range, c.array.checksum,
+             _array_nbytes(c.array), c.array.origin)
             for c in entry.chunks
         ]
     if isinstance(entry, ShardedArrayEntry):
         return [
-            (s.array.location, s.array.byte_range, s.array.checksum, _array_nbytes(s.array))
+            (s.array.location, s.array.byte_range, s.array.checksum,
+             _array_nbytes(s.array), s.array.origin)
             for s in entry.shards
         ]
     if isinstance(entry, ObjectEntry):
-        return [(entry.location, None, entry.checksum, entry.size)]
+        return [(entry.location, None, entry.checksum, entry.size, entry.origin)]
     return []
 
 
@@ -127,15 +134,17 @@ def cmd_info(args: argparse.Namespace) -> int:
     # Replicated entries repeat under every rank prefix but share storage;
     # dedup payloads by (location, byte_range) so sizes reflect bytes on
     # disk, not bytes times world_size (same rule cmd_verify applies).
-    payloads: Dict[Tuple[str, Optional[Tuple[int, int]]], Tuple[Optional[str], Optional[int]]] = {}
+    payloads: Dict[Tuple[str, Optional[Tuple[int, int]]], Tuple[Optional[str], Optional[int], Optional[str]]] = {}
     for entry in meta.manifest.values():
         counts[entry.type] = counts.get(entry.type, 0) + 1
-        for location, byte_range, checksum, nbytes in _entry_payloads(entry):
+        for location, byte_range, checksum, nbytes, origin in _entry_payloads(entry):
             key = (location, tuple(byte_range) if byte_range else None)
-            payloads.setdefault(key, (checksum, nbytes))
-    total = sum(n for _, n in payloads.values() if n is not None)
-    unsized = sum(1 for _, n in payloads.values() if n is None)
-    checksummed = sum(1 for c, _ in payloads.values() if c is not None)
+            payloads.setdefault(key, (checksum, nbytes, origin))
+    local = {k: v for k, v in payloads.items() if v[2] is None}
+    external = {k: v for k, v in payloads.items() if v[2] is not None}
+    total = sum(n for _, n, _ in local.values() if n is not None)
+    unsized = sum(1 for _, n, _ in local.values() if n is None)
+    checksummed = sum(1 for c, _, _ in payloads.values() if c is not None)
     print(f"path:        {args.path}")
     print(f"version:     {meta.version}")
     print(f"world_size:  {meta.world_size}")
@@ -144,6 +153,12 @@ def cmd_info(args: argparse.Namespace) -> int:
         print(f"  {typ}: {counts[typ]}")
     print(f"payload:     {_fmt_bytes(total)}"
           + (f" (+{unsized} payloads of unknown size)" if unsized else ""))
+    if external:
+        ext_total = sum(n for _, n, _ in external.values() if n is not None)
+        origins = sorted({o for _, _, o in external.values()})
+        print(f"external:    {len(external)} payloads ({_fmt_bytes(ext_total)}) "
+              f"referenced from base snapshot(s): {', '.join(origins)}")
+        print("             (bases must remain intact for restore)")
     print(f"checksums:   {checksummed}/{len(payloads)} payloads")
     return 0
 
@@ -184,40 +199,49 @@ def cmd_verify(args: argparse.Namespace) -> int:
 
     meta = _load_metadata(args.path)
     # Replicated entries appear under every rank prefix and chunked stripes
-    # can share a location: verify each distinct payload once.
-    seen: Dict[Tuple[str, Optional[Tuple[int, int]]], Optional[str]] = {}
+    # can share a location: verify each distinct payload once. Payloads an
+    # incremental take left in a base snapshot are verified there (grouped
+    # by origin so each base's plugin opens once).
+    seen: Dict[Tuple[Optional[str], str, Optional[Tuple[int, int]]], Optional[str]] = {}
     for entry in meta.manifest.values():
-        for location, byte_range, checksum, _ in _entry_payloads(entry):
-            key = (location, tuple(byte_range) if byte_range else None)
+        for location, byte_range, checksum, _, origin in _entry_payloads(entry):
+            key = (origin, location, tuple(byte_range) if byte_range else None)
             seen.setdefault(key, checksum)
+    by_origin: Dict[Optional[str], List[Tuple[str, Optional[Tuple[int, int]], Optional[str]]]] = {}
+    for (origin, location, byte_range), checksum in sorted(
+        seen.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])
+    ):
+        by_origin.setdefault(origin, []).append((location, byte_range, checksum))
 
     event_loop = asyncio.new_event_loop()
-    storage = url_to_storage_plugin_in_event_loop(args.path, event_loop)
     ok = skipped = failed = 0
     try:
-        for (location, byte_range), checksum in sorted(seen.items()):
-            if checksum is None:
-                skipped += 1
-                if args.verbose:
-                    print(f"SKIP  {location} (no checksum recorded)")
-                continue
-            read_io = ReadIO(path=location, byte_range=byte_range)
+        for origin, payloads in by_origin.items():
+            storage = url_to_storage_plugin_in_event_loop(
+                origin if origin is not None else args.path, event_loop
+            )
+            where = f" [{origin}]" if origin is not None else ""
             try:
-                event_loop.run_until_complete(storage.read(read_io))
-                verify_checksum(read_io.buf, checksum, location)
-            except IntegrityError as e:
-                failed += 1
-                print(f"FAIL  {location}: {e}")
-                continue
-            except OSError as e:
-                failed += 1
-                print(f"FAIL  {location}: {e}")
-                continue
-            ok += 1
-            if args.verbose:
-                print(f"OK    {location}")
+                for location, byte_range, checksum in payloads:
+                    if checksum is None:
+                        skipped += 1
+                        if args.verbose:
+                            print(f"SKIP  {location}{where} (no checksum recorded)")
+                        continue
+                    read_io = ReadIO(path=location, byte_range=byte_range)
+                    try:
+                        event_loop.run_until_complete(storage.read(read_io))
+                        verify_checksum(read_io.buf, checksum, location)
+                    except (IntegrityError, OSError) as e:
+                        failed += 1
+                        print(f"FAIL  {location}{where}: {e}")
+                        continue
+                    ok += 1
+                    if args.verbose:
+                        print(f"OK    {location}{where}")
+            finally:
+                storage.sync_close(event_loop)
     finally:
-        storage.sync_close(event_loop)
         event_loop.close()
     print(f"verified {ok} payloads, {skipped} without checksums, {failed} failed")
     return 1 if failed else 0

@@ -1,0 +1,161 @@
+"""Incremental snapshots: skip storage writes for unchanged payloads.
+
+A capability beyond the reference (which always rewrites every byte). When
+``Snapshot.take(..., incremental_base=...)`` is given a previous snapshot,
+each payload's content digest (SHA-256, computed at stage time — after the
+DtoH copy, on the exact bytes that would be written) is compared against
+the digest the base snapshot recorded for the payload at the same storage
+location. On a match the storage write is skipped and the manifest entry
+records ``origin`` = the snapshot that physically holds the bytes — chains
+of incrementals resolve ``origin`` transitively, so a payload written once
+is referenced directly no matter how many increments follow.
+
+Where this wins: any training run where a large fraction of state is
+frozen between snapshots — LoRA/adapter fine-tuning (frozen backbone),
+embedding tables with sparse updates, EMA copies updated infrequently.
+The DtoH + hash cost is still paid (correctness requires hashing the real
+bytes); only the storage write is elided, which is the expensive part on
+cloud storage.
+
+Matching is by storage location, which is a deterministic function of
+(logical path, replication class, chunk/shard box) and independent of
+which rank writes it for ``replicated/`` and ``sharded/`` payloads. A
+changed world size shifts ``<rank>/`` locations, so per-rank payloads
+simply miss the index and are rewritten — correct, just not deduplicated.
+Payloads the base packed into batched slabs (``batched/<uuid>``) are
+never matched for the same reason.
+
+Restore-side: entries with ``origin`` read their payload from that
+snapshot's storage (see ``Snapshot._execute_read_reqs_grouped``).
+Deleting a base snapshot therefore breaks incrementals built on it —
+``python -m torchsnapshot_tpu info`` lists origin dependencies.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional
+
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    ObjectEntry,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+)
+
+DIGEST_ALGO = "sha256"
+
+
+def compute_digest(buf) -> str:
+    h = hashlib.sha256()
+    h.update(memoryview(buf).cast("B"))
+    return f"{DIGEST_ALGO}:{h.hexdigest()}"
+
+
+@dataclass(frozen=True)
+class PayloadRef:
+    """Where a base snapshot holds a payload, and what its content was."""
+
+    digest: str
+    origin: str  # snapshot URL that physically holds the bytes
+    nbytes: Optional[int]
+
+
+def _iter_payload_entries(entry: Entry) -> Iterator[ArrayEntry]:
+    if isinstance(entry, ArrayEntry):
+        yield entry
+    elif isinstance(entry, ChunkedArrayEntry):
+        for chunk in entry.chunks:
+            yield chunk.array
+    elif isinstance(entry, ShardedArrayEntry):
+        for shard in entry.shards:
+            yield shard.array
+
+
+class DedupContext:
+    """Digest recording + (optionally) a base snapshot's payload index.
+
+    Active during a take's prepare phase via :func:`dedup_staging`; stagers
+    capture it at construction and consult it at stage time.
+    """
+
+    def __init__(self, base_path: Optional[str], refs: Dict[str, PayloadRef]):
+        self.base_path = base_path
+        self.refs = refs
+
+    @classmethod
+    def recording_only(cls) -> "DedupContext":
+        return cls(base_path=None, refs={})
+
+    @classmethod
+    def from_base(cls, base_path: str, metadata: SnapshotMetadata) -> "DedupContext":
+        """Index every digest-carrying payload of ``metadata`` by location.
+
+        ``origin`` resolves transitively: if the base itself borrowed the
+        payload from an older snapshot, new entries point straight at the
+        older snapshot, so restores never walk a chain.
+        """
+        refs: Dict[str, PayloadRef] = {}
+        from .serialization import array_size_bytes
+
+        for entry in metadata.manifest.values():
+            payloads = list(_iter_payload_entries(entry))
+            for p in payloads:
+                if p.digest is None or p.byte_range is not None:
+                    # Slab-packed payloads (byte_range) live at uuid
+                    # locations a new take can never produce; skip them.
+                    continue
+                try:
+                    nbytes = array_size_bytes(p.shape, p.dtype)
+                except ValueError:
+                    nbytes = None
+                refs.setdefault(
+                    p.location,
+                    PayloadRef(
+                        digest=p.digest,
+                        origin=p.origin or base_path,
+                        nbytes=nbytes,
+                    ),
+                )
+            if isinstance(entry, ObjectEntry) and entry.digest is not None:
+                refs.setdefault(
+                    entry.location,
+                    PayloadRef(
+                        digest=entry.digest,
+                        origin=entry.origin or base_path,
+                        nbytes=entry.size,
+                    ),
+                )
+        return cls(base_path=base_path, refs=refs)
+
+    def match(self, location: str, digest: str, nbytes: int) -> Optional[PayloadRef]:
+        ref = self.refs.get(location)
+        if ref is None or ref.digest != digest:
+            return None
+        if ref.nbytes is not None and ref.nbytes != nbytes:
+            return None  # digest collision paranoia: sizes must agree
+        return ref
+
+
+_dedup_context: contextvars.ContextVar[Optional[DedupContext]] = contextvars.ContextVar(
+    "tsnap_dedup_context", default=None
+)
+
+
+def active_dedup_context() -> Optional[DedupContext]:
+    return _dedup_context.get()
+
+
+@contextlib.contextmanager
+def dedup_staging(ctx: Optional[DedupContext]):
+    """Prepared stagers capture ``ctx`` for digest recording/dedup."""
+    token = _dedup_context.set(ctx)
+    try:
+        yield
+    finally:
+        _dedup_context.reset(token)

@@ -96,6 +96,12 @@ class ArrayBufferStager(BufferStager):
         # mutation is visible in the persisted metadata).
         self.entry = entry
         self.copy_for_consistency = _copy_for_consistency.get()
+        from ..dedup import active_dedup_context
+
+        self.dedup = active_dedup_context()
+        # Set at stage time when the payload matched the dedup base: the
+        # scheduler then releases the buffer without writing it.
+        self.io_skipped = False
 
     def _stage_sync(self, arr) -> np.ndarray:
         if _is_jax_array(arr):
@@ -126,6 +132,17 @@ class ArrayBufferStager(BufferStager):
 
             if checksums_enabled():
                 self.entry.checksum = compute_checksum(buf)
+            if self.dedup is not None:
+                from ..dedup import compute_digest
+
+                digest = compute_digest(buf)
+                self.entry.digest = digest
+                ref = self.dedup.match(self.entry.location, digest, buf.nbytes)
+                if ref is not None:
+                    # Unchanged since the base snapshot: record where the
+                    # bytes already live and skip the storage write.
+                    self.entry.origin = ref.origin
+                    self.io_skipped = True
         return buf
 
     async def stage_buffer(self, executor=None) -> BufferType:
@@ -217,6 +234,7 @@ class ArrayIOPreparer:
                     path=entry.location,
                     buffer_consumer=consumer,
                     byte_range=byte_range,
+                    origin=entry.origin,
                 )
             ]
         return _prepare_chunked_read(entry, dst_view, callback, buffer_size_limit_bytes)
@@ -344,6 +362,7 @@ def _prepare_chunked_read(
                 path=entry.location,
                 buffer_consumer=_SlicedArrayConsumer(entry, assembler, elem_lo, elem_hi),
                 byte_range=(base + elem_lo * itemsize, base + elem_hi * itemsize),
+                origin=entry.origin,
             )
         )
     return read_reqs

@@ -64,6 +64,10 @@ class ObjectBufferStager(BufferStager):
         self.entry = entry  # checksum + size recorded at stage time when given
         self._size_estimate: Optional[int] = None
         self._probed_bytes: Optional[bytes] = None
+        from ..dedup import active_dedup_context
+
+        self.dedup = active_dedup_context()
+        self.io_skipped = False
 
     def _stage_and_sum(self) -> BufferType:
         if self._probed_bytes is not None:
@@ -77,6 +81,15 @@ class ObjectBufferStager(BufferStager):
 
             if checksums_enabled():
                 self.entry.checksum = compute_checksum(buf)
+            if self.dedup is not None:
+                from ..dedup import compute_digest
+
+                digest = compute_digest(buf)
+                self.entry.digest = digest
+                ref = self.dedup.match(self.entry.location, digest, len(buf))
+                if ref is not None:
+                    self.entry.origin = ref.origin
+                    self.io_skipped = True
         return buf
 
     async def stage_buffer(self, executor=None) -> BufferType:
@@ -150,4 +163,8 @@ class ObjectIOPreparer:
     @staticmethod
     def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], ObjectBufferConsumer]:
         consumer = ObjectBufferConsumer(entry)
-        return [ReadReq(path=entry.location, buffer_consumer=consumer)], consumer
+        return [
+            ReadReq(
+                path=entry.location, buffer_consumer=consumer, origin=entry.origin
+            )
+        ], consumer

@@ -341,6 +341,7 @@ class ShardedArrayIOPreparer:
                     path=shard.array.location,
                     buffer_consumer=consumer,
                     byte_range=byte_range,
+                    origin=shard.array.origin,
                 )
             )
         return read_reqs

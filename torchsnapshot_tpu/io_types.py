@@ -39,6 +39,9 @@ class ReadIO:
 
 
 class BufferStager(abc.ABC):
+    # Stagers may set ``io_skipped = True`` during stage_buffer to tell the
+    # scheduler the staged payload must NOT be written (incremental
+    # snapshots: the bytes already exist in a base snapshot — dedup.py).
     """Produces the bytes to be written for one write request.
 
     ``stage_buffer`` runs inside the scheduler's staging pipeline under the
@@ -79,6 +82,10 @@ class ReadReq:
     path: str
     buffer_consumer: BufferConsumer
     byte_range: Optional[Tuple[int, int]] = None
+    # Incremental snapshots: when set, the payload lives in this base
+    # snapshot's storage, not the snapshot being restored; the orchestrator
+    # groups reads by origin and opens one plugin per origin (dedup.py).
+    origin: Optional[str] = None
 
 
 class StoragePlugin(abc.ABC):

@@ -52,6 +52,12 @@ class ArrayEntry(Entry):
     replicated: bool
     byte_range: Optional[List[int]] = None  # [lo, hi) within location
     checksum: Optional[str] = None  # "<algo>:<hexdigest>" of the payload
+    # Incremental snapshots (dedup.py): content digest recorded at stage
+    # time, and — for payloads reused from a base snapshot — the URL of the
+    # snapshot that physically holds the bytes. Omitted from the YAML when
+    # unset so non-incremental snapshots keep their on-disk format.
+    digest: Optional[str] = None  # "sha256:<hexdigest>" of the payload
+    origin: Optional[str] = None  # base snapshot URL holding the payload
 
     def __init__(
         self,
@@ -62,6 +68,8 @@ class ArrayEntry(Entry):
         replicated: bool,
         byte_range: Optional[List[int]] = None,
         checksum: Optional[str] = None,
+        digest: Optional[str] = None,
+        origin: Optional[str] = None,
     ) -> None:
         super().__init__(type="array")
         self.location = location
@@ -71,6 +79,8 @@ class ArrayEntry(Entry):
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
         self.checksum = checksum
+        self.digest = digest
+        self.origin = origin
 
 
 @dataclass
@@ -118,6 +128,8 @@ class ObjectEntry(Entry):
     replicated: bool
     checksum: Optional[str] = None  # "<algo>:<hexdigest>" of the payload
     size: Optional[int] = None  # serialized bytes, recorded at stage time
+    digest: Optional[str] = None  # "sha256:<hexdigest>" (see ArrayEntry)
+    origin: Optional[str] = None  # base snapshot URL holding the payload
 
     def __init__(
         self,
@@ -127,6 +139,8 @@ class ObjectEntry(Entry):
         replicated: bool,
         checksum: Optional[str] = None,
         size: Optional[int] = None,
+        digest: Optional[str] = None,
+        origin: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
@@ -135,6 +149,8 @@ class ObjectEntry(Entry):
         self.replicated = replicated
         self.checksum = checksum
         self.size = size
+        self.digest = digest
+        self.origin = origin
 
 
 _PRIMITIVE_TYPES = ("int", "float", "str", "bool", "bytes", "NoneType")
@@ -314,7 +330,23 @@ class SnapshotMetadata:
     manifest: Manifest
 
     def to_yaml(self) -> str:
-        return yaml.dump(asdict(self), sort_keys=False, Dumper=_Dumper)
+        d = asdict(self)
+        # Incremental-snapshot fields are omitted while unset so that
+        # non-incremental snapshots keep their exact on-disk format (pinned
+        # by tests/test_manifest_golden.py); absent keys read back as None.
+        def strip(node: Any) -> None:
+            if isinstance(node, dict):
+                for k in ("digest", "origin"):
+                    if node.get(k, "sentinel") is None:
+                        del node[k]
+                for v in node.values():
+                    strip(v)
+            elif isinstance(node, list):
+                for v in node:
+                    strip(v)
+
+        strip(d["manifest"])
+        return yaml.dump(d, sort_keys=False, Dumper=_Dumper)
 
     @classmethod
     def from_yaml(cls, yaml_str: str) -> "SnapshotMetadata":

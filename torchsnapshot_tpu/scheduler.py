@@ -83,10 +83,17 @@ class _WritePipeline:
         )
         self.buf = None
         self.buf_size_bytes: Optional[int] = None
+        self.io_skipped = False
 
     async def stage_buffer(self, executor) -> "_WritePipeline":
         self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
         self.buf_size_bytes = memoryview(self.buf).nbytes
+        # Incremental snapshots: the stager found the payload unchanged in a
+        # base snapshot — drop the buffer instead of writing it.
+        if getattr(self.write_req.buffer_stager, "io_skipped", False):
+            self.io_skipped = True
+            self.buf = None
+            self.buf_size_bytes = 0
         return self
 
     async def write_buffer(self, storage: StoragePlugin) -> "_WritePipeline":
@@ -378,7 +385,8 @@ async def execute_write_reqs(
                     budget.release(
                         pipeline.staging_cost_bytes - pipeline.buf_size_bytes
                     )
-                    ready_for_io.append(pipeline)
+                    if not pipeline.io_skipped:
+                        ready_for_io.append(pipeline)
                     reporter.inflight_staging -= 1
                     reporter.staged_count += 1
                     reporter.staged_bytes += pipeline.buf_size_bytes

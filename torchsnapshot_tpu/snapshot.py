@@ -143,7 +143,19 @@ class Snapshot:
         pg: Optional[ProcessGroup] = None,
         replicated: Optional[List[str]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        incremental_base: Optional[str] = None,
+        record_digests: bool = False,
     ) -> "Snapshot":
+        """Persist ``app_state`` at ``path``.
+
+        ``incremental_base`` names a previous snapshot: payloads whose
+        content is unchanged since it are not rewritten — their entries
+        reference the base's bytes instead (see dedup.py; the base must
+        have been taken with ``record_digests=True`` or be incremental
+        itself). ``record_digests`` records content digests so a FUTURE
+        take can use this snapshot as its base; implied by
+        ``incremental_base``.
+        """
         cls._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         pg_wrapper = PGWrapper(pg)
@@ -165,6 +177,9 @@ class Snapshot:
                     storage=storage,
                     event_loop=event_loop,
                     timer=timer,
+                    incremental_base=incremental_base,
+                    record_digests=record_digests,
+                    storage_options=storage_options,
                 )
             pending_io_work.sync_complete(event_loop)
             timer.mark("io_drain")
@@ -195,12 +210,15 @@ class Snapshot:
         pg: Optional[ProcessGroup] = None,
         replicated: Optional[List[str]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        incremental_base: Optional[str] = None,
+        record_digests: bool = False,
     ) -> "PendingSnapshot":
         """Non-blocking take. Returns once *staging* (DtoH copy + serialize)
         completes — after that, mutations to the app state do not affect the
         snapshot. Storage I/O and the metadata commit continue on a
         background thread; call ``.wait()`` on the returned handle
-        (reference: snapshot.py:245-313)."""
+        (reference: snapshot.py:245-313). ``incremental_base`` /
+        ``record_digests`` as in :meth:`take`."""
         cls._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         pg_wrapper = PGWrapper(pg)
@@ -217,6 +235,9 @@ class Snapshot:
             storage=storage,
             event_loop=event_loop,
             timer=timer,
+            incremental_base=incremental_base,
+            record_digests=record_digests,
+            storage_options=storage_options,
         )
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -240,11 +261,41 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         timer: Optional[_PhaseTimer] = None,
+        incremental_base: Optional[str] = None,
+        record_digests: bool = False,
+        storage_options: Optional[Dict[str, Any]] = None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         timer = timer or _PhaseTimer("Snapshot.take")  # unlogged unless the caller logs
         rank = pg_wrapper.get_rank()
         world_size = pg_wrapper.get_world_size()
         app_state = dict(app_state)
+
+        from .dedup import DedupContext, dedup_staging
+
+        dedup_ctx: Optional[DedupContext] = None
+        if (incremental_base is not None or record_digests) and batching_enabled():
+            # Slab packing rewrites small-write locations to batched/<uuid>
+            # before staging, which can never match a base's ref index, and
+            # byte-ranged slab sub-entries are excluded from future indexes
+            # — batched payloads silently opt out of dedup. Say so.
+            logger.warning(
+                "Write batching (%s) is enabled: batched (small) payloads "
+                "will not be deduplicated against the incremental base and "
+                "their digests will not serve future incremental takes. "
+                "Disable batching for snapshots used in incremental chains.",
+                "TORCHSNAPSHOT_TPU_ENABLE_BATCHING",
+            )
+        if incremental_base is not None:
+            base_meta = cls(incremental_base, storage_options=storage_options).metadata
+            dedup_ctx = DedupContext.from_base(incremental_base, base_meta)
+            if not dedup_ctx.refs:
+                logger.warning(
+                    "incremental_base %s has no content digests (take it with "
+                    "record_digests=True); every payload will be rewritten.",
+                    incremental_base,
+                )
+        elif record_digests:
+            dedup_ctx = DedupContext.recording_only()
 
         # RNG invariant (reference: snapshot.py:329-373): RNG state is
         # captured at entry and re-applied after take, so the snapshot
@@ -298,46 +349,50 @@ class Snapshot:
                 flattened, replicated_paths, rank, world_size
             )
 
-            for logical_path in sorted(flattened.keys()):
-                obj = flattened[logical_path]
-                is_repl = logical_path in replicated_paths
-                if is_partitionable_array(obj):
-                    prefix = get_storage_path(
-                        logical_path, rank, replicated=is_repl
-                    )
-                    entry, reqs = _prepare_chunked_array_write(
-                        prefix,
-                        obj,
-                        local_chunks=chunk_assignments[logical_path],
-                        replicated=is_repl,
-                    )
-                    manifest[logical_path] = entry
-                    write_reqs.extend(reqs)
-                elif is_sharded_jax_array(obj):
-                    from .io_preparers.sharded import ShardedArrayIOPreparer
-
-                    storage_prefix = get_storage_path(
-                        logical_path, rank, sharded=True
-                    )
-                    entry, reqs = ShardedArrayIOPreparer.prepare_write(
-                        storage_prefix, obj
-                    )
-                    manifest[logical_path] = entry
-                    write_reqs.extend(reqs)
-                elif PrimitivePreparer.should_inline(obj):
-                    manifest[logical_path] = PrimitivePreparer.prepare_write(
-                        obj, replicated=is_repl
-                    )
-                else:
-                    storage_path = get_storage_path(
-                        logical_path, rank, replicated=is_repl
-                    )
-                    entry, reqs = ObjectIOPreparer.prepare_write(
-                        storage_path, obj, replicated=is_repl
-                    )
-                    manifest[logical_path] = entry
-                    if not is_repl or logical_path in owned_objects:
+            # Stagers capture the dedup context at construction (prepare
+            # time) and consult it at stage time — digest recording and
+            # unchanged-payload write elision for incremental snapshots.
+            with dedup_staging(dedup_ctx):
+                for logical_path in sorted(flattened.keys()):
+                    obj = flattened[logical_path]
+                    is_repl = logical_path in replicated_paths
+                    if is_partitionable_array(obj):
+                        prefix = get_storage_path(
+                            logical_path, rank, replicated=is_repl
+                        )
+                        entry, reqs = _prepare_chunked_array_write(
+                            prefix,
+                            obj,
+                            local_chunks=chunk_assignments[logical_path],
+                            replicated=is_repl,
+                        )
+                        manifest[logical_path] = entry
                         write_reqs.extend(reqs)
+                    elif is_sharded_jax_array(obj):
+                        from .io_preparers.sharded import ShardedArrayIOPreparer
+
+                        storage_prefix = get_storage_path(
+                            logical_path, rank, sharded=True
+                        )
+                        entry, reqs = ShardedArrayIOPreparer.prepare_write(
+                            storage_prefix, obj
+                        )
+                        manifest[logical_path] = entry
+                        write_reqs.extend(reqs)
+                    elif PrimitivePreparer.should_inline(obj):
+                        manifest[logical_path] = PrimitivePreparer.prepare_write(
+                            obj, replicated=is_repl
+                        )
+                    else:
+                        storage_path = get_storage_path(
+                            logical_path, rank, replicated=is_repl
+                        )
+                        entry, reqs = ObjectIOPreparer.prepare_write(
+                            storage_path, obj, replicated=is_repl
+                        )
+                        manifest[logical_path] = entry
+                        if not is_repl or logical_path in owned_objects:
+                            write_reqs.extend(reqs)
 
             if batching_enabled():
                 # Pack small per-rank/sharded writes into slabs; rewrites the
@@ -513,10 +568,7 @@ class Snapshot:
 
             read_reqs.extend(prepare_read(entry, obj_out=obj, callback=_cb))
 
-        # Merge adjacent ranged reads (slab restores, chunked reads) into
-        # spanning reads — always on; it only coalesces, never reorders data.
-        read_reqs = batch_read_requests(read_reqs)
-        sync_execute_read_reqs(
+        self._execute_read_reqs_grouped(
             read_reqs, storage, memory_budget, rank, event_loop
         )
 
@@ -527,6 +579,52 @@ class Snapshot:
         }
         inflated = inflate(container_manifest, flattened, prefix=key)
         stateful.load_state_dict(inflated)
+
+    def _execute_read_reqs_grouped(
+        self,
+        read_reqs: List[ReadReq],
+        storage: StoragePlugin,
+        memory_budget: int,
+        rank: int,
+        event_loop: asyncio.AbstractEventLoop,
+        batch: bool = True,
+    ) -> None:
+        """Execute reads, grouped by payload origin.
+
+        Incremental snapshots reference unchanged payloads in their base
+        snapshot(s); those reads go through a plugin opened on the origin
+        URL. Batching (read coalescing) runs per group — merging ranges
+        across different origins would read from the wrong storage.
+        """
+        groups: Dict[Optional[str], List[ReadReq]] = {}
+        for rr in read_reqs:
+            groups.setdefault(rr.origin, []).append(rr)
+        for origin, reqs in groups.items():
+            # Merge adjacent ranged reads (slab restores, chunked reads)
+            # into spanning reads — it only coalesces, never reorders data.
+            if batch:
+                reqs = batch_read_requests(reqs)
+            if origin is None:
+                sync_execute_read_reqs(
+                    reqs, storage, memory_budget, rank, event_loop
+                )
+                continue
+            origin_storage = url_to_storage_plugin_in_event_loop(
+                origin, event_loop, self._storage_options
+            )
+            try:
+                sync_execute_read_reqs(
+                    reqs, origin_storage, memory_budget, rank, event_loop
+                )
+            except FileNotFoundError as e:
+                raise RuntimeError(
+                    f"Restoring from incremental snapshot {self.path!r}: a "
+                    f"payload referenced in base snapshot {origin!r} is "
+                    f"missing ({e}). Incremental snapshots require their "
+                    "base snapshots to remain intact."
+                ) from e
+            finally:
+                origin_storage.sync_close(event_loop)
 
     # ----------------------------------------------------------- read_object
 
@@ -576,8 +674,9 @@ class Snapshot:
                 buffer_size_limit_bytes=memory_budget_bytes,
             )
             budget = memory_budget_bytes or get_process_memory_budget_bytes(None)
-            sync_execute_read_reqs(
-                read_reqs, storage, budget, pg_wrapper.get_rank(), event_loop
+            self._execute_read_reqs_grouped(
+                read_reqs, storage, budget, pg_wrapper.get_rank(), event_loop,
+                batch=False,
             )
             return box[0]
         finally:
@@ -709,10 +808,14 @@ class Snapshot:
 
 
 def _propagate_checksums(global_manifest: Manifest) -> None:
-    """Replicated entries are recorded by every rank but staged (and thus
-    checksummed) only by the rank that writes each chunk; copy checksums to
-    the other ranks' copies of the same storage location so every reader
-    can verify."""
+    """Replicated entries are recorded by every rank but staged only by the
+    rank that writes each chunk; copy the stage-time metadata — checksum,
+    content digest, and dedup origin — to the other ranks' copies of the
+    same storage location. Origin propagation is load-bearing: when an
+    incremental take deduplicates a replicated chunk, only the writing
+    rank learns the payload lives in the base snapshot, and every other
+    rank restores its OWN copy of the entry (manifest.get_available_entries),
+    which must therefore also point at the base."""
     from .manifest import ArrayEntry, ChunkedArrayEntry, ObjectEntry, ShardedArrayEntry
 
     def sub_entries(entry):
@@ -723,16 +826,21 @@ def _propagate_checksums(global_manifest: Manifest) -> None:
             for part in parts:
                 yield part.array
 
-    known: Dict[str, str] = {}
-    blank = []
+    known: Dict[Tuple[str, str], str] = {}
+    blanks: Dict[str, List[Any]] = {"checksum": [], "digest": [], "origin": []}
     for entry in global_manifest.values():
         for sub in sub_entries(entry):
-            if sub.checksum is not None:
-                known[sub.location] = sub.checksum
-            else:
-                blank.append(sub)
-    for sub in blank:
-        sub.checksum = known.get(sub.location)
+            for field in ("checksum", "digest", "origin"):
+                value = getattr(sub, field)
+                if value is not None:
+                    known.setdefault((field, sub.location), value)
+                else:
+                    blanks[field].append(sub)
+    for field, subs in blanks.items():
+        for sub in subs:
+            value = known.get((field, sub.location))
+            if value is not None:
+                setattr(sub, field, value)
 
 
 def _is_process_replicated_jax_array(obj: Any) -> bool:
