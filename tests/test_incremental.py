@@ -270,6 +270,48 @@ def test_multiprocess_replicated_incremental(tmp_path):
     assert any("head" in f for f in inc_files)
 
 
+def test_consolidate_detaches_from_bases(tmp_path, capsys):
+    import shutil
+
+    from torchsnapshot_tpu.cli import main
+    from torchsnapshot_tpu.manifest import ChunkedArrayEntry as _CAE
+
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    flat = str(tmp_path / "flat")
+    Snapshot.take(a, {"app": _state()}, record_digests=True)
+    Snapshot.take(b, {"app": _state(trainable_val=5.0)}, incremental_base=a)
+
+    assert main(["consolidate", b, flat]) == 0
+    assert "payloads copied" in capsys.readouterr().out
+
+    # self-contained: verify passes, info shows no external deps
+    assert main(["verify", flat]) == 0
+    capsys.readouterr()
+    assert main(["info", flat]) == 0
+    assert "external:" not in capsys.readouterr().out
+
+    # bases gone -> consolidated snapshot still restores; digests survive
+    shutil.rmtree(a)
+    shutil.rmtree(b)
+    dst = _state(0.0, 0.0, None)
+    Snapshot(flat).restore({"app": dst})
+    np.testing.assert_array_equal(dst["frozen"], np.full((64, 8), 1.0, np.float32))
+    np.testing.assert_array_equal(dst["trainable"], np.full((16, 4), 5.0, np.float32))
+
+    meta = Snapshot(flat).metadata
+    entry = meta.manifest["0/app/frozen"]
+    assert isinstance(entry, _CAE)
+    for chunk in entry.chunks:
+        assert chunk.array.origin is None
+        assert chunk.array.digest is not None  # still usable as a base
+
+    # ...and it can indeed serve as a new incremental base
+    nxt = str(tmp_path / "next")
+    Snapshot.take(nxt, {"app": _state(trainable_val=6.0)}, incremental_base=flat)
+    assert not any("frozen" in f for f in _payload_files(nxt))
+
+
 def test_non_incremental_format_unchanged(tmp_path):
     """Snapshots taken without digest recording must not carry the new
     fields in their YAML (on-disk format stability)."""

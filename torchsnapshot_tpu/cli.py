@@ -13,11 +13,13 @@ on-call asks, so they get first-class commands here:
   (end-to-end CRC32C integrity, see integrity.py).
 - ``migrate``  — convert a reference-format (pytorch/torchsnapshot)
   snapshot to native format (tricks/torchsnapshot_interop.py).
+- ``consolidate`` — materialize an incremental snapshot as a
+  self-contained one so its base snapshots can be deleted (dedup.py).
 
-The inspection commands (``info``/``ls``/``cat``/``verify``) work over any
-registered storage backend (fs://, s3://, gs://) because they reuse the
-plugin layer; plain paths mean fs. ``migrate`` reads the reference format
-from the local filesystem only.
+The inspection commands (``info``/``ls``/``cat``/``verify``) and
+``consolidate`` work over any registered storage backend (fs://, s3://,
+gs://) because they reuse the plugin layer; plain paths mean fs.
+``migrate`` reads the reference format from the local filesystem only.
 """
 
 from __future__ import annotations
@@ -285,6 +287,15 @@ def _looks_native(raw_manifest: Dict[str, Any]) -> bool:
     return True
 
 
+def cmd_consolidate(args: argparse.Namespace) -> int:
+    from .dedup import consolidate
+
+    n = consolidate(args.src, args.dst)
+    print(f"consolidated {args.src} -> {args.dst} ({n} payloads copied; "
+          "no base snapshots required)")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m torchsnapshot_tpu",
@@ -320,6 +331,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("dst")
     p.add_argument("--rank", type=int, default=0)
     p.set_defaults(fn=cmd_migrate)
+
+    p = sub.add_parser(
+        "consolidate",
+        help="materialize an incremental snapshot as a self-contained one",
+    )
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.set_defaults(fn=cmd_consolidate)
     return parser
 
 

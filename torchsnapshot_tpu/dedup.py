@@ -159,3 +159,85 @@ def dedup_staging(ctx: Optional[DedupContext]):
         yield
     finally:
         _dedup_context.reset(token)
+
+
+def consolidate(
+    src_path: str,
+    dst_path: str,
+    storage_options=None,
+    io_concurrency: int = 4,
+) -> int:
+    """Materialize an incremental snapshot as a self-contained one.
+
+    Copies every payload — local ones from ``src_path``, deduplicated ones
+    from their origin snapshots — into ``dst_path``, clears ``origin`` on
+    all entries (digests are kept: the consolidated snapshot can serve as
+    a future incremental base), and commits the metadata last, same as a
+    take. After consolidation the original bases can be deleted.
+
+    Peak memory is ~``io_concurrency`` × the largest payload. Array chunks
+    are ≤512 MB and batched slabs ~128 MB by construction, so the default
+    stays around 2 GB; lower ``io_concurrency`` for snapshots holding
+    giant pickled objects (the one payload type with no size bound).
+
+    Returns the number of payload files copied.
+    """
+    import asyncio
+
+    from .io_types import ReadIO, WriteIO
+    from .snapshot import Snapshot
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    metadata = Snapshot(src_path, storage_options=storage_options).metadata
+
+    # One copy per distinct location; byte-ranged payloads (batched slabs)
+    # share their slab file, which is copied whole so ranges stay valid.
+    locations: Dict[str, Optional[str]] = {}
+    for entry in metadata.manifest.values():
+        payloads = list(_iter_payload_entries(entry))
+        if isinstance(entry, ObjectEntry):
+            payloads.append(entry)
+        for p in payloads:
+            locations.setdefault(p.location, p.origin)
+            if p.origin is None:
+                locations[p.location] = None  # prefer the local copy
+
+    event_loop = asyncio.new_event_loop()
+    # Plugin construction drives the event loop itself, so resolve every
+    # source up front — inside copy_all the loop is already running.
+    plugins = {
+        None: url_to_storage_plugin_in_event_loop(
+            dst_path, event_loop, storage_options
+        )
+    }
+    for origin in {org or src_path for org in locations.values()}:
+        plugins[origin] = url_to_storage_plugin_in_event_loop(
+            origin, event_loop, storage_options
+        )
+
+    async def copy_all() -> None:
+        sem = asyncio.Semaphore(max(1, io_concurrency))
+
+        async def copy_one(location: str, origin: Optional[str]) -> None:
+            async with sem:
+                read_io = ReadIO(path=location)
+                await plugins[origin or src_path].read(read_io)
+                await plugins[None].write(WriteIO(path=location, buf=read_io.buf))
+
+        await asyncio.gather(
+            *(copy_one(loc, org) for loc, org in locations.items())
+        )
+
+    try:
+        event_loop.run_until_complete(copy_all())
+        for entry in metadata.manifest.values():
+            for p in _iter_payload_entries(entry):
+                p.origin = None
+            if isinstance(entry, ObjectEntry):
+                entry.origin = None
+        Snapshot._write_snapshot_metadata(metadata, plugins[None], event_loop)
+    finally:
+        for plugin in plugins.values():
+            plugin.sync_close(event_loop)
+        event_loop.close()
+    return len(locations)
