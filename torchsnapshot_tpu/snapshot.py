@@ -580,6 +580,92 @@ class Snapshot:
         inflated = inflate(container_manifest, flattened, prefix=key)
         stateful.load_state_dict(inflated)
 
+    def read_state_dict(
+        self,
+        key: Optional[str] = None,
+        rank: Optional[int] = None,
+        memory_budget_bytes: Optional[int] = None,
+    ) -> Any:
+        """Materialize state WITHOUT a pre-built destination.
+
+        ``restore`` fills an existing app state in place (memory-efficient,
+        sharding-aware); this is the structure-free counterpart for
+        inspection, conversion, and loading into a program that doesn't
+        have the original module tree: arrays come back as host numpy
+        (sharded entries merged dense), objects unpickled, primitives
+        inlined, containers rebuilt. ``key`` selects one app-state key
+        (e.g. ``"model"``); ``None`` returns ``{key: state}`` for every
+        key visible to ``rank`` under the elasticity rules.
+        """
+        event_loop = asyncio.new_event_loop()
+        pg_wrapper = PGWrapper(self.pg)
+        r = rank if rank is not None else pg_wrapper.get_rank()
+        storage = url_to_storage_plugin_in_event_loop(
+            self.path, event_loop, self._storage_options
+        )
+        try:
+            metadata = self._read_metadata(storage, event_loop)
+            manifest = get_manifest_for_rank(metadata, r)
+
+            def selected(p: str) -> bool:
+                return key is None or p == key or p.startswith(f"{key}/")
+
+            flattened: Dict[str, Any] = {}
+            read_reqs: List[ReadReq] = []
+            for logical_path, entry in manifest.items():
+                if not selected(logical_path) or is_container_entry(entry):
+                    continue
+                if isinstance(entry, PrimitiveEntry):
+                    flattened[logical_path] = entry.get_value()
+                    continue
+
+                def _cb(value: Any, lp: str = logical_path) -> None:
+                    flattened[lp] = value
+
+                read_reqs.extend(prepare_read(entry, callback=_cb))
+
+            containers = {
+                p: e
+                for p, e in manifest.items()
+                if is_container_entry(e) and selected(p)
+            }
+            if key is not None and not flattened and not read_reqs and not containers:
+                raise RuntimeError(
+                    f"No entries under {key!r} are visible to rank {r} in "
+                    f"this snapshot (world size {metadata.world_size})."
+                )
+            budget = memory_budget_bytes or get_process_memory_budget_bytes(None)
+            self._execute_read_reqs_grouped(
+                read_reqs, storage, budget, r, event_loop
+            )
+
+            if key is not None:
+                return inflate(containers, flattened, prefix=key)
+            # One inflate per top-level app key, not a synthetic root dict:
+            # app keys appear RAW in logical paths (flatten prefixes them
+            # unescaped), so a root DictEntry would mis-resolve any key the
+            # flattener's escaping would alter (e.g. one with a space).
+            out: Dict[str, Any] = {}
+            tops = sorted(
+                {p.split("/", 1)[0] for p in list(containers) + list(flattened)}
+            )
+            for top in tops:
+                sub_c = {
+                    p: e
+                    for p, e in containers.items()
+                    if p == top or p.startswith(f"{top}/")
+                }
+                sub_f = {
+                    p: v
+                    for p, v in flattened.items()
+                    if p == top or p.startswith(f"{top}/")
+                }
+                out[top] = inflate(sub_c, sub_f, prefix=top)
+            return out
+        finally:
+            storage.sync_close(event_loop)
+            event_loop.close()
+
     def _execute_read_reqs_grouped(
         self,
         read_reqs: List[ReadReq],

@@ -16,6 +16,8 @@ __all__ = [
     "PytreeAdapter",
     "load_torchsnapshot",
     "migrate_from_torchsnapshot",
+    "migrate_to_torchsnapshot",
+    "save_as_torchsnapshot",
 ]
 
 
@@ -25,7 +27,12 @@ def __getattr__(name: str) -> Any:
 
         return {"FlaxTrainStateAdapter": FlaxTrainStateAdapter,
                 "PytreeAdapter": PytreeAdapter}[name]
-    if name in ("load_torchsnapshot", "migrate_from_torchsnapshot"):
+    if name in (
+        "load_torchsnapshot",
+        "migrate_from_torchsnapshot",
+        "migrate_to_torchsnapshot",
+        "save_as_torchsnapshot",
+    ):
         from . import torchsnapshot_interop as _tsi
 
         return getattr(_tsi, name)

@@ -413,6 +413,213 @@ def load_torchsnapshot(
     return inflate(containers, leaves, prefix="")
 
 
+_NP_TO_TORCH_DTYPE: Dict[Any, str] = {}
+for _torch_name, _np_dtype in _TORCH_DTYPE_TO_NP.items():
+    _NP_TO_TORCH_DTYPE.setdefault(_np_dtype, _torch_name)
+# The reference has no fp8 support at all (its serialization dtype table
+# predates fp8), so fp8 exports are written via torch_save: OUR
+# load_torchsnapshot round-trips them, but the reference library rejects
+# the dtype on restore either way. Migrating fp8 state to the reference
+# requires casting it to a dtype the reference knows first.
+_REFERENCE_BUFFER_PROTOCOL_UNSUPPORTED = frozenset(
+    name for name in _NP_TO_TORCH_DTYPE.values() if "float8" in name
+)
+
+
+def _export_primitive(value: Any) -> Optional[Dict[str, Any]]:
+    if isinstance(value, bool):  # before int: bool is an int subclass
+        return {"type": "bool", "serialized_value": str(value)}
+    if isinstance(value, int):
+        return {"type": "int", "serialized_value": str(value)}
+    if isinstance(value, float):
+        return {
+            "type": "float",
+            "serialized_value": base64.b64encode(struct.pack("<d", value)).decode(),
+        }
+    if isinstance(value, str):
+        return {"type": "str", "serialized_value": value}
+    if isinstance(value, bytes):
+        return {"type": "bytes", "serialized_value": base64.b64encode(value).decode()}
+    return None
+
+
+def _escape_ref_key(key: str) -> str:
+    # The reference escapes only '%' then '/' (flatten.py:158-161).
+    return key.replace("%", "%25").replace("/", "%2F")
+
+
+def save_as_torchsnapshot(state: Dict[str, Any], path: str) -> None:
+    """Write ``state`` in the REFERENCE's on-disk format (world size 1).
+
+    The inverse of :func:`load_torchsnapshot`: the resulting directory is a
+    valid pytorch/torchsnapshot snapshot the reference library restores
+    directly — the exit ramp matching the orbax trick's two-way migration.
+
+    ``state`` maps app-state keys to nested dict/OrderedDict/list
+    structures of numpy arrays (bf16 via ml_dtypes export as
+    buffer-protocol bytes, exactly how the reference writes them; fp8 via
+    torch_save — readable by :func:`load_torchsnapshot` only, since the
+    reference predates fp8 dtypes), jax arrays (fetched to host;
+    single-process view), Python primitives, and arbitrary picklable
+    objects (``torch.save``-serialized, so the reference can load them).
+
+    Payloads stream to disk as the state is walked — peak memory is one
+    payload, not the whole checkpoint.
+    """
+    import numpy as _np
+    import yaml
+
+    manifest: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    used_locations: set = set()
+
+    def claim_location(preferred: str) -> str:
+        # Sibling entries can alias (array 'w' at '0/w_0' vs object 'w_0'
+        # at '0/w_0'); a written payload must never be overwritten.
+        location = preferred
+        n = 0
+        while location in used_locations:
+            n += 1
+            location = f"{preferred}~{n}"
+        used_locations.add(location)
+        return location
+
+    def write_payload(location: str, blob: bytes) -> None:
+        full = os.path.join(path, location)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "wb") as f:
+            f.write(blob)
+
+    def write_torch_save(location: str, value: Any) -> None:
+        import torch
+
+        full = os.path.join(path, location)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "wb") as f:
+            torch.save(value, f)
+
+    def visit(logical: str, value: Any) -> None:
+        if isinstance(value, OrderedDict):
+            manifest[logical] = {
+                "type": "OrderedDict", "keys": list(value.keys())
+            }
+            for k, v in value.items():
+                visit(f"{logical}/{_escape_ref_key(str(k))}", v)
+            return
+        if isinstance(value, dict):
+            manifest[logical] = {"type": "dict", "keys": list(value.keys())}
+            for k, v in value.items():
+                visit(f"{logical}/{_escape_ref_key(str(k))}", v)
+            return
+        if isinstance(value, list):
+            manifest[logical] = {"type": "list"}
+            for i, v in enumerate(value):
+                visit(f"{logical}/{i}", v)
+            return
+        prim = _export_primitive(value)
+        if prim is not None:
+            manifest[logical] = {**prim, "readable": None, "replicated": False}
+            return
+        if hasattr(value, "shape") and hasattr(value, "dtype") and not isinstance(
+            value, (bytes, str)
+        ):
+            arr = _np.asarray(value)  # jax arrays fetched to host here
+            torch_dtype = _NP_TO_TORCH_DTYPE.get(arr.dtype)
+            if torch_dtype is not None and torch_dtype not in (
+                _REFERENCE_BUFFER_PROTOCOL_UNSUPPORTED
+            ):
+                location = claim_location(
+                    f"{logical}_" + "_".join("0" for _ in arr.shape or [0])
+                )
+                write_payload(location, _np.ascontiguousarray(arr).tobytes())
+                tensor_entry = {
+                    "type": "Tensor",
+                    "location": location,
+                    "serializer": "buffer_protocol",
+                    "dtype": torch_dtype,
+                    "shape": list(arr.shape),
+                    "replicated": False,
+                    "byte_range": None,
+                }
+                # Mirror the reference's non-sharded layout: one
+                # ChunkedTensor entry holding a single whole-array chunk.
+                manifest[logical] = {
+                    "type": "ChunkedTensor",
+                    "dtype": torch_dtype,
+                    "shape": list(arr.shape),
+                    "chunks": [
+                        {
+                            "offsets": [0] * len(arr.shape),
+                            "sizes": list(arr.shape),
+                            "tensor": tensor_entry,
+                        }
+                    ],
+                    "replicated": False,
+                }
+                return
+            # fp8 / exotic dtypes: export as a torch_save tensor payload.
+            location = claim_location(logical)
+            write_torch_save(location, _to_torch(arr))
+            manifest[logical] = {
+                "type": "Tensor",
+                "location": location,
+                "serializer": "torch_save",
+                "dtype": torch_dtype or str(arr.dtype),
+                "shape": list(arr.shape),
+                "replicated": False,
+                "byte_range": None,
+            }
+            return
+        location = claim_location(logical)
+        write_torch_save(location, value)
+        manifest[logical] = {
+            "type": "object",
+            "location": location,
+            "serializer": "torch_save",
+            "obj_type": f"{type(value).__module__}.{type(value).__qualname__}",
+            "replicated": False,
+        }
+
+    os.makedirs(path, exist_ok=True)
+    for app_key in state:
+        visit(f"0/{_escape_ref_key(str(app_key))}", state[app_key])
+
+    # Metadata last: a partially exported directory is never mistaken for a
+    # complete snapshot (the reference's own commit-point rule).
+    meta = {"version": "0.0.3", "world_size": 1, "manifest": dict(manifest)}
+    with open(os.path.join(path, SNAPSHOT_METADATA_FILENAME), "w") as f:
+        yaml.safe_dump(meta, f, sort_keys=False)
+
+
+def _to_torch(arr: Any):
+    """numpy -> torch, bridging ml_dtypes the way _torch_to_np reverses."""
+    import numpy as _np
+
+    import torch
+
+    arr = _np.ascontiguousarray(arr)
+    name = _NP_TO_TORCH_DTYPE.get(arr.dtype)
+    if name and "float8" in name:
+        t = torch.from_numpy(arr.view(_np.uint8).copy())
+        return t.view(getattr(torch, name.split(".", 1)[1])).reshape(arr.shape)
+    if name == "torch.bfloat16":
+        t = torch.from_numpy(arr.view(_np.uint16).copy())
+        return t.view(torch.bfloat16).reshape(arr.shape)
+    return torch.from_numpy(arr.copy())
+
+
+def migrate_to_torchsnapshot(src_path: str, dst_path: str, rank: int = 0) -> None:
+    """Convert a NATIVE snapshot into the reference's on-disk format.
+
+    Reads ``src_path`` structure-free (``Snapshot.read_state_dict``) and
+    writes it with :func:`save_as_torchsnapshot`, so a user leaving for
+    (or round-tripping through) the reference keeps their checkpoints.
+    """
+    from .. import Snapshot
+
+    state = Snapshot(src_path).read_state_dict(rank=rank)
+    save_as_torchsnapshot(state, dst_path)
+
+
 def migrate_from_torchsnapshot(
     src_path: str, dst_path: str, rank: int = 0
 ) -> Tuple[Any, Dict[str, Any]]:
