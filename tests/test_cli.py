@@ -105,6 +105,78 @@ def test_error_path_returns_2(tmp_path, capsys):
     assert "error:" in capsys.readouterr().err
 
 
+def test_diff_reports_changed_added_removed(tmp_path, capsys):
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    Snapshot.take(
+        a,
+        {"app": StateDict(same=np.ones(4, np.float32),
+                          changed=np.zeros(3, np.float32),
+                          gone=7)},
+        record_digests=True,
+    )
+    Snapshot.take(
+        b,
+        {"app": StateDict(same=np.ones(4, np.float32),
+                          changed=np.full((3,), 5.0, np.float32),
+                          added="new")},
+        record_digests=True,
+    )
+    assert main(["diff", a, b]) == 1  # differences found
+    out = capsys.readouterr().out
+    assert "+ 0/app/added" in out
+    assert "- 0/app/gone" in out
+    assert "~ 0/app/changed" in out
+    assert "1 added, 1 removed, 1 changed, 1 unchanged" in out
+
+    # identical snapshots diff clean (exit 0)
+    c = str(tmp_path / "c")
+    Snapshot.take(c, {"app": StateDict(same=np.ones(4, np.float32))},
+                  record_digests=True)
+    d = str(tmp_path / "d")
+    Snapshot.take(d, {"app": StateDict(same=np.ones(4, np.float32))},
+                  record_digests=True)
+    assert main(["diff", c, d]) == 0
+    assert "0 changed, 1 unchanged" in capsys.readouterr().out
+
+
+def test_diff_without_digests_uses_checksums(tmp_path, capsys):
+    # checksums are on by default, so equality is still exact
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    Snapshot.take(a, {"app": StateDict(w=np.ones(4, np.float32))})
+    Snapshot.take(b, {"app": StateDict(w=np.ones(4, np.float32))})
+    assert main(["diff", a, b]) == 0
+    assert "1 unchanged" in capsys.readouterr().out
+
+
+def test_diff_across_evidence_tiers(tmp_path, capsys):
+    """One side has digests, the other only checksums: the comparison
+    degrades to the tier both sides share instead of calling identical
+    content changed."""
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    Snapshot.take(
+        a, {"app": StateDict(w=np.ones(4, np.float32), step=7)},
+        record_digests=True,
+    )
+    Snapshot.take(b, {"app": StateDict(w=np.ones(4, np.float32), step=7)})
+    assert main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    # primitive equality counts as unchanged, not indeterminate
+    assert "0 changed, 2 unchanged" in out and "indeterminate" not in out
+
+
+def test_diff_indeterminate_without_any_evidence(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_CHECKSUM", "0")
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    Snapshot.take(a, {"app": StateDict(w=np.ones(4, np.float32))})
+    Snapshot.take(b, {"app": StateDict(w=np.ones(4, np.float32))})
+    assert main(["diff", a, b]) == 0  # no *proven* differences
+    assert "1 indeterminate" in capsys.readouterr().out
+
+
 def test_looks_native_handles_type_name_collisions():
     from torchsnapshot_tpu.cli import _looks_native
 

@@ -15,6 +15,9 @@ on-call asks, so they get first-class commands here:
   snapshot to native format (tricks/torchsnapshot_interop.py).
 - ``consolidate`` — materialize an incremental snapshot as a
   self-contained one so its base snapshots can be deleted (dedup.py).
+- ``diff``     — compare two snapshots leaf by leaf (added/removed/
+  changed/unchanged) using recorded content digests where available,
+  falling back to checksum then shape/dtype.
 
 The inspection commands (``info``/``ls``/``cat``/``verify``) and
 ``consolidate`` work over any registered storage backend (fs://, s3://,
@@ -287,6 +290,117 @@ def _looks_native(raw_manifest: Dict[str, Any]) -> bool:
     return True
 
 
+def _sub_payload_entries(entry: Entry) -> List[Tuple[Optional[Tuple[int, ...]], Any]]:
+    """(chunk/shard box, payload-entry) pairs — the per-payload alignment
+    unit for content comparison. Plain arrays/objects have one boxless
+    payload; chunked/sharded entries align by their N-D (offsets, sizes)
+    so each sub-entry's own digest/checksum is compared (slab-batched
+    payloads share a location, so location is NOT a safe key)."""
+    if isinstance(entry, (ArrayEntry, ObjectEntry)):
+        return [(None, entry)]
+    if isinstance(entry, ChunkedArrayEntry):
+        return [
+            ((*c.offsets, *c.sizes), c.array) for c in entry.chunks
+        ]
+    if isinstance(entry, ShardedArrayEntry):
+        return [
+            ((*s.offsets, *s.sizes), s.array) for s in entry.shards
+        ]
+    return []
+
+
+def _leaf_compare(ea: Entry, eb: Entry) -> str:
+    """'same' | 'changed' | 'unknown' for two leaf entries.
+
+    Exactness degrades to the strongest evidence available on BOTH sides:
+    content digests, else same-algorithm integrity checksums, else only
+    structure — in which case equality is 'unknown', never claimed.
+    Comparison is chunk/shard-layout-sensitive by construction: identical
+    content striped differently (e.g. saved at different world sizes)
+    reports as changed.
+    """
+    if ea.type != eb.type:
+        return "changed"
+    if isinstance(ea, PrimitiveEntry):
+        return (
+            "same"
+            if (ea.ptype, ea.readable) == (eb.ptype, eb.readable)
+            else "changed"
+        )
+    if str(getattr(ea, "dtype", None)) != str(getattr(eb, "dtype", None)):
+        return "changed"
+    if list(getattr(ea, "shape", []) or []) != list(getattr(eb, "shape", []) or []):
+        return "changed"
+    if (
+        isinstance(ea, ObjectEntry)
+        and ea.size is not None
+        and eb.size is not None
+        and ea.size != eb.size
+    ):
+        return "changed"
+    pa = dict(_sub_payload_entries(ea))
+    pb = dict(_sub_payload_entries(eb))
+    if set(pa) != set(pb):
+        return "changed"  # different chunk/shard layout
+    unknown = False
+    for box, sub_a in pa.items():
+        sub_b = pb[box]
+        if sub_a.digest is not None and sub_b.digest is not None:
+            if sub_a.digest != sub_b.digest:
+                return "changed"
+        elif (
+            sub_a.checksum is not None
+            and sub_b.checksum is not None
+            and sub_a.checksum.partition(":")[0] == sub_b.checksum.partition(":")[0]
+        ):
+            if sub_a.checksum != sub_b.checksum:
+                return "changed"
+        else:
+            unknown = True
+    return "unknown" if unknown else "same"
+
+
+def cmd_diff(args: argparse.Namespace) -> int:
+    meta_a = _load_metadata(args.a)
+    meta_b = _load_metadata(args.b)
+
+    def leaves(meta):
+        return {
+            p: e for p, e in meta.manifest.items() if not is_container_entry(e)
+        }
+
+    a, b = leaves(meta_a), leaves(meta_b)
+    added = sorted(set(b) - set(a))
+    removed = sorted(set(a) - set(b))
+    changed, unchanged, uncertain = [], [], []
+    for p in sorted(set(a) & set(b)):
+        status = _leaf_compare(a[p], b[p])
+        if status == "changed":
+            changed.append(p)
+        elif status == "same":
+            unchanged.append(p)
+        else:
+            uncertain.append(p)
+    for p in added:
+        print(f"+ {p}")
+    for p in removed:
+        print(f"- {p}")
+    for p in changed:
+        print(f"~ {p}  ({_entry_desc(b[p])})")
+    if args.verbose:
+        for p in unchanged:
+            print(f"= {p}")
+        for p in uncertain:
+            print(f"? {p}  (structure equal; no digest/checksum common to "
+                  "both snapshots)")
+    print(
+        f"{len(added)} added, {len(removed)} removed, {len(changed)} changed, "
+        f"{len(unchanged)} unchanged"
+        + (f", {len(uncertain)} indeterminate" if uncertain else "")
+    )
+    return 1 if (added or removed or changed) else 0
+
+
 def cmd_consolidate(args: argparse.Namespace) -> int:
     from .dedup import consolidate
 
@@ -339,6 +453,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("src")
     p.add_argument("dst")
     p.set_defaults(fn=cmd_consolidate)
+
+    p = sub.add_parser("diff", help="compare two snapshots leaf by leaf")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also list unchanged/indeterminate leaves")
+    p.set_defaults(fn=cmd_diff)
     return parser
 
 
