@@ -3,10 +3,22 @@
 Async file I/O via aiofiles (thread-pool backed — file I/O releases the GIL so
 this overlaps with DtoH staging). Parent directories are created lazily with a
 cache; ranged reads seek into the file.
+
+Writes are ATOMIC: each file lands via temp-file + ``os.replace`` so a
+crash mid-write can never leave a truncated payload, and — critically —
+the ``.snapshot_metadata`` commit point is all-or-nothing (the reference
+writes in place, storage_plugins/fs.py:31-35, so a crash there can leave
+metadata that parses halfway). ``TORCHSNAPSHOT_TPU_FSYNC=1`` additionally
+fsyncs the data before the rename AND the parent directory after it, for
+power-loss durability of the published file (off by default: flush
+latency is paid per write, though in the executor so concurrent writes
+still overlap).
 """
 
 from __future__ import annotations
 
+import asyncio
+import itertools
 import os
 from typing import Set
 
@@ -15,11 +27,30 @@ import aiofiles.os
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
+FSYNC_ENV_VAR = "TORCHSNAPSHOT_TPU_FSYNC"
+
+_tmp_counter = itertools.count()
+
+
+def _fsync_enabled() -> bool:
+    value = os.environ.get(FSYNC_ENV_VAR, "0").strip().lower()
+    return value not in ("", "0", "false", "no", "off")
+
+
+def _fsync_path(path: str) -> None:
+    """Blocking fsync of a file or directory path (runs in an executor)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
 
 class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str, storage_options=None) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
+        self._fsync = _fsync_enabled()
 
     async def _ensure_parent(self, path: str) -> None:
         parent = os.path.dirname(path)
@@ -30,8 +61,34 @@ class FSStoragePlugin(StoragePlugin):
     async def write(self, write_io: WriteIO) -> None:
         path = os.path.join(self.root, write_io.path)
         await self._ensure_parent(path)
-        async with aiofiles.open(path, "wb") as f:
-            await f.write(write_io.buf)
+        # Per-call unique temp name: concurrent writers of the same path are
+        # not a supported pattern, but even then each task owns its temp and
+        # the last completed replace wins a whole file, never a mix.
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
+        loop = asyncio.get_running_loop()
+        try:
+            async with aiofiles.open(tmp, "wb") as f:
+                await f.write(write_io.buf)
+                if self._fsync:
+                    await f.flush()
+                    # Blocking flush latency belongs in the I/O thread pool,
+                    # not on the event loop where it would serialize every
+                    # concurrent write behind the drive.
+                    fd = f.fileno()
+                    await loop.run_in_executor(None, os.fsync, fd)
+            await aiofiles.os.replace(tmp, path)
+            if self._fsync:
+                # The rename itself must reach disk for the commit to be
+                # power-loss durable: fsync the parent directory entry.
+                await loop.run_in_executor(
+                    None, _fsync_path, os.path.dirname(path) or "."
+                )
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
 
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
