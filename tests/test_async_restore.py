@@ -1,0 +1,101 @@
+"""async_restore: background restore overlapping caller work.
+
+No reference analogue (its restore is synchronous only); mirrors the
+fault-injection style of tests/test_async_take.py.
+"""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+def _state(v=1.0):
+    return StateDict(
+        w=np.full((128, 64), v, np.float32),
+        nested={"b": np.full((32,), v * 2, np.float32)},
+        step=int(v),
+    )
+
+
+def test_async_restore_roundtrip(tmp_path):
+    p = str(tmp_path / "snap")
+    Snapshot.take(p, {"app": _state(3.0)})
+
+    dst = _state(0.0)
+    pending = Snapshot(p).async_restore({"app": dst})
+    # caller-side work overlapping the restore (stand-in for jit compile)
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: (x * 2).sum()).lower(
+        jnp.zeros((8, 8), jnp.float32)
+    ).compile()
+    pending.wait()
+    assert pending.done()
+    np.testing.assert_array_equal(dst["w"], np.full((128, 64), 3.0, np.float32))
+    np.testing.assert_array_equal(dst["nested"]["b"], np.full((32,), 6.0, np.float32))
+    assert dst["step"] == 3
+    assert float(fn(jnp.ones((8, 8), jnp.float32))) == 128.0
+
+
+def test_async_restore_propagates_failure(tmp_path):
+    p = str(tmp_path / "snap")
+    Snapshot.take(p, {"app": _state(1.0)})
+    # destination whose structure mismatches -> restore must fail via wait()
+    dst = StateDict(w=np.zeros((7, 7), np.float32))
+    pending = Snapshot(p).async_restore({"app": dst})
+    with pytest.raises(RuntimeError):
+        pending.wait()
+    assert pending.done()
+
+
+def test_async_restore_jax_sharded_dst(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+    src = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8), sharding)
+    p = str(tmp_path / "snap")
+    Snapshot.take(p, {"m": StateDict(emb=src)})
+
+    dst = StateDict(emb=jax.device_put(jnp.zeros((8, 8), jnp.float32), sharding))
+    pending = Snapshot(p).async_restore({"m": dst})
+    pending.wait()
+    np.testing.assert_array_equal(
+        np.asarray(dst["emb"]), np.arange(64, dtype=np.float32).reshape(8, 8)
+    )
+    assert dst["emb"].sharding.is_equivalent_to(sharding, 2)
+
+
+def _async_restore_worker(rank, world_size, snap_path):
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    state = {
+        "model": StateDict(w=np.arange(256, dtype=np.float32)),
+        "local": StateDict(r=np.full((4,), rank, np.int32)),
+    }
+    Snapshot.take(snap_path, state, replicated=["model/*"])
+
+    dst = {
+        "model": StateDict(w=np.zeros(256, np.float32)),
+        "local": StateDict(r=np.zeros((4,), np.int32)),
+    }
+    pending = Snapshot(snap_path).async_restore(dst)
+    pending.wait()
+    np.testing.assert_array_equal(dst["model"]["w"], np.arange(256, dtype=np.float32))
+    np.testing.assert_array_equal(dst["local"]["r"], np.full((4,), rank, np.int32))
+    return "ok"
+
+
+def test_async_restore_multiprocess(tmp_path):
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _async_restore_worker, 2, str(tmp_path / "snap")
+    )
+    assert all(v == "ok" for v in results.values())

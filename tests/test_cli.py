@@ -177,6 +177,44 @@ def test_diff_indeterminate_without_any_evidence(tmp_path, capsys, monkeypatch):
     assert "1 indeterminate" in capsys.readouterr().out
 
 
+def test_deps_graph_and_safe_to_delete(tmp_path, capsys):
+    base = str(tmp_path / "step_0")
+    inc = str(tmp_path / "step_1")
+    solo = str(tmp_path / "solo")
+    Snapshot.take(base, {"app": StateDict(w=np.ones(16, np.float32))},
+                  record_digests=True)
+    Snapshot.take(inc, {"app": StateDict(w=np.ones(16, np.float32))},
+                  incremental_base=base)
+    Snapshot.take(solo, {"app": StateDict(v=np.zeros(4, np.float32))})
+
+    assert main(["deps", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "step_0 [REQUIRED by step_1]" in out
+    assert "step_1 <- bases: step_0" in out
+    assert "safe to delete" in out
+    safe_line = [l for l in out.splitlines() if l.startswith("safe to delete")][0]
+    assert "step_1" in safe_line and "solo" in safe_line
+    assert "step_0" not in safe_line
+
+
+def test_deps_with_relative_base_recorded(tmp_path, capsys, monkeypatch):
+    """A base given as a RELATIVE path at take time must still be matched
+    when deps runs from a different working directory — origins are
+    canonicalized at record time, so a false 'safe to delete' (data loss)
+    can't happen."""
+    monkeypatch.chdir(tmp_path)
+    Snapshot.take("step_0", {"app": StateDict(w=np.ones(8, np.float32))},
+                  record_digests=True)
+    Snapshot.take("step_1", {"app": StateDict(w=np.ones(8, np.float32))},
+                  incremental_base="step_0")
+    monkeypatch.chdir("/")
+    assert main(["deps", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "step_0 [REQUIRED by step_1]" in out
+    safe_line = [l for l in out.splitlines() if l.startswith("safe to delete")][0]
+    assert "step_0" not in safe_line
+
+
 def test_looks_native_handles_type_name_collisions():
     from torchsnapshot_tpu.cli import _looks_native
 

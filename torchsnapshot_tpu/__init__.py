@@ -8,6 +8,6 @@ try:
     from .stateful import AppState, Stateful  # noqa: F401
     from .state_dict import StateDict  # noqa: F401
     from .rng_state import RNGState  # noqa: F401
-    from .snapshot import PendingSnapshot, Snapshot  # noqa: F401
+    from .snapshot import PendingRestore, PendingSnapshot, Snapshot  # noqa: F401
 except ImportError:  # pragma: no cover - during incremental bring-up only
     pass

@@ -18,6 +18,9 @@ on-call asks, so they get first-class commands here:
 - ``diff``     — compare two snapshots leaf by leaf (added/removed/
   changed/unchanged) using recorded content digests where available,
   falling back to checksum then shape/dtype.
+- ``deps``     — scan a directory of snapshots and print the incremental
+  origin graph: which snapshots reference which bases, and which are
+  safe to delete (referenced by no other snapshot in the directory).
 
 The inspection commands (``info``/``ls``/``cat``/``verify``) and
 ``consolidate`` work over any registered storage backend (fs://, s3://,
@@ -401,6 +404,84 @@ def cmd_diff(args: argparse.Namespace) -> int:
     return 1 if (added or removed or changed) else 0
 
 
+def _canon_snapshot_url(url: str) -> str:
+    """Canonical comparable form of a snapshot path/URL (fs:// == bare).
+
+    Matches the canonicalization applied to origins at record time
+    (dedup.canonical_base_url), plus fs://-vs-bare equivalence; realpath
+    (not abspath) so symlinked checkpoint directories compare equal.
+    """
+    import os
+
+    if url.startswith("fs://"):
+        url = url[len("fs://"):]
+    if "://" in url:
+        return url  # remote URL: compare verbatim
+    return os.path.realpath(url)
+
+
+def cmd_deps(args: argparse.Namespace) -> int:
+    import os
+
+    dirpath = args.dir
+    snapshots = sorted(
+        name
+        for name in os.listdir(dirpath)
+        if os.path.isfile(os.path.join(dirpath, name, ".snapshot_metadata"))
+    )
+    if not snapshots:
+        print(f"no snapshots found under {dirpath}")
+        return 2
+
+    # origin URL -> set of snapshot names (in this dir) referencing it
+    referenced: Dict[str, set] = {}
+    origins_of: Dict[str, set] = {}
+    for name in snapshots:
+        full = os.path.join(dirpath, name)
+        meta = _load_metadata(full)
+        origins = set()
+        for entry in meta.manifest.values():
+            for _, _, _, _, origin in _entry_payloads(entry):
+                if origin is not None:
+                    origins.add(origin)
+        origins_of[name] = origins
+        for origin in origins:
+            referenced.setdefault(_canon_snapshot_url(origin), set()).add(name)
+
+    canon_of = {
+        name: _canon_snapshot_url(os.path.join(dirpath, name))
+        for name in snapshots
+    }
+    safe = []
+    for name in snapshots:
+        dependents = referenced.get(canon_of[name], set())
+        origins = origins_of[name]
+        tag = ""
+        if origins:
+            tag += " <- bases: " + ", ".join(
+                os.path.basename(o) for o in sorted(origins)
+            )
+        if dependents:
+            tag += " [REQUIRED by " + ", ".join(sorted(dependents)) + "]"
+        else:
+            safe.append(name)
+        print(f"{name}{tag}")
+    local_canon = set(canon_of.values())
+    external = {
+        o
+        for origins in origins_of.values()
+        for o in origins
+        if _canon_snapshot_url(o) not in local_canon
+    }
+    for o in sorted(external):
+        print(f"(external base outside this directory: {o})")
+    print(
+        "safe to delete (no dependents here): "
+        + (", ".join(safe) if safe else "none")
+    )
+    return 0
+
+
 def cmd_consolidate(args: argparse.Namespace) -> int:
     from .dedup import consolidate
 
@@ -460,6 +541,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="store_true",
                    help="also list unchanged/indeterminate leaves")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "deps", help="origin graph of a directory of snapshots"
+    )
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_deps)
     return parser
 
 

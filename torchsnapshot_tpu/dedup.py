@@ -51,6 +51,23 @@ from .manifest import (
 DIGEST_ALGO = "sha256"
 
 
+def canonical_base_url(url: str) -> str:
+    """Canonical form of a base-snapshot URL for recording as an origin.
+
+    Origins are resolved later from arbitrary working directories (restore
+    on another host's job, CLI ``deps``/``verify``), so a relative path or
+    symlink recorded verbatim would dangle. Filesystem paths resolve to
+    their real absolute path; remote URLs pass through verbatim.
+    """
+    import os
+
+    if url.startswith("fs://"):
+        return "fs://" + os.path.realpath(url[len("fs://"):])
+    if "://" in url:
+        return url
+    return os.path.realpath(url)
+
+
 def compute_digest(buf) -> str:
     h = hashlib.sha256()
     h.update(memoryview(buf).cast("B"))

@@ -270,7 +270,13 @@ class Snapshot:
         world_size = pg_wrapper.get_world_size()
         app_state = dict(app_state)
 
-        from .dedup import DedupContext, dedup_staging
+        from .dedup import DedupContext, canonical_base_url, dedup_staging
+
+        if incremental_base is not None:
+            # Recorded origins must resolve from any working directory /
+            # via symlinks later (restores, CLI deps/verify), so pin the
+            # canonical URL before anything references it.
+            incremental_base = canonical_base_url(incremental_base)
 
         dedup_ctx: Optional[DedupContext] = None
         if (incremental_base is not None or record_digests) and batching_enabled():
@@ -458,8 +464,33 @@ class Snapshot:
         shapes/dtypes/shardings of the *current* state (memory-efficient and
         sharding-aware; reference rationale: snapshot.py:693-700)."""
         self._validate_app_state(app_state)
-        event_loop = asyncio.new_event_loop()
+        self._restore_impl(app_state, PGWrapper(self.pg))
+
+    def async_restore(self, app_state: AppState) -> "PendingRestore":
+        """Restore on a background thread; returns a handle immediately.
+
+        Lets a resuming program overlap the restore (storage reads, HtoD
+        transfers) with other startup work — typically jit compilation of
+        the train step, which needs only shapes, not values. The app state
+        must not be read, mutated, or checkpointed until ``.wait()``
+        returns; the KV-store collectives used for cross-rank lockstep are
+        background-thread-safe, but do not start OTHER snapshot operations
+        (take/restore) on any rank before waiting — collective ordering
+        across ranks must stay consistent. No reference analogue (its
+        restore is synchronous only).
+        """
+        self._validate_app_state(app_state)
         pg_wrapper = PGWrapper(self.pg)
+        # Entry barrier on the CALLING thread: synchronizes all ranks into
+        # the restore and — critically — performs the wrapper's namespace
+        # handshake in foreground construction order, so the background
+        # thread's collectives can never desynchronize against other
+        # wrappers created later on the main thread.
+        pg_wrapper.barrier()
+        return PendingRestore(self, app_state, pg_wrapper)
+
+    def _restore_impl(self, app_state: AppState, pg_wrapper: PGWrapper) -> None:
+        event_loop = asyncio.new_event_loop()
         rank = pg_wrapper.get_rank()
         storage = url_to_storage_plugin_in_event_loop(
             self.path, event_loop, self._storage_options
@@ -1179,6 +1210,41 @@ class PendingSnapshot:
             raise self._exc
         assert self._snapshot is not None
         return self._snapshot
+
+    def done(self) -> bool:
+        return self._done_event.is_set()
+
+
+class PendingRestore:
+    """Handle over a restore running on a background thread.
+
+    ``wait()`` joins and re-raises any failure; until then the app state
+    being restored must not be touched (see ``Snapshot.async_restore``).
+    """
+
+    def __init__(
+        self, snapshot: Snapshot, app_state: AppState, pg_wrapper: PGWrapper
+    ) -> None:
+        self._exc: Optional[BaseException] = None
+        self._done_event = threading.Event()
+
+        def run() -> None:
+            try:
+                snapshot._restore_impl(app_state, pg_wrapper)
+            except BaseException as e:  # noqa: B036
+                self._exc = e
+            finally:
+                self._done_event.set()
+
+        self._thread = threading.Thread(
+            target=run, name="tsnap-async-restore", daemon=True
+        )
+        self._thread.start()
+
+    def wait(self) -> None:
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
 
     def done(self) -> bool:
         return self._done_event.is_set()
