@@ -1,0 +1,250 @@
+"""Mirrored (two-tier) storage: fast primary + background durable mirror.
+
+No reference analogue. Fault injection mirrors the style of
+tests/test_async_take.py (plugin subclassing).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.io_types import WriteIO
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.storage_plugins.mirror import MirroredStoragePlugin
+
+
+def _state(v=1.0):
+    return StateDict(
+        w=np.full((64, 32), v, np.float32),
+        nested={"b": np.full((16,), v, np.float32)},
+        step=int(v),
+    )
+
+
+def _opts(mirror_dir, **extra):
+    return {"mirror_url": str(mirror_dir), **extra}
+
+
+def test_take_commits_both_tiers(tmp_path):
+    primary, mirror = tmp_path / "fast", tmp_path / "durable"
+    Snapshot.take(str(primary), {"app": _state(3.0)},
+                  storage_options=_opts(mirror))
+
+    # both tiers are complete, independently restorable snapshots
+    for root in (primary, mirror):
+        dst = _state(0.0)
+        Snapshot(str(root)).restore({"app": dst})
+        np.testing.assert_array_equal(dst["w"], np.full((64, 32), 3.0, np.float32))
+        assert (root / SNAPSHOT_METADATA_FNAME).exists()
+
+
+def test_read_falls_back_to_mirror(tmp_path):
+    primary, mirror = tmp_path / "fast", tmp_path / "durable"
+    Snapshot.take(str(primary), {"app": _state(2.0)},
+                  storage_options=_opts(mirror))
+
+    # local disk loses a payload; restore through the mirrored options
+    victims = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(primary)
+        for f in fs
+        if "w" in f and f != SNAPSHOT_METADATA_FNAME
+    ]
+    assert victims
+    for v in victims:
+        os.remove(v)
+    dst = _state(0.0)
+    Snapshot(str(primary), storage_options=_opts(mirror)).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], np.full((64, 32), 2.0, np.float32))
+
+
+class _FaultyMirror(FSStoragePlugin):
+    async def write(self, write_io: WriteIO) -> None:
+        if write_io.path != SNAPSHOT_METADATA_FNAME:
+            raise RuntimeError("mirror down")
+        await super().write(write_io)
+
+
+def test_mirror_failure_keeps_primary_and_never_commits_mirror(tmp_path):
+    primary, mirror = tmp_path / "fast", tmp_path / "durable"
+    plugin = MirroredStoragePlugin(
+        primary=FSStoragePlugin(str(primary)),
+        mirror=_FaultyMirror(str(mirror)),
+        metadata_filename=SNAPSHOT_METADATA_FNAME,
+    )
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(plugin.write(WriteIO(path="0/app/w", buf=b"abcd")))
+        loop.run_until_complete(
+            plugin.write(WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=b"meta"))
+        )
+        with pytest.raises(RuntimeError, match="mirror write"):
+            loop.run_until_complete(plugin.close())
+    finally:
+        loop.close()
+    # primary complete; mirror has NO metadata (uncommitted => invisible)
+    assert (primary / "0/app/w").read_bytes() == b"abcd"
+    assert (primary / SNAPSHOT_METADATA_FNAME).read_bytes() == b"meta"
+    assert not (mirror / SNAPSHOT_METADATA_FNAME).exists()
+
+
+def test_mirror_failure_nonstrict_warns_only(tmp_path):
+    primary, mirror = tmp_path / "fast", tmp_path / "durable"
+    plugin = MirroredStoragePlugin(
+        primary=FSStoragePlugin(str(primary)),
+        mirror=_FaultyMirror(str(mirror)),
+        metadata_filename=SNAPSHOT_METADATA_FNAME,
+        strict=False,
+    )
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(plugin.write(WriteIO(path="x", buf=b"1")))
+        loop.run_until_complete(plugin.close())  # no raise
+    finally:
+        loop.close()
+
+
+class _SlowMirror(FSStoragePlugin):
+    """Records the peak number of concurrently retained mirror buffers."""
+
+    def __init__(self, root, delay_s=0.02):
+        super().__init__(root)
+        self.delay_s = delay_s
+
+    async def write(self, write_io: WriteIO) -> None:
+        await asyncio.sleep(self.delay_s)
+        await super().write(write_io)
+
+
+def test_backlog_backpressure_bounds_retained_bytes(tmp_path):
+    primary, mirror = tmp_path / "fast", tmp_path / "durable"
+    plugin = MirroredStoragePlugin(
+        primary=FSStoragePlugin(str(primary)),
+        mirror=_SlowMirror(str(mirror)),
+        metadata_filename=SNAPSHOT_METADATA_FNAME,
+        backlog_bytes=3000,  # three 1 KB payloads in flight at most
+    )
+    peak = 0
+
+    async def run():
+        nonlocal peak
+
+        async def one(i):
+            nonlocal peak
+            await plugin.write(WriteIO(path=f"p{i}", buf=b"x" * 1000))
+            peak = max(peak, plugin._backlog_bytes)
+
+        await asyncio.gather(*(one(i) for i in range(12)))
+        await plugin.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(run())
+    finally:
+        loop.close()
+    assert peak <= 3000
+    for i in range(12):
+        assert (mirror / f"p{i}").read_bytes() == b"x" * 1000
+
+
+def test_async_take_with_mirror(tmp_path):
+    primary, mirror = tmp_path / "fast", tmp_path / "durable"
+    pending = Snapshot.async_take(
+        str(primary), {"app": _state(5.0)}, storage_options=_opts(mirror)
+    )
+    pending.wait()
+    # by wait() time BOTH tiers are committed
+    for root in (primary, mirror):
+        dst = _state(0.0)
+        Snapshot(str(root)).restore({"app": dst})
+        np.testing.assert_array_equal(dst["w"], np.full((64, 32), 5.0, np.float32))
+
+
+def test_incremental_take_with_mirror_strips_mirror_for_base(tmp_path):
+    """Mirror options name THIS snapshot's mirror; base/origin reads must
+    not be wrapped with it (a wrong fallback root). The combination
+    incremental + mirror works end to end and the mirror tier of the
+    incremental is itself restorable (its entries reference the base)."""
+    base_p, base_m = str(tmp_path / "b_fast"), str(tmp_path / "b_durable")
+    inc_p, inc_m = str(tmp_path / "i_fast"), str(tmp_path / "i_durable")
+    Snapshot.take(base_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": base_m},
+                  record_digests=True)
+    Snapshot.take(inc_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": inc_m},
+                  incremental_base=base_p)
+
+    # frozen payloads not rewritten in either tier of the incremental
+    for root in (inc_p, inc_m):
+        payload_files = [
+            f for r, _, fs in os.walk(root) for f in fs
+            if f != SNAPSHOT_METADATA_FNAME
+        ]
+        assert not any("w" in f for f in payload_files), (root, payload_files)
+
+    # restore from the incremental's mirror tier (base primary intact)
+    dst = _state(0.0)
+    Snapshot(inc_m).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], np.full((64, 32), 1.0, np.float32))
+
+
+def _mirror_worker(rank, world_size, primary_dir, mirror_dir):
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    state = {
+        "model": StateDict(w=np.arange(128, dtype=np.float32)),
+        "local": StateDict(r=np.full((4,), rank, np.int32)),
+    }
+    Snapshot.take(
+        primary_dir, state, replicated=["model/*"],
+        storage_options={"mirror_url": mirror_dir},
+    )
+    return "ok"
+
+
+def test_multiprocess_mirror_commit_is_complete(tmp_path):
+    """Every rank's payload mirrors drain before the commit barrier, so
+    the mirror metadata never publishes a mirror missing a rank's data."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    primary, mirror = str(tmp_path / "fast"), str(tmp_path / "durable")
+    results = run_with_subprocesses(_mirror_worker, 2, primary, mirror)
+    assert all(v == "ok" for v in results.values())
+
+    # the mirror restores completely for both ranks' views
+    for rank in range(2):
+        dst = {
+            "model": StateDict(w=np.zeros(128, np.float32)),
+            "local": StateDict(r=np.zeros((4,), np.int32)),
+        }
+        import subprocess
+        import sys
+
+        code = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.manifest import get_available_entries
+meta = Snapshot({mirror!r}).metadata
+avail = get_available_entries(meta.manifest, {rank})
+assert "model/w" in avail and "local/r" in avail
+state = Snapshot({mirror!r}).read_state_dict(rank={rank})
+np.testing.assert_array_equal(state["model"]["w"], np.arange(128, dtype=np.float32))
+np.testing.assert_array_equal(state["local"]["r"], np.full((4,), {rank}, np.int32))
+print("MIRROR-RANK-OK")
+"""
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
+        )
+        assert r.returncode == 0, r.stderr
+        assert "MIRROR-RANK-OK" in r.stdout

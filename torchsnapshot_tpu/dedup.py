@@ -203,8 +203,15 @@ def consolidate(
 
     from .io_types import ReadIO, WriteIO
     from .snapshot import Snapshot
-    from .storage_plugin import url_to_storage_plugin_in_event_loop
+    from .storage_plugin import (
+        strip_mirror_options,
+        url_to_storage_plugin_in_event_loop,
+    )
 
+    # Mirror settings name the SOURCE snapshot's mirror; they must not leak
+    # onto origin snapshots or the destination (the consolidated result is
+    # single-tier — mirror it explicitly if desired).
+    storage_options = strip_mirror_options(storage_options)
     metadata = Snapshot(src_path, storage_options=storage_options).metadata
 
     # One copy per distinct location; byte-ranged payloads (batched slabs)

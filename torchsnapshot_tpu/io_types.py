@@ -111,5 +111,10 @@ class StoragePlugin(abc.ABC):
     async def close(self) -> None:
         ...
 
+    async def drain_background(self) -> None:
+        """Wait for plugin-internal background work (e.g. mirror
+        replication) to finish. The snapshot orchestrator awaits this on
+        every rank before the commit barrier; default: nothing to drain."""
+
     def sync_close(self, event_loop) -> None:
         event_loop.run_until_complete(self.close())

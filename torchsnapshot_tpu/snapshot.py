@@ -39,6 +39,7 @@ from __future__ import annotations
 import asyncio
 import fnmatch
 import logging
+import sys
 import threading
 import time
 import uuid
@@ -119,6 +120,14 @@ class _PhaseTimer:
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 
 
+def _drain_background_storage(
+    storage: StoragePlugin, event_loop: asyncio.AbstractEventLoop
+) -> None:
+    """Drain plugin-internal background work (e.g. mirror replication)
+    before the commit barrier — see StoragePlugin.drain_background."""
+    event_loop.run_until_complete(storage.drain_background())
+
+
 class Snapshot:
     """A handle to a snapshot at ``path`` (fs://, s3://, gs:// or bare path)."""
 
@@ -182,6 +191,7 @@ class Snapshot:
                     storage_options=storage_options,
                 )
             pending_io_work.sync_complete(event_loop)
+            _drain_background_storage(storage, event_loop)
             timer.mark("io_drain")
             pg_wrapper.barrier()
             if pg_wrapper.get_rank() == 0:
@@ -196,8 +206,19 @@ class Snapshot:
                 pg_wrapper.retire()
             except Exception:
                 pass
-            storage.sync_close(event_loop)
-            event_loop.close()
+            try:
+                storage.sync_close(event_loop)
+            except Exception:
+                # Close-time errors (e.g. a strict mirror failure) matter —
+                # but never at the cost of masking an in-flight take error,
+                # and never leaking the event loop.
+                if sys.exc_info()[0] is None:
+                    raise
+                logger.exception(
+                    "storage close failed while handling a take failure."
+                )
+            finally:
+                event_loop.close()
         snapshot = cls(path, pg, storage_options)
         snapshot._metadata = metadata
         return snapshot
@@ -292,7 +313,12 @@ class Snapshot:
                 "TORCHSNAPSHOT_TPU_ENABLE_BATCHING",
             )
         if incremental_base is not None:
-            base_meta = cls(incremental_base, storage_options=storage_options).metadata
+            from .storage_plugin import strip_mirror_options
+
+            base_meta = cls(
+                incremental_base,
+                storage_options=strip_mirror_options(storage_options),
+            ).metadata
             dedup_ctx = DedupContext.from_base(incremental_base, base_meta)
             if not dedup_ctx.refs:
                 logger.warning(
@@ -726,8 +752,10 @@ class Snapshot:
                     reqs, storage, memory_budget, rank, event_loop
                 )
                 continue
+            from .storage_plugin import strip_mirror_options
+
             origin_storage = url_to_storage_plugin_in_event_loop(
-                origin, event_loop, self._storage_options
+                origin, event_loop, strip_mirror_options(self._storage_options)
             )
             try:
                 sync_execute_read_reqs(
@@ -1166,6 +1194,7 @@ class PendingSnapshot:
             )
         try:
             pending_io_work.sync_complete(event_loop)
+            _drain_background_storage(storage, event_loop)
             if self._timer is not None:
                 self._timer.mark("io_drain")
             if barrier is not None:
@@ -1197,6 +1226,14 @@ class PendingSnapshot:
                 pass
             try:
                 storage.sync_close(event_loop)
+            except Exception as e:
+                # A close-time failure must reach wait(): mirrored storage
+                # commits the mirror tier here, and silently dropping its
+                # error would report a durable copy that doesn't exist.
+                if self._exc is None:
+                    self._exc = e
+                logger.exception("storage close failed after commit.")
+            try:
                 event_loop.close()
             except Exception:
                 pass

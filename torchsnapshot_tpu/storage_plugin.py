@@ -18,6 +18,29 @@ from .io_types import StoragePlugin
 def url_to_storage_plugin(
     url_path: str, storage_options: Optional[Dict[str, Any]] = None
 ) -> StoragePlugin:
+    # Two-tier mirroring: {"mirror_url": "..."} wraps the resolved primary
+    # with background replication to a second backend (mirror.py). The
+    # mirror's own options can be supplied via {"mirror_options": {...}}.
+    if storage_options and storage_options.get("mirror_url"):
+        from .snapshot import SNAPSHOT_METADATA_FNAME
+        from .storage_plugins.mirror import (
+            DEFAULT_MIRROR_BACKLOG_BYTES,
+            MirroredStoragePlugin,
+        )
+
+        opts = dict(storage_options)
+        mirror_url = opts.pop("mirror_url")
+        mirror_opts = opts.pop("mirror_options", None)
+        backlog = opts.pop("mirror_backlog_bytes", DEFAULT_MIRROR_BACKLOG_BYTES)
+        strict = opts.pop("mirror_strict", True)
+        return MirroredStoragePlugin(
+            primary=url_to_storage_plugin(url_path, opts or None),
+            mirror=url_to_storage_plugin(mirror_url, mirror_opts),
+            metadata_filename=SNAPSHOT_METADATA_FNAME,
+            backlog_bytes=backlog,
+            strict=strict,
+        )
+
     if "://" in url_path:
         protocol, _, path = url_path.partition("://")
         if protocol == "":
@@ -48,6 +71,21 @@ def url_to_storage_plugin(
         f"Failed to resolve storage plugin for protocol {protocol!r} "
         f"(url: {url_path!r})."
     )
+
+
+def strip_mirror_options(
+    storage_options: Optional[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """Storage options for a DIFFERENT snapshot than the one they were
+    given for: the mirror settings name that snapshot's mirror location,
+    which is meaningless (and harmful — a wrong fallback root, stray
+    replication) applied to a base/origin snapshot's storage."""
+    if not storage_options:
+        return storage_options
+    cleaned = {
+        k: v for k, v in storage_options.items() if not k.startswith("mirror")
+    }
+    return cleaned or None
 
 
 def url_to_storage_plugin_in_event_loop(

@@ -1,0 +1,177 @@
+"""Mirrored (two-tier) storage: fast primary + background durable mirror.
+
+Production pattern with no reference analogue: checkpoints land on fast
+local storage (quick saves, quick restarts after a process crash) and are
+replicated in the background to durable remote storage (survives the
+machine), without the training loop ever waiting on the slow tier.
+
+Activate by passing ``storage_options={"mirror_url": "gs://..."}`` to any
+snapshot operation — the resolved primary plugin is wrapped transparently.
+
+Semantics:
+
+- ``write``: awaits the primary write, then schedules the mirror write in
+  the background. The staged buffer is retained (zero-copy) until its
+  mirror write completes, bounded by a byte-budget semaphore — when more
+  than ``mirror_backlog_bytes`` (default 512 MB) of payloads await
+  mirroring, further writes exert backpressure instead of accumulating
+  unbounded memory beyond the scheduler's budget.
+- ``.snapshot_metadata`` is special-cased: it commits the PRIMARY
+  immediately, but its mirror copy is deferred until ``close()``, AFTER
+  every payload's mirror write has drained — the metadata-last commit
+  protocol holds independently on each tier, so a reader of the mirror
+  never sees a committed-but-incomplete snapshot. Multi-rank saves stay
+  safe because the orchestrator calls ``drain_background()`` on every
+  rank BEFORE the commit barrier: by the time rank 0's close commits the
+  mirror metadata, every rank's payload mirrors have landed.
+- ``read``: primary first; falls back to the mirror when the primary
+  lost the payload (e.g. local disk wiped between save and restore).
+- Mirror failures do not fail the snapshot (the primary committed); they
+  are logged and raised at ``close()`` on the failing rank unless
+  ``storage_options={"mirror_strict": False}``. A failing rank's error
+  does not stop rank 0 from committing the mirror metadata — strict mode
+  makes the failure loud on that rank; re-run ``python -m
+  torchsnapshot_tpu verify`` against the mirror before trusting it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional, Set
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_MIRROR_BACKLOG_BYTES = 512 * 1024 * 1024
+
+
+class MirroredStoragePlugin(StoragePlugin):
+    def __init__(
+        self,
+        primary: StoragePlugin,
+        mirror: StoragePlugin,
+        metadata_filename: str,
+        backlog_bytes: int = DEFAULT_MIRROR_BACKLOG_BYTES,
+        strict: bool = True,
+    ) -> None:
+        self.primary = primary
+        self.mirror = mirror
+        self.metadata_filename = metadata_filename
+        self.strict = strict
+        self._backlog_limit = max(1, backlog_bytes)
+        self._backlog_bytes = 0
+        self._backlog_cv: Optional[asyncio.Condition] = None
+        self._mirror_tasks: Set[asyncio.Task] = set()
+        self._pending_metadata: Optional[bytes] = None
+        self._mirror_errors: List[BaseException] = []
+
+    def _cv(self) -> asyncio.Condition:
+        # Created lazily on the loop that drives the plugin.
+        if self._backlog_cv is None:
+            self._backlog_cv = asyncio.Condition()
+        return self._backlog_cv
+
+    async def _mirror_write(self, path: str, buf) -> None:
+        nbytes = len(buf)
+        try:
+            await self.mirror.write(WriteIO(path=path, buf=buf))
+        except BaseException as e:  # noqa: B036
+            logger.warning("mirror write of %s failed: %s", path, e)
+            self._mirror_errors.append(e)
+        finally:
+            async with self._cv():
+                self._backlog_bytes -= nbytes
+                self._cv().notify_all()
+
+    async def write(self, write_io: WriteIO) -> None:
+        if write_io.path == self.metadata_filename:
+            # Primary commit point is immediate; the mirror's commit point
+            # is deferred to close(), after its payloads have landed.
+            await self.primary.write(write_io)
+            self._pending_metadata = bytes(write_io.buf)
+            return
+        await self.primary.write(write_io)
+        nbytes = len(write_io.buf)
+        async with self._cv():
+            # Backpressure: beyond the backlog budget, block the caller
+            # (the scheduler's io slot) instead of retaining unbounded
+            # buffers the memory budget believes are released.
+            while (
+                self._backlog_bytes > 0
+                and self._backlog_bytes + nbytes > self._backlog_limit
+            ):
+                await self._cv().wait()
+            self._backlog_bytes += nbytes
+        task = asyncio.get_running_loop().create_task(
+            self._mirror_write(write_io.path, write_io.buf)
+        )
+        self._mirror_tasks.add(task)
+        task.add_done_callback(self._mirror_tasks.discard)
+
+    async def read(self, read_io: ReadIO) -> None:
+        try:
+            await self.primary.read(read_io)
+        except (FileNotFoundError, OSError) as primary_exc:
+            try:
+                await self.mirror.read(read_io)
+            except BaseException:
+                raise primary_exc
+            logger.info(
+                "read %s from the mirror (primary copy missing)", read_io.path
+            )
+
+    async def delete(self, path: str) -> None:
+        await self.primary.delete(path)
+        try:
+            await self.mirror.delete(path)
+        except FileNotFoundError:
+            pass  # mirror may not have received it (e.g. aborted snapshot)
+
+    async def drain_background(self) -> None:
+        """Wait for every scheduled mirror payload write to finish.
+
+        The snapshot orchestrator calls this on every rank before the
+        commit barrier, so the deferred mirror metadata commit (close())
+        can never publish a mirror missing another rank's payloads.
+        """
+        if self._mirror_tasks:
+            await asyncio.gather(*self._mirror_tasks, return_exceptions=True)
+
+    async def close(self) -> None:
+        if self._mirror_tasks:
+            await asyncio.gather(*self._mirror_tasks, return_exceptions=True)
+        if self._pending_metadata is not None and not self._mirror_errors:
+            try:
+                await self.mirror.write(
+                    WriteIO(
+                        path=self.metadata_filename, buf=self._pending_metadata
+                    )
+                )
+            except BaseException as e:  # noqa: B036
+                logger.warning("mirror metadata commit failed: %s", e)
+                self._mirror_errors.append(e)
+        elif self._pending_metadata is not None:
+            logger.warning(
+                "mirror payload write(s) failed; NOT committing mirror "
+                "metadata — the mirror copy stays uncommitted/invisible"
+            )
+        self._pending_metadata = None
+        # Both backends must close even if one fails, and a strict-mode
+        # mirror error (the data-loss signal) outranks close-time errors.
+        close_exc: Optional[BaseException] = None
+        for backend in (self.primary, self.mirror):
+            try:
+                await backend.close()
+            except BaseException as e:  # noqa: B036
+                close_exc = close_exc or e
+        if self._mirror_errors and self.strict:
+            errors, self._mirror_errors = self._mirror_errors, []
+            raise RuntimeError(
+                f"{len(errors)} mirror write(s) failed (the primary tier is "
+                f"unaffected): {errors[0]!r}"
+            ) from errors[0]
+        self._mirror_errors = []
+        if close_exc is not None:
+            raise close_exc
