@@ -215,6 +215,87 @@ def test_deps_with_relative_base_recorded(tmp_path, capsys, monkeypatch):
     assert "step_0" not in safe_line
 
 
+def test_prune_keeps_required_bases(tmp_path, capsys):
+    import time
+
+    def take(name, base=None):
+        p = str(tmp_path / name)
+        Snapshot.take(p, {"app": StateDict(w=np.ones(16, np.float32))},
+                      incremental_base=base, record_digests=True)
+        time.sleep(0.02)  # distinct mtimes for retention ordering
+        return p
+
+    s0 = take("step_0")
+    take("step_1", base=s0)
+    take("step_2", base=s0)
+    take("step_3")  # independent full snapshot, the newest
+
+    # keep newest 2 (step_2, step_3); step_0 is required by step_2
+    assert main(["prune", str(tmp_path), "--keep", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "keep    step_2" in out and "keep    step_3" in out
+    assert "keep    step_0  (base of a kept snapshot)" in out
+    assert "delete  step_1" in out
+    assert "dry run" in out
+    assert (tmp_path / "step_1").exists()  # dry run deletes nothing
+
+    assert main(["prune", str(tmp_path), "--keep", "2", "--yes"]) == 0
+    capsys.readouterr()
+    assert not (tmp_path / "step_1").exists()
+    for name in ("step_0", "step_2", "step_3"):
+        assert (tmp_path / name).exists()
+
+    # the surviving incremental still restores through its kept base
+    dst = StateDict(w=np.zeros(16, np.float32))
+    Snapshot(str(tmp_path / "step_2")).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], np.ones(16, np.float32))
+
+    # pruning again: nothing eligible
+    assert main(["prune", str(tmp_path), "--keep", "3"]) == 0
+    assert "nothing to prune" in capsys.readouterr().out
+
+
+def test_prune_required_set_is_transitive(tmp_path, capsys):
+    """A spared base's OWN bases must survive: s2 borrows X from s1, s1
+    borrows Y from s0 — keeping only s2 must spare both s1 and s0, or the
+    'kept' s1 (and s2's own restore of Y via s1? no — via s0 directly)
+    would dangle."""
+    import time
+
+    def take(name, x, y, base=None):
+        p = str(tmp_path / name)
+        Snapshot.take(
+            p,
+            {"app": StateDict(x=np.full((8,), float(x), np.float32),
+                              y=np.full((8,), float(y), np.float32))},
+            incremental_base=base, record_digests=True,
+        )
+        time.sleep(0.02)
+        return p
+
+    s0 = take("s0", x=1, y=1)
+    s1 = take("s1", x=2, y=1, base=s0)  # holds X, borrows Y from s0
+    take("s2", x=2, y=2, base=s1)       # borrows X from s1, holds Y
+
+    assert main(["prune", str(tmp_path), "--keep", "1", "--yes"]) == 0
+    capsys.readouterr()
+    for name in ("s0", "s1", "s2"):
+        assert (tmp_path / name).exists(), name
+
+    # everything still restores
+    for name, (ex, ey) in (("s1", (2, 1)), ("s2", (2, 2))):
+        dst = StateDict(x=np.zeros(8, np.float32), y=np.zeros(8, np.float32))
+        Snapshot(str(tmp_path / name)).restore({"app": dst})
+        np.testing.assert_array_equal(dst["x"], np.full((8,), float(ex), np.float32))
+        np.testing.assert_array_equal(dst["y"], np.full((8,), float(ey), np.float32))
+
+
+def test_prune_rejects_remote_and_bad_args(tmp_path, capsys):
+    assert main(["prune", "gs://bucket/x", "--keep", "1"]) == 2
+    Snapshot.take(str(tmp_path / "s"), {"app": StateDict(n=1)})
+    assert main(["prune", str(tmp_path), "--keep", "0"]) == 2
+
+
 def test_looks_native_handles_type_name_collisions():
     from torchsnapshot_tpu.cli import _looks_native
 

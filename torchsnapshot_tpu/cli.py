@@ -21,6 +21,9 @@ on-call asks, so they get first-class commands here:
 - ``deps``     — scan a directory of snapshots and print the incremental
   origin graph: which snapshots reference which bases, and which are
   safe to delete (referenced by no other snapshot in the directory).
+- ``prune``    — retention: keep the newest N snapshots in a directory,
+  delete the rest EXCEPT bases that kept snapshots still reference.
+  Prints the plan; ``--yes`` executes it (local filesystem only).
 
 The inspection commands (``info``/``ls``/``cat``/``verify``) and
 ``consolidate`` work over any registered storage backend (fs://, s3://,
@@ -424,27 +427,15 @@ def cmd_deps(args: argparse.Namespace) -> int:
     import os
 
     dirpath = args.dir
-    snapshots = sorted(
-        name
-        for name in os.listdir(dirpath)
-        if os.path.isfile(os.path.join(dirpath, name, ".snapshot_metadata"))
-    )
+    names, origins_of = _scan_snapshot_dir(dirpath)
+    snapshots = sorted(names)
     if not snapshots:
         print(f"no snapshots found under {dirpath}")
         return 2
 
     # origin URL -> set of snapshot names (in this dir) referencing it
     referenced: Dict[str, set] = {}
-    origins_of: Dict[str, set] = {}
-    for name in snapshots:
-        full = os.path.join(dirpath, name)
-        meta = _load_metadata(full)
-        origins = set()
-        for entry in meta.manifest.values():
-            for _, _, _, _, origin in _entry_payloads(entry):
-                if origin is not None:
-                    origins.add(origin)
-        origins_of[name] = origins
+    for name, origins in origins_of.items():
         for origin in origins:
             referenced.setdefault(_canon_snapshot_url(origin), set()).add(name)
 
@@ -479,6 +470,104 @@ def cmd_deps(args: argparse.Namespace) -> int:
         "safe to delete (no dependents here): "
         + (", ".join(safe) if safe else "none")
     )
+    return 0
+
+
+def _scan_snapshot_dir(dirpath: str):
+    """(snapshots sorted by mtime asc, {name: origin set}) for a directory."""
+    import os
+
+    names = sorted(
+        (
+            name
+            for name in os.listdir(dirpath)
+            if os.path.isfile(os.path.join(dirpath, name, ".snapshot_metadata"))
+        ),
+        # Name tiebreaker: mtime granularity can collide (1s filesystems,
+        # rsync-flattened trees); retention decisions must be deterministic.
+        key=lambda n: (
+            os.path.getmtime(os.path.join(dirpath, n, ".snapshot_metadata")),
+            n,
+        ),
+    )
+    origins_of = {}
+    for name in names:
+        meta = _load_metadata(os.path.join(dirpath, name))
+        origins = set()
+        for entry in meta.manifest.values():
+            for _, _, _, _, origin in _entry_payloads(entry):
+                if origin is not None:
+                    origins.add(origin)
+        origins_of[name] = origins
+    return names, origins_of
+
+
+def cmd_prune(args: argparse.Namespace) -> int:
+    import os
+    import shutil
+
+    if "://" in args.dir and not args.dir.startswith("fs://"):
+        print("error: prune operates on local filesystem directories only",
+              file=sys.stderr)
+        return 2
+    dirpath = args.dir[len("fs://"):] if args.dir.startswith("fs://") else args.dir
+    names, origins_of = _scan_snapshot_dir(dirpath)
+    if not names:
+        print(f"no snapshots found under {dirpath}")
+        return 2
+    if args.keep < 1:
+        print("error: --keep must be >= 1", file=sys.stderr)
+        return 2
+
+    keep = set(names[-args.keep:])  # newest N by metadata mtime
+    canon_of = {
+        name: _canon_snapshot_url(os.path.join(dirpath, name)) for name in names
+    }
+    name_of_canon = {c: n for n, c in canon_of.items()}
+    # Every surviving snapshot's restore closure must survive. Origins name
+    # each payload's physical writer directly, but a SPARED base's own
+    # payloads can reference yet another snapshot the kept set never
+    # mentions — so the required set is a transitive closure via a
+    # worklist, not one pass over the kept snapshots.
+    required = set()
+    frontier = list(keep)
+    visited = set()
+    while frontier:
+        name = frontier.pop()
+        if name in visited:
+            continue
+        visited.add(name)
+        for origin in origins_of.get(name, ()):
+            canon = _canon_snapshot_url(origin)
+            required.add(canon)
+            base_name = name_of_canon.get(canon)
+            if base_name is not None and base_name not in visited:
+                frontier.append(base_name)
+    spared, doomed = [], []
+    for name in names:
+        if name in keep:
+            continue
+        if canon_of[name] in required:
+            spared.append(name)
+        else:
+            doomed.append(name)
+
+    for name in sorted(keep):
+        print(f"keep    {name}")
+    for name in spared:
+        print(f"keep    {name}  (base of a kept snapshot)")
+    for name in doomed:
+        print(f"delete  {name}")
+    if not doomed:
+        print("nothing to prune")
+        return 0
+    if not args.yes:
+        print(f"dry run: would delete {len(doomed)} snapshot(s); "
+              "re-run with --yes to execute")
+        return 0
+    for name in doomed:
+        shutil.rmtree(os.path.join(dirpath, name))
+    print(f"deleted {len(doomed)} snapshot(s)")
     return 0
 
 
@@ -547,6 +636,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("dir")
     p.set_defaults(fn=cmd_deps)
+
+    p = sub.add_parser(
+        "prune",
+        help="keep the newest N snapshots (and bases they require); "
+             "delete the rest",
+    )
+    p.add_argument("dir")
+    p.add_argument("--keep", type=int, required=True,
+                   help="number of newest snapshots to keep")
+    p.add_argument("--yes", action="store_true",
+                   help="actually delete (default: print the plan)")
+    p.set_defaults(fn=cmd_prune)
     return parser
 
 
