@@ -23,3 +23,17 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _default_write_batching_off(monkeypatch):
+    """Most tests depend on the default per-payload file layout (payload
+    names, deterministic dedup locations, corrupt-one-file helpers) —
+    slab batching changes all of that by design. Pin it off suite-wide so
+    an ambient TORCHSNAPSHOT_TPU_ENABLE_BATCHING=1 can't change test
+    semantics; batching tests opt back in with monkeypatch.setenv (their
+    in-test setenv runs after this autouse fixture)."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "0")
