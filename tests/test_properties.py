@@ -153,3 +153,76 @@ def test_snapshot_roundtrip_fuzz(state, tmp_path_factory) -> None:
     Snapshot(str(tmp / "s")).restore({"m": dst})
     ok, msg = tree_eq(dst["s"], state)
     assert ok, msg
+
+
+# ---------------------------------------------------------------- incremental
+
+_inc_array_names = ["a", "b", "c", "d", "e"]
+
+
+@given(
+    mutations=st.lists(
+        st.sets(st.sampled_from(_inc_array_names)), min_size=1, max_size=4
+    ),
+    data=st.data(),
+)
+@settings(
+    max_examples=20,
+    deadline=None,
+    suppress_health_check=[HealthCheck.function_scoped_fixture],
+)
+def test_incremental_chain_random_mutations(tmp_path_factory, mutations, data):
+    """Fuzz an incremental chain: each link mutates a random subset of
+    arrays. Every link must (a) physically store exactly the mutated
+    payloads, (b) reference everything else in an ancestor, and (c)
+    restore bit-exactly to its oracle state."""
+    import os
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    root = tmp_path_factory.mktemp("inc_chain")
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+
+    state = {
+        name: rng.standard_normal((16, 4)).astype(np.float32)
+        for name in _inc_array_names
+    }
+    oracles = []
+    paths = []
+
+    prev = None
+    for i, mutated in enumerate([set(_inc_array_names)] + list(mutations)):
+        for name in mutated:
+            state[name] = state[name] + rng.standard_normal((16, 4)).astype(
+                np.float32
+            )
+        path = str(root / f"link_{i}")
+        Snapshot.take(
+            path,
+            {"app": StateDict(**{k: v.copy() for k, v in state.items()})},
+            incremental_base=prev,
+            record_digests=True,
+        )
+        oracles.append({k: v.copy() for k, v in state.items()})
+        paths.append(path)
+        prev = path
+
+        written = {
+            f
+            for r, _, fs in os.walk(path)
+            for f in fs
+            if f != ".snapshot_metadata"
+        }
+        for name in _inc_array_names:
+            has_file = any(f.startswith(f"{name}_") for f in written)
+            assert has_file == (name in mutated or i == 0), (
+                i, name, mutated, written,
+            )
+
+    for path, oracle in zip(paths, oracles):
+        dst = StateDict(
+            **{k: np.zeros((16, 4), np.float32) for k in _inc_array_names}
+        )
+        Snapshot(path).restore({"app": dst})
+        for name in _inc_array_names:
+            np.testing.assert_array_equal(dst[name], oracle[name])
