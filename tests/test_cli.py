@@ -59,7 +59,7 @@ def test_cat_array_and_primitive(snap_path, capsys):
 def test_verify_clean_and_corrupted(snap_path, capsys):
     assert main(["verify", snap_path]) == 0
     out = capsys.readouterr().out
-    assert "0 failed" in out
+    assert ", 0 failed" in out
 
     # Flip one byte of a payload: verify must fail with nonzero exit.
     target = None
