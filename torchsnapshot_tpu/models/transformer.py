@@ -143,16 +143,18 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
 
 def _flash_mesh_ok(cfg: TransformerConfig, mesh: Mesh, B: int, S: int) -> bool:
     """Preconditions for routing attention through the shard_mapped flash
-    kernel under a mesh: heads divide the 'model' axis, batch divides the
-    'data' axis, and S has a kernel-viable tile divisor (the kernel picks
-    its own 512-target tiling, so the gate must agree with that pick)."""
+    kernel under a mesh: heads divide the 'model' axis when one exists,
+    batch divides the 'data' axis, and S (the kernel's local sequence
+    length — pass S_local for ring-flash) has a kernel-viable tile
+    divisor (the kernel picks its own 512-target tiling, so the gate must
+    agree with that pick). Shared by the flash and ring-flash routes."""
     from ..ops.attention import pick_block_size
 
-    if "model" not in mesh.axis_names or cfg.n_heads % mesh.shape["model"]:
+    if "model" in mesh.axis_names and cfg.n_heads % mesh.shape["model"]:
         return False
     if "data" in mesh.axis_names and B % mesh.shape["data"]:
         return False
-    return pick_block_size(S, 512) is not None
+    return S > 0 and pick_block_size(S, 512) is not None
 
 
 def forward(
@@ -257,6 +259,20 @@ def forward(
                 return zigzag_ring_attention_sharded(
                     q, k, v, mesh, in_layout=zz_hoist
                 )
+            if c.attn_impl == "ring" and jax.default_backend() == "tpu":
+                # The ring's inner compute dominates long-context cost;
+                # run it through the Pallas flash kernel when the LOCAL
+                # shard satisfies the same preconditions as the non-ring
+                # flash path.
+                ring_size = mesh.shape["seq"]
+                if S % ring_size == 0 and _flash_mesh_ok(
+                    c, mesh, B, S // ring_size
+                ):
+                    from ..ops.ring_flash import ring_flash_attention_sharded
+
+                    return ring_flash_attention_sharded(
+                        q, k, v, mesh, causal=True
+                    )
             from ..ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(q, k, v, mesh, causal=True)

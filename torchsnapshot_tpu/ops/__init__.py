@@ -12,6 +12,8 @@ state to snapshot:
 - ring attention (K/V rotating on the ICI ring via ``ppermute``) and its
   causally load-balanced zigzag variant; Ulysses all-to-all sequence
   parallelism;
+- ring-flash attention: the Pallas kernel as the ring's inner compute,
+  hops merged by log-sum-exp under one custom VJP;
 - GShard-style top-2 MoE with einsum and sort-based dispatch, and an
   explicit all-to-all expert-parallel path;
 - selective-SSM sequence mixing via associative scan, with a
@@ -27,6 +29,7 @@ from .ring_attention import (
     zigzag_ring_attention_sharded,
     zigzag_ring_self_attention,
 )
+from .ring_flash import ring_flash_attention_sharded, ring_flash_self_attention
 from .ssm import ssm_mix, ssm_mix_sharded, ssm_scan, ssm_scan_sharded
 from .ulysses import ulysses_attention_sharded, ulysses_self_attention
 
@@ -38,6 +41,8 @@ __all__ = [
     "moe_ffn",
     "moe_ffn_sharded",
     "ring_attention_sharded",
+    "ring_flash_attention_sharded",
+    "ring_flash_self_attention",
     "ring_self_attention",
     "ssm_mix",
     "ssm_mix_sharded",

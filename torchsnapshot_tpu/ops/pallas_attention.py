@@ -211,7 +211,14 @@ def _shape(shape, dtype, like):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(causal, scale, block_q, block_k, interpret):
+def _make_flash_parts(causal, scale, block_q, block_k, interpret):
+    """Raw (fwd_impl, bwd_impl) on (BH, S, D) operands.
+
+    ``fwd_impl`` returns (normalized o, lse); ``bwd_impl`` consumes the
+    GLOBAL lse/delta, which is what lets ring attention drive these same
+    kernels per hop and still produce exact gradients (FA2 math: p =
+    exp(s - lse_global) is correct for any subset of keys).
+    """
     from jax.experimental import pallas as pl
 
     def kern_opts(D, S):
@@ -290,6 +297,15 @@ def _make_flash(causal, scale, block_q, block_k, interpret):
             interpret=interpret,
         )(q, k, v, g, lse, delta)
         return dq, dk, dv
+
+    return fwd_impl, bwd_impl
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(causal, scale, block_q, block_k, interpret):
+    fwd_impl, bwd_impl = _make_flash_parts(
+        causal, scale, block_q, block_k, interpret
+    )
 
     @jax.custom_vjp
     def flash(q, k, v):
