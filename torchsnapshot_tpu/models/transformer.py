@@ -254,6 +254,20 @@ def forward(
                     inner_block_size=c.attn_block_size,
                 )
             if c.attn_impl == "zigzag":
+                ring_size = mesh.shape["seq"]
+                # Half-shard length is the zigzag kernels' tile unit.
+                if (
+                    jax.default_backend() == "tpu"
+                    and S % (2 * ring_size) == 0
+                    and _flash_mesh_ok(c, mesh, B, S // (2 * ring_size))
+                ):
+                    from ..ops.ring_flash import (
+                        zigzag_ring_flash_attention_sharded,
+                    )
+
+                    return zigzag_ring_flash_attention_sharded(
+                        q, k, v, mesh, in_layout=zz_hoist
+                    )
                 from ..ops.ring_attention import zigzag_ring_attention_sharded
 
                 return zigzag_ring_attention_sharded(

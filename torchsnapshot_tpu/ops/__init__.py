@@ -12,8 +12,9 @@ state to snapshot:
 - ring attention (K/V rotating on the ICI ring via ``ppermute``) and its
   causally load-balanced zigzag variant; Ulysses all-to-all sequence
   parallelism;
-- ring-flash attention: the Pallas kernel as the ring's inner compute,
-  hops merged by log-sum-exp under one custom VJP;
+- ring-flash and zigzag-flash attention: the Pallas kernel as the ring's
+  inner compute (zigzag keeps the causal load balance with two half-block
+  kernels per hop), hops merged by log-sum-exp under one custom VJP;
 - GShard-style top-2 MoE with einsum and sort-based dispatch, and an
   explicit all-to-all expert-parallel path;
 - selective-SSM sequence mixing via associative scan, with a
@@ -29,7 +30,12 @@ from .ring_attention import (
     zigzag_ring_attention_sharded,
     zigzag_ring_self_attention,
 )
-from .ring_flash import ring_flash_attention_sharded, ring_flash_self_attention
+from .ring_flash import (
+    ring_flash_attention_sharded,
+    ring_flash_self_attention,
+    zigzag_ring_flash_attention_sharded,
+    zigzag_ring_flash_self_attention,
+)
 from .ssm import ssm_mix, ssm_mix_sharded, ssm_scan, ssm_scan_sharded
 from .ulysses import ulysses_attention_sharded, ulysses_self_attention
 
@@ -51,5 +57,7 @@ __all__ = [
     "ulysses_attention_sharded",
     "ulysses_self_attention",
     "zigzag_ring_attention_sharded",
+    "zigzag_ring_flash_attention_sharded",
+    "zigzag_ring_flash_self_attention",
     "zigzag_ring_self_attention",
 ]

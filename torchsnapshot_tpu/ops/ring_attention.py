@@ -218,6 +218,43 @@ def zigzag_layout_indices(seq_len: int, ring: int) -> "jnp.ndarray":
     return idx
 
 
+def _zigzag_sharded(
+    body_fn,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    seq_axis: str,
+    batch_axis: Optional[str],
+    head_axis: Optional[str],
+    in_layout: bool,
+    check_vma: bool = True,
+) -> jax.Array:
+    """Shared zigzag shard_map wrapper: the layout permute contract lives
+    here ONCE for both the pure-JAX and flash-kernel zigzag bodies."""
+    axes = set(mesh.axis_names)
+    if seq_axis not in axes:
+        raise ValueError(f"mesh {mesh.axis_names} lacks seq axis {seq_axis!r}")
+    ring = mesh.shape[seq_axis]
+    b = batch_axis if batch_axis in axes else None
+    h = head_axis if head_axis in axes else None
+    spec = P(b, seq_axis, h, None)
+    if not in_layout:
+        idx = zigzag_layout_indices(q.shape[1], ring)
+        inv = jnp.argsort(idx)
+        q, k, v = (jnp.take(x, idx, axis=1) for x in (q, k, v))
+    out = jax.shard_map(
+        body_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=check_vma,
+    )(q, k, v)
+    if not in_layout:
+        out = jnp.take(out, inv, axis=1)
+    return out
+
+
 def zigzag_ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -241,24 +278,10 @@ def zigzag_ring_attention_sharded(
     (see models/transformer.py, which permutes once after the position
     encoding and inverts once at the logits).
     """
-    axes = set(mesh.axis_names)
-    if seq_axis not in axes:
-        raise ValueError(f"mesh {mesh.axis_names} lacks seq axis {seq_axis!r}")
-    ring = mesh.shape[seq_axis]
-    b = batch_axis if batch_axis in axes else None
-    h = head_axis if head_axis in axes else None
-    spec = P(b, seq_axis, h, None)
     fn = partial(zigzag_ring_self_attention, axis_name=seq_axis, scale=scale)
-    if not in_layout:
-        idx = zigzag_layout_indices(q.shape[1], ring)
-        inv = jnp.argsort(idx)
-        q, k, v = (jnp.take(x, idx, axis=1) for x in (q, k, v))
-    out = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )(q, k, v)
-    if not in_layout:
-        out = jnp.take(out, inv, axis=1)
-    return out
+    return _zigzag_sharded(
+        fn, q, k, v, mesh, seq_axis, batch_axis, head_axis, in_layout
+    )
 
 
 def ring_attention_sharded(
