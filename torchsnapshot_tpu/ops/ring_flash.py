@@ -231,8 +231,12 @@ def _make_zigzag_flash(axis_name, scale, block_q, block_k, interpret):
         BH, S_loc, D = q.shape
         half = S_loc // 2
         q_lo, q_hi = q[:, :half], q[:, half:]
-        o0 = jnp.zeros((BH, half, D), jnp.float32)
-        l0 = jnp.full((BH, half, 1), NEG_INF, jnp.float32)
+        # Scan carries must hold a stable vma type: fresh zeros are
+        # replicated while kernel outputs vary over the ring axis, so
+        # promote the inits (the TPU vma checker rejects the mismatch;
+        # interpret mode does not — see tests' check_vma note).
+        o0 = _varying(jnp.zeros((BH, half, D), jnp.float32), axis_name)
+        l0 = _varying(jnp.full((BH, half, 1), NEG_INF, jnp.float32), axis_name)
 
         def hop(carry, s):
             o_lo, l_lo, o_hi, l_hi, k_cur, v_cur = carry
@@ -305,7 +309,9 @@ def _make_zigzag_flash(axis_name, scale, block_q, block_k, interpret):
         g_lo, g_hi = g[:, :half], g[:, half:]
         lse_lo, lse_hi = lse[:, :half], lse[:, half:]
         d_lo, d_hi = delta[:, :half], delta[:, half:]
-        zero = jnp.zeros((BH, half, D), jnp.float32)
+        # Varying like the kernel outputs: used both as scan-carry inits
+        # and inside lax.switch branches, where all branches must agree.
+        zero = _varying(jnp.zeros((BH, half, D), jnp.float32), axis_name)
 
         def hop(carry, s):
             dq_lo, dq_hi, k_cur, v_cur, dk_cur, dv_cur = carry
