@@ -89,11 +89,30 @@ def main() -> None:
         lambda q, k, v: flash_attention(q, k, v, causal=True),
     )
 
+    # Ring-flash on a 1-device ring: measures the ring harness overhead
+    # (shard_map + custom VJP + lse merge) over the bare kernel — on a
+    # multi-chip mesh the same code path adds only the ppermute hops.
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from torchsnapshot_tpu.ops import ring_flash_attention_sharded
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("seq",))
+    t_ring = bench(
+        "ring_flash(ring=1)",
+        lambda q, k, v: ring_flash_attention_sharded(q, k, v, mesh1, causal=True),
+    )
+
     # Causal attention FLOPs (fwd 2 matmuls + bwd 5) ≈ 3.5 * 4 * B*H*S^2*D / 2.
     flops = 3.5 * 2 * B * H * S * S * D
     cb = max(t_block - overhead, 1e-9)
     cf = max(t_flash - overhead, 1e-9)
-    for name, t, c in (("blockwise", t_block, cb), ("flash", t_flash, cf)):
+    cr = max(t_ring - overhead, 1e-9)
+    for name, t, c in (
+        ("blockwise", t_block, cb),
+        ("flash", t_flash, cf),
+        ("ring_flash", t_ring, cr),
+    ):
         report(
             f"attention_fwdbwd_{name}",
             {

@@ -58,6 +58,24 @@ def main() -> None:
         Snapshot(f"{tmp}/tsnap").restore({"m": dst})
         results["tsnap_restore"] = time.perf_counter() - t0
 
+        # --- torchsnapshot_tpu incremental (no orbax counterpart) -------
+        # The frozen-backbone pattern: second save where only 1/16 of the
+        # state changed. Orbax rewrites everything every save; this is the
+        # capability gap the dedup layer exists for.
+        Snapshot.take(
+            f"{tmp}/tsnap_base", {"m": StateDict(**state)}, record_digests=True
+        )
+        state_inc = dict(state)
+        state_inc["param_0"] = state["param_0"] + jnp.bfloat16(1.0)
+        jax.block_until_ready(state_inc["param_0"])
+        t0 = time.perf_counter()
+        Snapshot.take(
+            f"{tmp}/tsnap_inc",
+            {"m": StateDict(**state_inc)},
+            incremental_base=f"{tmp}/tsnap_base",
+        )
+        results["tsnapincr_save"] = time.perf_counter() - t0
+
         # --- orbax ------------------------------------------------------
         import orbax.checkpoint as ocp
 
@@ -70,7 +88,7 @@ def main() -> None:
             restored = ckptr.restore(f"{tmp}/orbax")
             results["orbax_restore"] = time.perf_counter() - t0
 
-        # sanity: both restored trees bit-match the source, every array
+        # sanity: every reported save restores bit-exactly
         import numpy as np
 
         for k, src in state.items():
@@ -78,11 +96,17 @@ def main() -> None:
             np.testing.assert_array_equal(np.asarray(dst[k], np.float32), ref)
             np.testing.assert_array_equal(np.asarray(restored[k], np.float32), ref)
 
+        inc_dst = StateDict(**{k: jnp.zeros_like(v) for k, v in state_inc.items()})
+        Snapshot(f"{tmp}/tsnap_inc").restore({"m": inc_dst})
+        for k, src in state_inc.items():
+            np.testing.assert_array_equal(
+                np.asarray(inc_dst[k], np.float32), np.asarray(src, np.float32)
+            )
+
         for name, dt in results.items():
             lib, direction = name.split("_")
-            other = results.get(
-                ("orbax" if lib == "tsnap" else "tsnap") + "_" + direction
-            )
+            other_lib = "orbax" if lib.startswith("tsnap") else "tsnap"
+            other = results.get(f"{other_lib}_{direction}")
             report(
                 f"vs_orbax_{name}",
                 {
