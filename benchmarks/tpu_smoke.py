@@ -1,0 +1,141 @@
+"""Real-TPU hardware smoke: compile + run + finite-grad every op family.
+
+The test suite forces a virtual CPU mesh (tests/conftest.py), and Pallas
+interpret mode plus CPU lowering hide real-TPU type/lowering issues (a
+vma mismatch in ring-flash's scan carries was only catchable on the
+chip). This script validates the hardware paths in a few minutes:
+
+- transformer forward+grad through the auto -> flash kernel route;
+- flash / ring-flash / zigzag-flash vs the dense oracle (bf16);
+- SSM LM forward+grad (associative-scan mixing);
+- MoE einsum and sort dispatch paths (values must agree);
+- a Snapshot round-trip of device arrays.
+
+Run on a machine with a TPU: ``python benchmarks/tpu_smoke.py``.
+Exits nonzero on any failure; prints one OK line per family.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print(f"not a TPU backend ({jax.default_backend()}); nothing to smoke")
+        return 2
+
+    # --- attention kernels vs dense oracle (bf16) ----------------------
+    from jax.sharding import Mesh
+
+    from torchsnapshot_tpu.ops import (
+        dense_attention,
+        flash_attention,
+        ring_flash_attention_sharded,
+        zigzag_ring_flash_attention_sharded,
+    )
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (2, 512, 4, 64), jnp.bfloat16) for kk in ks
+    )
+    ref = dense_attention(q, k, v, causal=True).astype(jnp.float32)
+    # All local devices: on a multi-chip host the ring actually rotates
+    # K/V over ICI ppermute (S=512 divides 2/4/8-way rings); a single
+    # chip still validates kernels + shard_map + custom VJP lowering.
+    mesh1 = Mesh(np.array(jax.devices()), ("seq",))
+    for name, fn in (
+        ("flash", lambda q, k, v: flash_attention(q, k, v, causal=True)),
+        ("ring_flash", lambda q, k, v: ring_flash_attention_sharded(q, k, v, mesh1)),
+        ("zigzag_flash",
+         lambda q, k, v: zigzag_ring_flash_attention_sharded(q, k, v, mesh1)),
+    ):
+        out = fn(q, k, v).astype(jnp.float32)
+        err = float(jnp.max(jnp.abs(out - ref)))
+        assert err < 0.05, (name, err)
+        grads = jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for gname, g in zip("qkv", grads):
+            assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))), (name, gname)
+        print(f"OK attention/{name} (max_err {err:.4f})")
+
+    # --- transformer auto route ----------------------------------------
+    from torchsnapshot_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab_size=1024, d_model=256, n_heads=4, n_layers=2, d_ff=512,
+        max_seq_len=512,
+    )
+    params = T.init_params(jax.random.PRNGKey(1), cfg)
+    tokens = jnp.ones((2, 512), jnp.int32)
+    loss, grads = jax.jit(
+        jax.value_and_grad(
+            lambda p: jnp.mean(T.forward(p, tokens, cfg).astype(jnp.float32) ** 2)
+        )
+    )(params)
+    assert all(
+        bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+        for x in jax.tree.leaves(grads)
+    )
+    print(f"OK transformer/auto->flash (loss {float(loss):.4f})")
+
+    # --- SSM LM ---------------------------------------------------------
+    from torchsnapshot_tpu.models import ssm_lm as M
+
+    scfg = M.SSMConfig(vocab_size=512, d_model=128, d_state=8, n_layers=2, d_ff=256)
+    sp = M.init_params(jax.random.PRNGKey(2), scfg)
+    stoks = jnp.ones((2, 256), jnp.int32)
+
+    def sloss(p):
+        return jnp.mean(M.forward(p, stoks, scfg).astype(jnp.float32) ** 2)
+
+    sl, sg = jax.jit(jax.value_and_grad(sloss))(sp)
+    assert all(
+        bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+        for x in jax.tree.leaves(sg)
+    )
+    print(f"OK ssm_lm (loss {float(sl):.4f})")
+
+    # --- MoE dispatch paths agree ---------------------------------------
+    from torchsnapshot_tpu.ops import moe_ffn
+    from torchsnapshot_tpu.ops.moe import init_moe_params
+
+    mp = init_moe_params(jax.random.PRNGKey(3), d_model=128, d_ff=256, n_experts=4)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 64, 128), jnp.float32)
+    outs = {}
+    for dispatch in ("einsum", "sort"):
+        y, aux = jax.jit(
+            lambda mp, dispatch=dispatch: moe_ffn(mp, x, dispatch=dispatch)
+        )(mp)
+        outs[dispatch] = np.asarray(y)
+    np.testing.assert_allclose(outs["einsum"], outs["sort"], atol=1e-5)
+    print("OK moe (einsum == sort dispatch)")
+
+    # --- snapshot round-trip of device arrays ---------------------------
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    with tempfile.TemporaryDirectory() as d:
+        w = jax.random.normal(jax.random.PRNGKey(5), (256, 256), jnp.bfloat16)
+        Snapshot.take(f"{d}/s", {"app": StateDict(w=w)})
+        dst = StateDict(w=jnp.zeros((256, 256), jnp.bfloat16))
+        Snapshot(f"{d}/s").restore({"app": dst})
+        np.testing.assert_array_equal(
+            np.asarray(dst["w"], np.float32), np.asarray(w, np.float32)
+        )
+    print("OK snapshot round-trip (device arrays)")
+    print("TPU SMOKE: ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
