@@ -10,7 +10,9 @@ Runs on an 8-device virtual CPU mesh (no TPU pod needed):
 3. "Elastic resume": rebuild the model on a DIFFERENT mesh layout and
    restore the same snapshot into it — overlap resharding handles the
    layout change.
-4. Bonus: run a GPipe pipeline-parallel train step on a ('data','pipe')
+4. Production checkpoint config: async + incremental + mirrored saves
+   composed (an unchanged re-save writes zero payloads).
+5. Bonus: run a GPipe pipeline-parallel train step on a ('data','pipe')
    mesh (see parallel/pipeline.py).
 
 Usage: python examples/parallel_training.py
@@ -92,7 +94,53 @@ def main() -> None:
     resumed, loss = step2(resumed, b)
     print(f"post-resume step {int(resumed['step'])}: loss {float(loss):.4f}")
 
-    # ---- 4. pipeline parallelism -----------------------------------------
+    # ---- 4. production checkpoint config ---------------------------------
+    # Periodic saves compose: async (no training stall past staging) +
+    # incremental (unchanged payloads referenced, not rewritten) + a
+    # durable mirror tier (fast local primary, background replica).
+    prod_opts = {"mirror_url": f"{tmp}/durable_0"}
+    Snapshot.take(
+        f"{tmp}/prod_0", {"train": StateDict(state=resumed)},
+        storage_options=prod_opts, record_digests=True,
+    )
+    # A re-save against the base writes only what changed — nothing has
+    # trained since prod_0, so ZERO payloads hit storage here (a full
+    # optimizer step touches every tensor; examples/lora_incremental.py
+    # shows the frozen-backbone case where the win persists through
+    # training).
+    pending = Snapshot.async_take(
+        f"{tmp}/prod_1", {"train": StateDict(state=resumed)},
+        storage_options={"mirror_url": f"{tmp}/durable_1"},
+        incremental_base=f"{tmp}/prod_0",
+    )
+    resumed, loss = step2(resumed, b)  # keeps training during I/O
+    pending.wait()
+    def payload_count(root):
+        return sum(
+            1 for _, _, files in os.walk(root)
+            for f in files if f != ".snapshot_metadata"
+        )
+
+    print(
+        f"incremental+mirrored snapshot committed: "
+        f"{payload_count(f'{tmp}/prod_1')} of {payload_count(f'{tmp}/prod_0')} "
+        "payloads rewritten (unchanged ones reference prod_0)"
+    )
+    # CAVEAT: an incremental snapshot's deduplicated payloads reference
+    # the PRIMARY base (prod_0) — the mirror tier alone is not enough to
+    # survive losing this machine. For off-machine durability of an
+    # incremental chain, consolidate it into a self-contained snapshot:
+    from torchsnapshot_tpu.dedup import consolidate
+
+    consolidate(f"{tmp}/prod_1", f"{tmp}/durable_standalone")
+    dst2 = {"train": StateDict(state=T.init_state(jax.random.PRNGKey(3), cfg2, tx, mesh=mesh2))}
+    Snapshot(f"{tmp}/durable_standalone").restore(dst2)
+    print(
+        "consolidated standalone replica restores at step "
+        f"{int(dst2['train']['state']['step'])} (no bases required)"
+    )
+
+    # ---- 5. pipeline parallelism -----------------------------------------
     from torchsnapshot_tpu.parallel import pipeline_param_sharding, pipelined_apply
 
     pmesh = make_mesh({"data": 2, "pipe": 4})

@@ -26,6 +26,10 @@ Semantics:
   mirror metadata, every rank's payload mirrors have landed.
 - ``read``: primary first; falls back to the mirror when the primary
   lost the payload (e.g. local disk wiped between save and restore).
+- Incremental caveat: a deduplicated payload's ``origin`` names the base
+  snapshot's PRIMARY, so the mirror of an INCREMENTAL snapshot is not
+  independently durable against machine loss — consolidate the chain
+  onto the durable tier for that (see docs/storage.rst).
 - Mirror failures do not fail the snapshot (the primary committed); they
   are logged and raised at ``close()`` on the failing rank unless
   ``storage_options={"mirror_strict": False}``. A failing rank's error
