@@ -126,19 +126,25 @@ def main() -> None:
         f"{payload_count(f'{tmp}/prod_1')} of {payload_count(f'{tmp}/prod_0')} "
         "payloads rewritten (unchanged ones reference prod_0)"
     )
-    # CAVEAT: an incremental snapshot's deduplicated payloads reference
-    # the PRIMARY base (prod_0) — the mirror tier alone is not enough to
-    # survive losing this machine. For off-machine durability of an
-    # incremental chain, consolidate it into a self-contained snapshot:
+    # Disaster recovery: deduplicated payloads record each base's MIRROR
+    # in the metadata, so the durable tier alone restores the whole chain
+    # even after every fast/primary tier is gone.
+    import shutil
+
+    shutil.rmtree(f"{tmp}/prod_0")
+    shutil.rmtree(f"{tmp}/prod_1")
+    dst2 = {"train": StateDict(state=T.init_state(jax.random.PRNGKey(3), cfg2, tx, mesh=mesh2))}
+    Snapshot(f"{tmp}/durable_1").restore(dst2)
+    print(
+        "primaries wiped; durable tier restores the chain at step "
+        f"{int(dst2['train']['state']['step'])} "
+        "(deduped payloads read from durable_0 via origin_mirrors)"
+    )
+    # To retire a chain into one self-contained artifact:
     from torchsnapshot_tpu.dedup import consolidate
 
-    consolidate(f"{tmp}/prod_1", f"{tmp}/durable_standalone")
-    dst2 = {"train": StateDict(state=T.init_state(jax.random.PRNGKey(3), cfg2, tx, mesh=mesh2))}
-    Snapshot(f"{tmp}/durable_standalone").restore(dst2)
-    print(
-        "consolidated standalone replica restores at step "
-        f"{int(dst2['train']['state']['step'])} (no bases required)"
-    )
+    consolidate(f"{tmp}/durable_1", f"{tmp}/durable_standalone")
+    print("consolidated standalone replica written (no bases required)")
 
     # ---- 5. pipeline parallelism -----------------------------------------
     from torchsnapshot_tpu.parallel import pipeline_param_sharding, pipelined_apply

@@ -192,6 +192,88 @@ def test_incremental_take_with_mirror_strips_mirror_for_base(tmp_path):
     np.testing.assert_array_equal(dst["w"], np.full((64, 32), 1.0, np.float32))
 
 
+def test_incremental_mirror_survives_total_primary_loss(tmp_path):
+    """Machine-loss disaster recovery for an incremental chain: every
+    snapshot records its mirror in metadata and propagates origin->mirror
+    mappings, so restoring from an incremental's MIRROR falls back to the
+    base's MIRROR for deduplicated payloads after BOTH primaries are
+    gone."""
+    import shutil
+
+    base_p, base_m = str(tmp_path / "b_fast"), str(tmp_path / "b_durable")
+    inc_p, inc_m = str(tmp_path / "i_fast"), str(tmp_path / "i_durable")
+    Snapshot.take(base_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": base_m}, record_digests=True)
+    Snapshot.take(inc_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": inc_m},
+                  incremental_base=base_p)
+
+    meta = Snapshot(inc_m).metadata
+    assert meta.origin_mirrors, "origin->mirror mapping must be recorded"
+    from torchsnapshot_tpu.dedup import canonical_base_url
+
+    assert meta.origin_mirrors.get(canonical_base_url(base_p)) == canonical_base_url(base_m)
+
+    # the machine dies: both fast tiers are gone
+    shutil.rmtree(base_p)
+    shutil.rmtree(inc_p)
+
+    dst = _state(0.0)
+    Snapshot(inc_m).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], np.full((64, 32), 1.0, np.float32))
+    np.testing.assert_array_equal(dst["nested"]["b"], np.full((16,), 1.0, np.float32))
+
+
+def test_chained_origin_mirrors_propagate(tmp_path):
+    """A -> B -> C chain, all mirrored: C's metadata carries A's mirror
+    mapping (payloads written once in A are referenced directly), so C's
+    mirror restores after every primary is gone."""
+    import shutil
+
+    paths = {}
+    for name in "abc":
+        paths[name] = (str(tmp_path / f"{name}_fast"), str(tmp_path / f"{name}_dur"))
+
+    def chain_state(head_val):
+        # frozen backbone identical across the chain; head trains
+        return StateDict(
+            frozen=np.arange(512, dtype=np.float32).reshape(32, 16),
+            head=np.full((8,), float(head_val), np.float32),
+            step=int(head_val),
+        )
+
+    Snapshot.take(paths["a"][0], {"app": chain_state(1)},
+                  storage_options={"mirror_url": paths["a"][1]},
+                  record_digests=True)
+    Snapshot.take(paths["b"][0], {"app": chain_state(2)},
+                  storage_options={"mirror_url": paths["b"][1]},
+                  incremental_base=paths["a"][0])
+    Snapshot.take(paths["c"][0], {"app": chain_state(3)},
+                  storage_options={"mirror_url": paths["c"][1]},
+                  incremental_base=paths["b"][0])
+
+    from torchsnapshot_tpu.dedup import canonical_base_url
+
+    meta_c = Snapshot(paths["c"][1]).metadata
+    assert canonical_base_url(paths["a"][0]) in (meta_c.origin_mirrors or {})
+
+    for name in "abc":
+        shutil.rmtree(paths[name][0])
+
+    dst = StateDict(
+        frozen=np.zeros((32, 16), np.float32),
+        head=np.zeros((8,), np.float32),
+        step=0,
+    )
+    Snapshot(paths["c"][1]).restore({"app": dst})
+    # frozen was written once, in A — read from A's MIRROR; head from C's
+    np.testing.assert_array_equal(
+        dst["frozen"], np.arange(512, dtype=np.float32).reshape(32, 16)
+    )
+    np.testing.assert_array_equal(dst["head"], np.full((8,), 3.0, np.float32))
+    assert dst["step"] == 3
+
+
 def _mirror_worker(rank, world_size, primary_dir, mirror_dir):
     import numpy as np
 
@@ -248,3 +330,57 @@ print("MIRROR-RANK-OK")
         )
         assert r.returncode == 0, r.stderr
         assert "MIRROR-RANK-OK" in r.stdout
+
+
+def test_consolidate_after_primary_loss(tmp_path):
+    """Consolidation reads origin payloads through the recorded mirrors,
+    so an incremental chain can be flattened into a standalone snapshot
+    even after every primary tier is gone."""
+    import shutil
+
+    from torchsnapshot_tpu.dedup import consolidate
+
+    base_p, base_m = str(tmp_path / "b_fast"), str(tmp_path / "b_dur")
+    inc_p, inc_m = str(tmp_path / "i_fast"), str(tmp_path / "i_dur")
+    Snapshot.take(base_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": base_m}, record_digests=True)
+    Snapshot.take(inc_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": inc_m},
+                  incremental_base=base_p)
+    shutil.rmtree(base_p)
+    shutil.rmtree(inc_p)
+
+    flat = str(tmp_path / "flat")
+    consolidate(inc_m, flat)
+    meta = Snapshot(flat).metadata
+    assert meta.origin_mirrors is None and meta.mirror_url is None
+
+    shutil.rmtree(base_m)  # standalone: no tier of the chain needed
+    dst = _state(0.0)
+    Snapshot(flat).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], np.full((64, 32), 1.0, np.float32))
+
+
+def test_cli_verify_and_info_follow_origin_mirrors(tmp_path, capsys):
+    import shutil
+
+    from torchsnapshot_tpu.cli import main
+
+    base_p, base_m = str(tmp_path / "b_fast"), str(tmp_path / "b_dur")
+    inc_p, inc_m = str(tmp_path / "i_fast"), str(tmp_path / "i_dur")
+    Snapshot.take(base_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": base_m}, record_digests=True)
+    Snapshot.take(inc_p, {"app": _state(1.0)},
+                  storage_options={"mirror_url": inc_m},
+                  incremental_base=base_p)
+
+    assert main(["info", inc_m]) == 0
+    out = capsys.readouterr().out
+    assert "restore\nsurvives" in out.replace("\n             ", "\n") or \
+        "survives loss" in out
+
+    # after total primary loss, verify still passes via the origin mirrors
+    shutil.rmtree(base_p)
+    shutil.rmtree(inc_p)
+    assert main(["verify", inc_m]) == 0
+    assert ", 0 failed" in capsys.readouterr().out

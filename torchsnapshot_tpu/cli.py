@@ -169,7 +169,12 @@ def cmd_info(args: argparse.Namespace) -> int:
         origins = sorted({o for _, _, o in external.values()})
         print(f"external:    {len(external)} payloads ({_fmt_bytes(ext_total)}) "
               f"referenced from base snapshot(s): {', '.join(origins)}")
-        print("             (bases must remain intact for restore)")
+        mirrored = meta.origin_mirrors or {}
+        if all(o in mirrored for o in origins):
+            print("             (every base's mirror is recorded: restore "
+                  "survives loss of the bases' primary tiers)")
+        else:
+            print("             (bases must remain intact for restore)")
     print(f"checksums:   {checksummed}/{len(payloads)} payloads")
     return 0
 
@@ -226,10 +231,18 @@ def cmd_verify(args: argparse.Namespace) -> int:
 
     event_loop = asyncio.new_event_loop()
     ok = skipped = failed = 0
+    origin_mirrors = meta.origin_mirrors or {}
     try:
         for origin, payloads in by_origin.items():
+            # Restore-equivalent semantics: origin payloads verify through
+            # the origin's recorded mirror fallback, so verify agrees with
+            # what restore can actually read (incl. after primary loss).
+            opts = None
+            mirror = origin_mirrors.get(origin) if origin is not None else None
+            if mirror:
+                opts = {"mirror_url": mirror}
             storage = url_to_storage_plugin_in_event_loop(
-                origin if origin is not None else args.path, event_loop
+                origin if origin is not None else args.path, event_loop, opts
             )
             where = f" [{origin}]" if origin is not None else ""
             try:

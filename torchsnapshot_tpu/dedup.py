@@ -234,9 +234,19 @@ def consolidate(
             dst_path, event_loop, storage_options
         )
     }
+    origin_mirrors = metadata.origin_mirrors or {}
     for origin in {org or src_path for org in locations.values()}:
+        opts = dict(storage_options or {})
+        # Origin sources read through the origin's OWN mirror (recorded
+        # at take time), so consolidation works even after a base's
+        # primary tier is lost — same fallback the restore path uses.
+        mirror = origin_mirrors.get(origin) or (
+            metadata.mirror_url if origin == src_path else None
+        )
+        if mirror and canonical_base_url(mirror) != canonical_base_url(origin):
+            opts["mirror_url"] = mirror
         plugins[origin] = url_to_storage_plugin_in_event_loop(
-            origin, event_loop, storage_options
+            origin, event_loop, opts or None
         )
 
     async def copy_all() -> None:
@@ -259,6 +269,9 @@ def consolidate(
                 p.origin = None
             if isinstance(entry, ObjectEntry):
                 entry.origin = None
+        # The consolidated snapshot is self-contained and single-tier.
+        metadata.origin_mirrors = None
+        metadata.mirror_url = None
         Snapshot._write_snapshot_metadata(metadata, plugins[None], event_loop)
     finally:
         for plugin in plugins.values():

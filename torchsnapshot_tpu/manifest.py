@@ -328,12 +328,19 @@ class SnapshotMetadata:
     version: str
     world_size: int
     manifest: Manifest
+    # Two-tier + incremental composition (omitted from YAML when unset):
+    # the mirror this snapshot replicated to, and — for incremental
+    # snapshots — each origin snapshot's mirror, so deduplicated payloads
+    # stay restorable from the durable tier after the origin's primary is
+    # lost (see storage_plugins/mirror.py).
+    mirror_url: Optional[str] = None
+    origin_mirrors: Optional[Dict[str, str]] = None
 
     def to_yaml(self) -> str:
         d = asdict(self)
-        # Incremental-snapshot fields are omitted while unset so that
-        # non-incremental snapshots keep their exact on-disk format (pinned
-        # by tests/test_manifest_golden.py); absent keys read back as None.
+        # Optional fields are omitted while unset so that snapshots not
+        # using them keep their exact on-disk format (pinned by
+        # tests/test_manifest_golden.py); absent keys read back as None.
         def strip(node: Any) -> None:
             if isinstance(node, dict):
                 for k in ("digest", "origin"):
@@ -346,6 +353,9 @@ class SnapshotMetadata:
                     strip(v)
 
         strip(d["manifest"])
+        for key in ("mirror_url", "origin_mirrors"):
+            if not d.get(key):
+                d.pop(key, None)
         return yaml.dump(d, sort_keys=False, Dumper=_Dumper)
 
     @classmethod
@@ -354,7 +364,13 @@ class SnapshotMetadata:
         manifest: Manifest = {
             path: entry_from_dict(entry) for path, entry in d["manifest"].items()
         }
-        return cls(version=d["version"], world_size=d["world_size"], manifest=manifest)
+        return cls(
+            version=d["version"],
+            world_size=d["world_size"],
+            manifest=manifest,
+            mirror_url=d.get("mirror_url"),
+            origin_mirrors=d.get("origin_mirrors"),
+        )
 
 
 def get_available_entries(manifest: Manifest, rank: int) -> Manifest:

@@ -312,6 +312,12 @@ class Snapshot:
                 "Disable batching for snapshots used in incremental chains.",
                 "TORCHSNAPSHOT_TPU_ENABLE_BATCHING",
             )
+        # This snapshot's own mirror, recorded in its metadata so future
+        # incrementals can point origin reads at the durable tier too.
+        own_mirror: Optional[str] = None
+        if storage_options and storage_options.get("mirror_url"):
+            own_mirror = canonical_base_url(storage_options["mirror_url"])
+        origin_mirrors: Dict[str, str] = {}
         if incremental_base is not None:
             from .storage_plugin import strip_mirror_options
 
@@ -326,6 +332,17 @@ class Snapshot:
                     "record_digests=True); every payload will be rewritten.",
                     incremental_base,
                 )
+            # Origin mirrors propagate transitively: payloads this snapshot
+            # borrows may physically live in any ancestor, so carry every
+            # ancestor's mirror mapping forward alongside the base's own.
+            origin_mirrors.update(base_meta.origin_mirrors or {})
+            if base_meta.mirror_url and (
+                canonical_base_url(base_meta.mirror_url) != incremental_base
+            ):
+                # Self-reference guard: when the base IS a mirror tier
+                # (the natural rebase after losing a primary), wrapping it
+                # with itself as fallback would be a pointless double open.
+                origin_mirrors[incremental_base] = base_meta.mirror_url
         elif record_digests:
             dedup_ctx = DedupContext.recording_only()
 
@@ -476,6 +493,8 @@ class Snapshot:
                 version=__version__,
                 world_size=world_size,
                 manifest=global_manifest,
+                mirror_url=own_mirror,
+                origin_mirrors=origin_mirrors or None,
             )
             return pending_io_work, metadata
         finally:
@@ -626,7 +645,8 @@ class Snapshot:
             read_reqs.extend(prepare_read(entry, obj_out=obj, callback=_cb))
 
         self._execute_read_reqs_grouped(
-            read_reqs, storage, memory_budget, rank, event_loop
+            read_reqs, storage, memory_budget, rank, event_loop,
+            origin_mirrors=metadata.origin_mirrors,
         )
 
         container_manifest = {
@@ -693,7 +713,8 @@ class Snapshot:
                 )
             budget = memory_budget_bytes or get_process_memory_budget_bytes(None)
             self._execute_read_reqs_grouped(
-                read_reqs, storage, budget, r, event_loop
+                read_reqs, storage, budget, r, event_loop,
+                origin_mirrors=metadata.origin_mirrors,
             )
 
             if key is not None:
@@ -731,13 +752,17 @@ class Snapshot:
         rank: int,
         event_loop: asyncio.AbstractEventLoop,
         batch: bool = True,
+        origin_mirrors: Optional[Dict[str, str]] = None,
     ) -> None:
         """Execute reads, grouped by payload origin.
 
         Incremental snapshots reference unchanged payloads in their base
         snapshot(s); those reads go through a plugin opened on the origin
-        URL. Batching (read coalescing) runs per group — merging ranges
-        across different origins would read from the wrong storage.
+        URL — wrapped with the origin's OWN mirror (recorded in this
+        snapshot's ``origin_mirrors``) so deduplicated payloads survive
+        the loss of a base's primary tier. Batching (read coalescing)
+        runs per group — merging ranges across different origins would
+        read from the wrong storage.
         """
         groups: Dict[Optional[str], List[ReadReq]] = {}
         for rr in read_reqs:
@@ -754,19 +779,32 @@ class Snapshot:
                 continue
             from .storage_plugin import strip_mirror_options
 
+            origin_opts = strip_mirror_options(self._storage_options)
+            origin_mirror = (origin_mirrors or {}).get(origin)
+            if origin_mirror:
+                origin_opts = {
+                    **(origin_opts or {}),
+                    "mirror_url": origin_mirror,
+                }
             origin_storage = url_to_storage_plugin_in_event_loop(
-                origin, event_loop, strip_mirror_options(self._storage_options)
+                origin, event_loop, origin_opts
             )
             try:
                 sync_execute_read_reqs(
                     reqs, origin_storage, memory_budget, rank, event_loop
                 )
             except FileNotFoundError as e:
+                where = (
+                    f"base snapshot {origin!r} or its mirror {origin_mirror!r}"
+                    if origin_mirror
+                    else f"base snapshot {origin!r}"
+                )
                 raise RuntimeError(
                     f"Restoring from incremental snapshot {self.path!r}: a "
-                    f"payload referenced in base snapshot {origin!r} is "
-                    f"missing ({e}). Incremental snapshots require their "
-                    "base snapshots to remain intact."
+                    f"payload referenced in {where} is missing ({e}). "
+                    "Incremental snapshots require their base snapshots "
+                    "(or, when recorded, the bases' mirrors) to remain "
+                    "intact; `consolidate` detaches a chain from its bases."
                 ) from e
             finally:
                 origin_storage.sync_close(event_loop)
@@ -821,7 +859,7 @@ class Snapshot:
             budget = memory_budget_bytes or get_process_memory_budget_bytes(None)
             self._execute_read_reqs_grouped(
                 read_reqs, storage, budget, pg_wrapper.get_rank(), event_loop,
-                batch=False,
+                batch=False, origin_mirrors=metadata.origin_mirrors,
             )
             return box[0]
         finally:

@@ -26,10 +26,12 @@ Semantics:
   mirror metadata, every rank's payload mirrors have landed.
 - ``read``: primary first; falls back to the mirror when the primary
   lost the payload (e.g. local disk wiped between save and restore).
-- Incremental caveat: a deduplicated payload's ``origin`` names the base
-  snapshot's PRIMARY, so the mirror of an INCREMENTAL snapshot is not
-  independently durable against machine loss — consolidate the chain
-  onto the durable tier for that (see docs/storage.rst).
+- Incremental composition: a deduplicated payload's ``origin`` names the
+  base snapshot's primary, and the snapshot metadata records each
+  origin's MIRROR (``SnapshotMetadata.origin_mirrors``, propagated
+  transitively at take time) — origin reads are wrapped with that
+  mirror, so an incremental chain whose bases were mirrored restores
+  from the durable tier alone after total primary loss.
 - Mirror failures do not fail the snapshot (the primary committed); they
   are logged and raised at ``close()`` on the failing rank unless
   ``storage_options={"mirror_strict": False}``. A failing rank's error
