@@ -290,6 +290,111 @@ def test_prune_required_set_is_transitive(tmp_path, capsys):
         np.testing.assert_array_equal(dst["y"], np.full((8,), float(ey), np.float32))
 
 
+def test_prune_spares_bases_by_name_after_tree_move(tmp_path, capsys):
+    """Origins record absolute realpaths at take time. If the checkpoint
+    tree is moved (or scanned via a different mount path), those paths
+    resolve to nothing — prune must fall back to basename matching
+    instead of deleting the base of a kept incremental."""
+    import shutil
+    import time
+
+    src = tmp_path / "ckpts"
+    src.mkdir()
+    Snapshot.take(str(src / "step_0"),
+                  {"app": StateDict(w=np.ones(16, np.float32))},
+                  record_digests=True)
+    time.sleep(0.02)
+    Snapshot.take(str(src / "step_1"),
+                  {"app": StateDict(w=np.ones(16, np.float32))},
+                  incremental_base=str(src / "step_0"))
+    time.sleep(0.02)
+    Snapshot.take(str(src / "step_2"), {"app": StateDict(w=np.ones(16, np.float32))})
+
+    moved = tmp_path / "ckpts_moved"
+    shutil.move(str(src), str(moved))
+
+    # keep newest 2 (step_1, step_2): step_0 must be spared via basename
+    assert main(["prune", str(moved), "--keep", "2", "--yes"]) == 0
+    out = capsys.readouterr().out
+    assert "keep    step_0  (base of a kept snapshot, matched by name)" in out
+    assert (moved / "step_0").exists()
+
+
+def test_prune_name_match_requires_payload_identity(tmp_path, capsys):
+    """A same-named but UNRELATED snapshot must not satisfy the basename
+    fallback: the true base was renamed (origins still record its old
+    path), and an unrelated snapshot now occupies the old name. Sparing
+    the impostor would also suppress the unresolved-base refusal while
+    the real base is rmtree'd — the fallback must verify the candidate
+    actually holds the referenced payload files."""
+    import time
+
+    Snapshot.take(str(tmp_path / "step_0"),
+                  {"app": StateDict(w=np.ones(16, np.float32))},
+                  record_digests=True)
+    time.sleep(0.02)
+    Snapshot.take(str(tmp_path / "step_1"),
+                  {"app": StateDict(w=np.ones(16, np.float32))},
+                  incremental_base=str(tmp_path / "step_0"))
+    time.sleep(0.02)
+    (tmp_path / "step_0").rename(tmp_path / "step_0_renamed")
+    # unrelated snapshot under the base's old name, DIFFERENT tree shape;
+    # backdated so retention keeps (step_1, step_2), not the impostor
+    Snapshot.take(str(tmp_path / "step_0"),
+                  {"other": StateDict(z=np.zeros(4, np.int32))})
+    import os as _os
+    meta = tmp_path / "step_0" / ".snapshot_metadata"
+    st = _os.stat(str(tmp_path / "step_0_renamed" / ".snapshot_metadata"))
+    _os.utime(str(meta), (st.st_atime, st.st_mtime - 1))
+    time.sleep(0.02)
+    Snapshot.take(str(tmp_path / "step_2"), {"app": StateDict(w=np.ones(16, np.float32))})
+
+    # keep newest 2 (step_0 impostor is older than step_1? ensure keep
+    # covers step_1 and step_2): the impostor must NOT be spared by name,
+    # the origin is unresolved, and --yes refuses.
+    assert main(["prune", str(tmp_path), "--keep", "2", "--yes"]) == 2
+    captured = capsys.readouterr()
+    assert "refusing --yes" in captured.err
+    assert "matched by name" not in captured.out
+    assert (tmp_path / "step_0_renamed").exists()
+
+
+def test_prune_refuses_yes_on_unresolved_bases(tmp_path, capsys):
+    """A kept snapshot whose base resolves to nothing in the scanned
+    directory (and matches no name) makes `prune --yes` refuse: prune
+    cannot prove the doomed snapshots aren't that base under another
+    name. `--ignore-missing-bases` overrides."""
+    import time
+
+    external = tmp_path / "elsewhere" / "base"
+    Snapshot.take(str(external), {"app": StateDict(w=np.ones(16, np.float32))},
+                  record_digests=True)
+    scanned = tmp_path / "ckpts"
+    Snapshot.take(str(scanned / "old"), {"app": StateDict(w=np.zeros(16, np.float32))})
+    time.sleep(0.02)
+    Snapshot.take(str(scanned / "new"), {"app": StateDict(w=np.ones(16, np.float32))},
+                  incremental_base=str(external))
+
+    # dry run: plan prints, loud warning on stderr, rc 0
+    assert main(["prune", str(scanned), "--keep", "1"]) == 0
+    captured = capsys.readouterr()
+    assert "delete  old" in captured.out
+    assert "resolve to no snapshot in this directory" in captured.err
+
+    # --yes refuses; nothing deleted
+    assert main(["prune", str(scanned), "--keep", "1", "--yes"]) == 2
+    captured = capsys.readouterr()
+    assert "refusing --yes" in captured.err
+    assert (scanned / "old").exists()
+
+    # explicit override deletes
+    assert main(["prune", str(scanned), "--keep", "1", "--yes",
+                 "--ignore-missing-bases"]) == 0
+    capsys.readouterr()
+    assert not (scanned / "old").exists()
+    assert (scanned / "new").exists()
+
+
 def test_prune_rejects_remote_and_bad_args(tmp_path, capsys):
     assert main(["prune", "gs://bucket/x", "--keep", "1"]) == 2
     Snapshot.take(str(tmp_path / "s"), {"app": StateDict(n=1)})

@@ -92,6 +92,44 @@ def test_mirror_failure_keeps_primary_and_never_commits_mirror(tmp_path):
     assert not (mirror / SNAPSHOT_METADATA_FNAME).exists()
 
 
+def test_strict_mirror_failure_raises_from_sync_take(tmp_path):
+    """End-to-end through the public API: a strict-mode mirror failure is
+    raised at storage close, and synchronous ``Snapshot.take`` must
+    PROPAGATE it — a caller relying on ``mirror_strict=True`` (the
+    default) may delete primary tiers believing the durable mirror
+    landed. Regression: the close-error guard in take()'s finally block
+    read ``sys.exc_info()`` inside the except handler, where it is the
+    just-caught close exception, so the raise never fired."""
+    primary = tmp_path / "fast"
+    bad_mirror = tmp_path / "durable"
+    bad_mirror.write_bytes(b"not a directory")  # every mirror write fails
+
+    with pytest.raises(RuntimeError, match="mirror write"):
+        Snapshot.take(str(primary), {"app": _state(7.0)},
+                      storage_options=_opts(bad_mirror))
+
+    # the primary tier committed before close — it remains restorable
+    dst = _state(0.0)
+    Snapshot(str(primary)).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], np.full((64, 32), 7.0, np.float32))
+
+    # non-strict: same failure is demoted to a warning; take succeeds
+    primary2 = tmp_path / "fast2"
+    Snapshot.take(str(primary2), {"app": _state(8.0)},
+                  storage_options=_opts(bad_mirror, mirror_strict=False))
+
+    # checkpoint-on-error pattern: take() called from INSIDE an except
+    # handler. The close-error guard must not mistake the caller's
+    # ambient exception for an in-flight take failure and swallow the
+    # strict-mirror error.
+    try:
+        raise ValueError("ambient caller exception")
+    except ValueError:
+        with pytest.raises(RuntimeError, match="mirror write"):
+            Snapshot.take(str(tmp_path / "fast3"), {"app": _state(9.0)},
+                          storage_options=_opts(bad_mirror))
+
+
 def test_mirror_failure_nonstrict_warns_only(tmp_path):
     primary, mirror = tmp_path / "fast", tmp_path / "durable"
     plugin = MirroredStoragePlugin(

@@ -440,7 +440,7 @@ def cmd_deps(args: argparse.Namespace) -> int:
     import os
 
     dirpath = args.dir
-    names, origins_of = _scan_snapshot_dir(dirpath)
+    names, origins_of, _ = _scan_snapshot_dir(dirpath)
     snapshots = sorted(names)
     if not snapshots:
         print(f"no snapshots found under {dirpath}")
@@ -487,7 +487,8 @@ def cmd_deps(args: argparse.Namespace) -> int:
 
 
 def _scan_snapshot_dir(dirpath: str):
-    """(snapshots sorted by mtime asc, {name: origin set}) for a directory."""
+    """(snapshots sorted by mtime asc, {name: origin set},
+    {name: {origin: locations referenced in it}}) for a directory."""
     import os
 
     names = sorted(
@@ -504,15 +505,19 @@ def _scan_snapshot_dir(dirpath: str):
         ),
     )
     origins_of = {}
+    origin_locations_of = {}
     for name in names:
         meta = _load_metadata(os.path.join(dirpath, name))
         origins = set()
+        locations = {}
         for entry in meta.manifest.values():
-            for _, _, _, _, origin in _entry_payloads(entry):
+            for location, _, _, _, origin in _entry_payloads(entry):
                 if origin is not None:
                     origins.add(origin)
+                    locations.setdefault(origin, set()).add(location)
         origins_of[name] = origins
-    return names, origins_of
+        origin_locations_of[name] = locations
+    return names, origins_of, origin_locations_of
 
 
 def cmd_prune(args: argparse.Namespace) -> int:
@@ -524,7 +529,7 @@ def cmd_prune(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     dirpath = args.dir[len("fs://"):] if args.dir.startswith("fs://") else args.dir
-    names, origins_of = _scan_snapshot_dir(dirpath)
+    names, origins_of, origin_locations_of = _scan_snapshot_dir(dirpath)
     if not names:
         print(f"no snapshots found under {dirpath}")
         return 2
@@ -542,7 +547,9 @@ def cmd_prune(args: argparse.Namespace) -> int:
     # payloads can reference yet another snapshot the kept set never
     # mentions — so the required set is a transitive closure via a
     # worklist, not one pass over the kept snapshots.
-    required = set()
+    required_names = set()
+    by_name_matches = set()
+    unresolved = set()
     frontier = list(keep)
     visited = set()
     while frontier:
@@ -552,15 +559,45 @@ def cmd_prune(args: argparse.Namespace) -> int:
         visited.add(name)
         for origin in origins_of.get(name, ()):
             canon = _canon_snapshot_url(origin)
-            required.add(canon)
+            locations = origin_locations_of.get(name, {}).get(origin, set())
+
+            def _holds_payloads(candidate: str) -> bool:
+                # Identity, not just identity of path/name: the candidate
+                # must actually contain every payload file this
+                # snapshot's origin entries reference. An unrelated
+                # snapshot that merely OCCUPIES the base's old path (or
+                # name) must not be spared in its place — that would also
+                # suppress the unresolved-base refusal below while the
+                # true (renamed) base gets deleted.
+                return bool(locations) and all(
+                    os.path.isfile(os.path.join(dirpath, candidate, loc))
+                    for loc in locations
+                )
+
             base_name = name_of_canon.get(canon)
-            if base_name is not None and base_name not in visited:
+            if base_name is not None and not _holds_payloads(base_name):
+                base_name = None
+            if base_name is None:
+                # Origins record absolute realpaths at take time. If the
+                # tree was moved/copied or is scanned via a different
+                # mount path, those paths resolve to nothing here — a
+                # same-basename snapshot holding the referenced payloads
+                # is the moved base.
+                tail = os.path.basename(canon.rstrip("/"))
+                if tail in origins_of and _holds_payloads(tail):
+                    base_name = tail
+                    by_name_matches.add(tail)
+            if base_name is None:
+                unresolved.add(canon)
+                continue
+            required_names.add(base_name)
+            if base_name not in visited:
                 frontier.append(base_name)
     spared, doomed = [], []
     for name in names:
         if name in keep:
             continue
-        if canon_of[name] in required:
+        if name in required_names:
             spared.append(name)
         else:
             doomed.append(name)
@@ -568,9 +605,19 @@ def cmd_prune(args: argparse.Namespace) -> int:
     for name in sorted(keep):
         print(f"keep    {name}")
     for name in spared:
-        print(f"keep    {name}  (base of a kept snapshot)")
+        suffix = ", matched by name" if name in by_name_matches else ""
+        print(f"keep    {name}  (base of a kept snapshot{suffix})")
     for name in doomed:
         print(f"delete  {name}")
+    if unresolved:
+        print(
+            "warning: kept snapshot(s) depend on base(s) that resolve to no "
+            "snapshot in this directory (moved tree, different mount path, "
+            "or a base stored elsewhere):",
+            file=sys.stderr,
+        )
+        for canon in sorted(unresolved):
+            print(f"warning:   {canon}", file=sys.stderr)
     if not doomed:
         print("nothing to prune")
         return 0
@@ -578,6 +625,15 @@ def cmd_prune(args: argparse.Namespace) -> int:
         print(f"dry run: would delete {len(doomed)} snapshot(s); "
               "re-run with --yes to execute")
         return 0
+    if unresolved and not args.ignore_missing_bases:
+        print(
+            "refusing --yes: cannot prove the snapshots marked for deletion "
+            "are not the unresolved base(s) above under a different name. "
+            "Verify the bases exist (python -m torchsnapshot_tpu deps), then "
+            "re-run with --ignore-missing-bases to delete anyway.",
+            file=sys.stderr,
+        )
+        return 2
     for name in doomed:
         shutil.rmtree(os.path.join(dirpath, name))
     print(f"deleted {len(doomed)} snapshot(s)")
@@ -660,6 +716,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of newest snapshots to keep")
     p.add_argument("--yes", action="store_true",
                    help="actually delete (default: print the plan)")
+    p.add_argument("--ignore-missing-bases", action="store_true",
+                   help="delete even when kept snapshots reference bases "
+                        "that resolve to nothing in this directory")
     p.set_defaults(fn=cmd_prune)
     return parser
 

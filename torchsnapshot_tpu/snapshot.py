@@ -173,6 +173,7 @@ class Snapshot:
             path, event_loop, storage_options
         )
         timer = _PhaseTimer("Snapshot.take")
+        body_ok = False
         try:
             # Synchronous take blocks the caller until I/O drains, so staged
             # buffers may alias caller memory — halves host memory traffic
@@ -199,7 +200,12 @@ class Snapshot:
             pg_wrapper.barrier()
             timer.mark("commit")
             timer.log()
+            body_ok = True
         finally:
+            # A success flag, NOT sys.exc_info(): in a finally block
+            # exc_info also reports an AMBIENT exception the caller is
+            # currently handling (take() inside an except block), which
+            # would wrongly swallow close-time errors below.
             # Retire on failure too (a pure non-blocking write): a training
             # loop that catches failed takes must not leak store keys.
             try:
@@ -212,10 +218,11 @@ class Snapshot:
                 # Close-time errors (e.g. a strict mirror failure) matter —
                 # but never at the cost of masking an in-flight take error,
                 # and never leaking the event loop.
-                if sys.exc_info()[0] is None:
+                if body_ok:
                     raise
                 logger.exception(
-                    "storage close failed while handling a take failure."
+                    "storage close also failed while handling a take "
+                    "failure; the original take error propagates."
                 )
             finally:
                 event_loop.close()
