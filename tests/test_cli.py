@@ -338,10 +338,12 @@ def test_prune_name_match_requires_payload_identity(tmp_path, capsys):
                   incremental_base=str(tmp_path / "step_0"))
     time.sleep(0.02)
     (tmp_path / "step_0").rename(tmp_path / "step_0_renamed")
-    # unrelated snapshot under the base's old name, DIFFERENT tree shape;
-    # backdated so retention keeps (step_1, step_2), not the impostor
+    # unrelated snapshot under the base's old name — SAME model, same tree
+    # shape and sizes, different values (the hard case: file-existence or
+    # size checks would accept it); backdated so retention keeps
+    # (step_1, step_2), not the impostor
     Snapshot.take(str(tmp_path / "step_0"),
-                  {"other": StateDict(z=np.zeros(4, np.int32))})
+                  {"app": StateDict(w=np.full(16, 7.0, np.float32))})
     import os as _os
     meta = tmp_path / "step_0" / ".snapshot_metadata"
     st = _os.stat(str(tmp_path / "step_0_renamed" / ".snapshot_metadata"))

@@ -254,3 +254,40 @@ def test_large_objects_stage_within_budget(tmp_path) -> None:
     assert prof.peak_delta_bytes < 192 << 20, (
         f"peak RSS delta {prof.peak_delta_bytes >> 20} MB exceeds bound"
     )
+
+
+def test_host_consumers_get_writable_arrays_from_immutable_buffers() -> None:
+    """Remote plugins (S3/GCS) hand back immutable ``bytes``. Host-facing
+    consumers (read_state_dict, host callbacks) must still deliver
+    WRITABLE arrays — a zero-copy frombuffer view over bytes is read-only
+    and breaks in-place user code. Device-materialize consumers opt out:
+    device_put never needs a writable source."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+    from torchsnapshot_tpu.manifest import ArrayEntry
+    from torchsnapshot_tpu.serialization import Serializer
+
+    entry = ArrayEntry(
+        location="0/app/w",
+        serializer=Serializer.BUFFER_PROTOCOL.value,
+        dtype="float32",
+        shape=[8],
+        replicated=False,
+    )
+    payload = np.arange(8, dtype=np.float32).tobytes()  # immutable
+
+    got = {}
+    consumer = ArrayBufferConsumer(entry, callback=lambda a: got.update(arr=a))
+    asyncio.run(consumer.consume_buffer(payload))
+    assert got["arr"].flags["WRITEABLE"]
+    got["arr"][0] = 99.0  # must not raise
+    np.testing.assert_array_equal(got["arr"][1:], np.arange(1, 8, dtype=np.float32))
+
+    # opt-out path: zero-copy read-only view is acceptable for device_put
+    got2 = {}
+    consumer2 = ArrayBufferConsumer(
+        entry, callback=lambda a: got2.update(arr=a), ensure_writable=False
+    )
+    asyncio.run(consumer2.consume_buffer(payload))
+    np.testing.assert_array_equal(got2["arr"], np.arange(8, dtype=np.float32))

@@ -440,7 +440,7 @@ def cmd_deps(args: argparse.Namespace) -> int:
     import os
 
     dirpath = args.dir
-    names, origins_of, _ = _scan_snapshot_dir(dirpath)
+    names, origins_of, _, _ = _scan_snapshot_dir(dirpath)
     snapshots = sorted(names)
     if not snapshots:
         print(f"no snapshots found under {dirpath}")
@@ -506,18 +506,23 @@ def _scan_snapshot_dir(dirpath: str):
     )
     origins_of = {}
     origin_locations_of = {}
+    payloads_of = {}
     for name in names:
         meta = _load_metadata(os.path.join(dirpath, name))
         origins = set()
         locations = {}
+        own = {}
         for entry in meta.manifest.values():
-            for location, _, _, _, origin in _entry_payloads(entry):
+            for location, _, checksum, nbytes, origin in _entry_payloads(entry):
                 if origin is not None:
                     origins.add(origin)
-                    locations.setdefault(origin, set()).add(location)
+                    locations.setdefault(origin, {})[location] = (checksum, nbytes)
+                else:
+                    own[location] = (checksum, nbytes)
         origins_of[name] = origins
         origin_locations_of[name] = locations
-    return names, origins_of, origin_locations_of
+        payloads_of[name] = own
+    return names, origins_of, origin_locations_of, payloads_of
 
 
 def cmd_prune(args: argparse.Namespace) -> int:
@@ -529,7 +534,7 @@ def cmd_prune(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     dirpath = args.dir[len("fs://"):] if args.dir.startswith("fs://") else args.dir
-    names, origins_of, origin_locations_of = _scan_snapshot_dir(dirpath)
+    names, origins_of, origin_locations_of, payloads_of = _scan_snapshot_dir(dirpath)
     if not names:
         print(f"no snapshots found under {dirpath}")
         return 2
@@ -559,20 +564,40 @@ def cmd_prune(args: argparse.Namespace) -> int:
         visited.add(name)
         for origin in origins_of.get(name, ()):
             canon = _canon_snapshot_url(origin)
-            locations = origin_locations_of.get(name, {}).get(origin, set())
+            locations = origin_locations_of.get(name, {}).get(origin, {})
 
             def _holds_payloads(candidate: str) -> bool:
-                # Identity, not just identity of path/name: the candidate
-                # must actually contain every payload file this
-                # snapshot's origin entries reference. An unrelated
-                # snapshot that merely OCCUPIES the base's old path (or
-                # name) must not be spared in its place — that would also
-                # suppress the unresolved-base refusal below while the
-                # true (renamed) base gets deleted.
-                return bool(locations) and all(
-                    os.path.isfile(os.path.join(dirpath, candidate, loc))
-                    for loc in locations
-                )
+                # Identity, not identity of path/name or mere file
+                # existence: an unrelated snapshot of the SAME model
+                # (same tree shape, same sizes, different values) can
+                # occupy the base's old path or name. The deduplicated
+                # entry recorded the payload's content checksum at take
+                # time; the true base's manifest records the same
+                # checksum for the same bytes — compare them. Only
+                # checksum-less legacy snapshots fall back to
+                # size + file existence.
+                cand = payloads_of.get(candidate, {})
+                if not locations:
+                    return False
+                for loc, (csum, nbytes) in locations.items():
+                    have = cand.get(loc)
+                    if have is None:
+                        return False
+                    have_csum, have_nbytes = have
+                    if csum is not None and have_csum is not None:
+                        if csum != have_csum:
+                            return False
+                    elif (
+                        nbytes is not None
+                        and have_nbytes is not None
+                        and nbytes != have_nbytes
+                    ):
+                        return False
+                    if not os.path.isfile(
+                        os.path.join(dirpath, candidate, loc)
+                    ):
+                        return False
+                return True
 
             base_name = name_of_canon.get(canon)
             if base_name is not None and not _holds_payloads(base_name):

@@ -58,6 +58,22 @@ def zero_copy_staging():
         _copy_for_consistency.reset(token)
 
 
+def fast_copyto(dst: np.ndarray, src: np.ndarray) -> None:
+    """``np.copyto(dst, src, casting="same_kind")``, but through raw bytes
+    when the dtypes match exactly and both sides are C-contiguous: numpy's
+    generic same-dtype copy loop runs ~3.5x slower than memcpy for custom
+    dtypes (ml_dtypes bf16/fp8) and small itemsizes, and restore copies are
+    on the critical path."""
+    if (
+        dst.dtype == src.dtype
+        and dst.flags["C_CONTIGUOUS"]
+        and src.flags["C_CONTIGUOUS"]
+    ):
+        np.copyto(dst.reshape(-1).view(np.uint8), src.reshape(-1).view(np.uint8))
+    else:
+        np.copyto(dst, src, casting="same_kind")
+
+
 def _is_jax_array(arr) -> bool:
     try:
         import jax
@@ -168,10 +184,16 @@ class ArrayBufferConsumer(BufferConsumer):
         entry: ArrayEntry,
         dst_view: Optional[np.ndarray] = None,
         callback: Optional[Callable[[np.ndarray], None]] = None,
+        ensure_writable: bool = True,
     ) -> None:
         self.entry = entry
         self.dst_view = dst_view
         self.callback = callback
+        # User-facing host arrays (read_state_dict, host callbacks) must be
+        # writable even when the storage plugin hands back immutable bytes
+        # (S3/GCS); device-materialize callbacks opt out — device_put never
+        # needs a writable source and the copy would be pure waste.
+        self.ensure_writable = ensure_writable
 
     def _consume_sync(self, buf: BufferType) -> None:
         if self.entry.checksum is not None:
@@ -183,8 +205,15 @@ class ArrayBufferConsumer(BufferConsumer):
             if verification_enabled():
                 verify_checksum(buf, self.entry.checksum, self.entry.location)
         arr = array_from_buffer(buf, self.entry.dtype, self.entry.shape)
+        if (
+            self.dst_view is None
+            and self.callback is not None
+            and self.ensure_writable
+            and not arr.flags["WRITEABLE"]
+        ):
+            arr = np.array(arr)
         if self.dst_view is not None:
-            np.copyto(self.dst_view, arr, casting="same_kind")
+            fast_copyto(self.dst_view, arr)
             if self.callback is not None:
                 self.callback(self.dst_view)
         elif self.callback is not None:
@@ -223,9 +252,15 @@ class ArrayIOPreparer:
         dst_view: Optional[np.ndarray] = None,
         callback: Optional[Callable[[np.ndarray], None]] = None,
         buffer_size_limit_bytes: Optional[int] = None,
+        ensure_writable: bool = True,
     ) -> List[ReadReq]:
         if buffer_size_limit_bytes is None:
-            consumer = ArrayBufferConsumer(entry, dst_view=dst_view, callback=callback)
+            consumer = ArrayBufferConsumer(
+                entry,
+                dst_view=dst_view,
+                callback=callback,
+                ensure_writable=ensure_writable,
+            )
             byte_range = (
                 tuple(entry.byte_range) if entry.byte_range is not None else None
             )
@@ -310,11 +345,11 @@ class ArrayAssembler:
         return self._scratch[index] if index else self._scratch
 
     def fill_flat(self, elem_lo: int, elem_hi: int, values: np.ndarray) -> None:
-        np.copyto(self._flat[elem_lo:elem_hi], values, casting="same_kind")
+        fast_copyto(self._flat[elem_lo:elem_hi], values)
         self.part_done()
 
     def fill_region(self, index: Tuple[slice, ...], values: np.ndarray) -> None:
-        np.copyto(self.region_view(index), values, casting="same_kind")
+        fast_copyto(self.region_view(index), values)
         self.part_done()
 
     def part_done(self) -> None:
@@ -324,7 +359,7 @@ class ArrayAssembler:
             remaining = self._remaining
         if remaining == 0:
             if self._scratch is not self.dst:
-                np.copyto(self.dst, self._scratch, casting="same_kind")
+                fast_copyto(self.dst, self._scratch)
             if self.callback is not None:
                 self.callback(self.dst)
 

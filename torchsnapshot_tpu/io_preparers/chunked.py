@@ -128,7 +128,25 @@ class ChunkedArrayIOPreparer:
         dst_view: Optional[np.ndarray] = None,
         callback: Optional[Callable[[np.ndarray], None]] = None,
         buffer_size_limit_bytes: Optional[int] = None,
+        ensure_writable: bool = True,
     ) -> List[ReadReq]:
+        if len(entry.chunks) == 1 and list(entry.chunks[0].sizes) == list(
+            entry.shape
+        ):
+            # Whole array in one chunk — the common case (anything under
+            # the 512 MB chunk limit). Skip the assembler: its scratch is
+            # a full extra memcpy pass per array (and for jax
+            # destinations the device_put can consume a zero-copy view
+            # over the read buffer directly). Semantics match the
+            # assembler path: dst_view is filled in place, the callback
+            # fires once with the complete array.
+            return ArrayIOPreparer.prepare_read(
+                entry.chunks[0].array,
+                dst_view=dst_view,
+                callback=callback,
+                buffer_size_limit_bytes=buffer_size_limit_bytes,
+                ensure_writable=ensure_writable,
+            )
         if dst_view is None:
             dst_view = np.empty(
                 tuple(entry.shape), dtype=string_to_dtype(entry.dtype)

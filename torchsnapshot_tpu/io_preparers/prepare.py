@@ -111,6 +111,10 @@ def prepare_read(
 
     dst_view: Optional[np.ndarray] = None
     final_callback = callback
+    # Host consumers (read_state_dict, numpy callbacks) are promised
+    # writable arrays; the device-materialize path below opts out —
+    # device_put never needs a writable source.
+    ensure_writable = True
 
     if isinstance(obj_out, np.ndarray) and obj_out.flags["WRITEABLE"]:
         if list(obj_out.shape) != list(entry.shape):
@@ -139,6 +143,7 @@ def prepare_read(
                 _cb(restored)
 
         final_callback = _materialize
+        ensure_writable = False
     # else: no usable destination — allocate inside the preparer and report
     # the host value via callback.
 
@@ -148,6 +153,7 @@ def prepare_read(
             dst_view=dst_view,
             callback=final_callback,
             buffer_size_limit_bytes=buffer_size_limit_bytes,
+            ensure_writable=ensure_writable,
         )
     else:
         return ArrayIOPreparer.prepare_read(
@@ -155,6 +161,7 @@ def prepare_read(
             dst_view=dst_view,
             callback=final_callback,
             buffer_size_limit_bytes=buffer_size_limit_bytes,
+            ensure_writable=ensure_writable,
         )
 
 

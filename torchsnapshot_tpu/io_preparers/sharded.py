@@ -49,7 +49,7 @@ from ..serialization import (
     dtype_to_string,
     string_to_dtype,
 )
-from .array import ArrayBufferStager
+from .array import ArrayBufferStager, fast_copyto
 
 DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
 
@@ -145,7 +145,7 @@ class _ShardScatterConsumer(BufferConsumer):
         )
         for dst_buf, src_slices, dst_slices in self.targets:
             target = dst_buf[dst_slices] if dst_slices else dst_buf
-            np.copyto(target, arr[src_slices] if src_slices else arr, casting="same_kind")
+            fast_copyto(target, arr[src_slices] if src_slices else arr)
         self.completion.part_done()
 
     async def consume_buffer(self, buf: BufferType, executor=None) -> None:

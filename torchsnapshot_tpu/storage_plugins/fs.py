@@ -28,8 +28,17 @@ import aiofiles.os
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 FSYNC_ENV_VAR = "TORCHSNAPSHOT_TPU_FSYNC"
+MMAP_ENV_VAR = "TORCHSNAPSHOT_TPU_MMAP_READS"
+
+# Below this size the two mmap/munmap syscalls cost more than the copy.
+_MMAP_MIN_BYTES = 1 << 20
 
 _tmp_counter = itertools.count()
+
+
+def _mmap_enabled() -> bool:
+    value = os.environ.get(MMAP_ENV_VAR, "1").strip().lower()
+    return value not in ("0", "false", "no", "off")
 
 
 def _fsync_enabled() -> bool:
@@ -90,15 +99,64 @@ class FSStoragePlugin(StoragePlugin):
                 pass
             raise
 
+    def _mmap_read(self, path: str, lo: int, size: int):
+        """Private (copy-on-write) mapping of [lo, lo+size) — blocking,
+        runs in an executor thread."""
+        import mmap as _mmap
+
+        gran = _mmap.ALLOCATIONGRANULARITY
+        aligned = lo - (lo % gran)
+        with open(path, "rb") as f:
+            m = _mmap.mmap(
+                f.fileno(),
+                size + (lo - aligned),
+                flags=_mmap.MAP_PRIVATE,
+                prot=_mmap.PROT_READ | _mmap.PROT_WRITE,
+                offset=aligned,
+            )
+        view = memoryview(m)
+        if aligned != lo or len(view) != size:
+            view = view[lo - aligned : lo - aligned + size]
+        return view
+
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
+        if read_io.byte_range is None:
+            lo, size = 0, os.stat(path).st_size
+        else:
+            lo, hi = read_io.byte_range
+            size = hi - lo
+        if _mmap_enabled() and size >= _MMAP_MIN_BYTES:
+            # Large payloads: MAP_PRIVATE the file instead of copying it
+            # out of the page cache. Restores skip a full memcpy pass AND
+            # the fresh-buffer allocation churn (on lazily-backed VMs,
+            # first-touch of never-used memory costs several x a normal
+            # fault — measured 5-8x restore slowdowns). Copy-on-write
+            # keeps the buffer writable for zero-copy consumers without
+            # ever dirtying the file.
+            loop = asyncio.get_running_loop()
+            read_io.buf = await loop.run_in_executor(
+                None, self._mmap_read, path, lo, size
+            )
+            return
+        # Small payloads: readinto a preallocated bytearray (one page-cache
+        # copy). Like the mmap path the result is WRITABLE, so downstream
+        # zero-copy numpy views are writable arrays.
         async with aiofiles.open(path, "rb") as f:
-            if read_io.byte_range is None:
-                read_io.buf = await f.read()
-            else:
-                lo, hi = read_io.byte_range
+            if lo:
                 await f.seek(lo)
-                read_io.buf = await f.read(hi - lo)
+            buf = bytearray(size)
+            view = memoryview(buf)
+            got = 0
+            while got < size:
+                n = await f.readinto(view[got:])
+                if not n:
+                    raise EOFError(
+                        f"short read: {path} yielded {got} of {size} bytes "
+                        f"(offset {lo})"
+                    )
+                got += n
+            read_io.buf = buf
 
     async def delete(self, path: str) -> None:
         await aiofiles.os.remove(os.path.join(self.root, path))
