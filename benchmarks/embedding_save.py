@@ -10,6 +10,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import shutil
 import tempfile
 import time
@@ -47,7 +48,8 @@ def main() -> None:
     jax.block_until_ready(state)
     nbytes = sum(x.nbytes for x in jax.tree.leaves(state))
 
-    tmp = tempfile.mkdtemp(prefix="bench_embedding_")
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(dir=base, prefix="bench_embedding_")
     try:
         app_state = {"train": StateDict(**state)}
 
@@ -62,6 +64,16 @@ def main() -> None:
         res["caller_blocked_s"] = round(time.perf_counter() - t0, 3)
         pending.wait()
         res["total_s"] = round(time.perf_counter() - t0, 3)
+        # Steady state: a training loop checkpoints repeatedly; from the
+        # second async_take the staging-buffer pool recycles, so warm
+        # numbers are the production caller-blocked cost.
+        shutil.rmtree(f"{tmp}/async", ignore_errors=True)
+        time.sleep(1.0)
+        t0 = time.perf_counter()
+        pending = Snapshot.async_take(f"{tmp}/async", app_state)
+        res["warm_caller_blocked_s"] = round(time.perf_counter() - t0, 3)
+        pending.wait()
+        res["warm_total_s"] = round(time.perf_counter() - t0, 3)
         report("embedding_save/async", res, nbytes)
 
         fresh = E.init_state(jax.random.PRNGKey(1), cfg, tx, mesh=mesh)

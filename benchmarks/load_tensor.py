@@ -11,6 +11,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import shutil
 import tempfile
 
@@ -35,7 +36,8 @@ def main() -> None:
     arr = np.random.default_rng(0).standard_normal((side, side)).astype(np.float32)
     nbytes = arr.nbytes
 
-    tmp = tempfile.mkdtemp(prefix="bench_load_tensor_")
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(dir=base, prefix="bench_load_tensor_")
     try:
         Snapshot.take(f"{tmp}/snap", {"t": StateDict(x=arr)})
         snap = Snapshot(f"{tmp}/snap")

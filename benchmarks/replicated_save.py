@@ -12,6 +12,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import shutil
 import tempfile
 
@@ -44,7 +45,8 @@ def main() -> None:
     jax.block_until_ready(params)
     nbytes = sum(v.nbytes for v in params.values())
 
-    tmp = tempfile.mkdtemp(prefix="bench_replicated_")
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(dir=base, prefix="bench_replicated_")
     try:
         # naive baseline: serial DtoH + np.save per param
         res: dict = {}

@@ -97,6 +97,10 @@ def _load_locked() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_size_t,
     ]
+    lib.ts_copy_crc32c.restype = ctypes.c_uint32
+    lib.ts_copy_crc32c.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
+    ]
     _lib = lib
     return _lib
 
@@ -231,3 +235,33 @@ def gather_copy(dst, sources: Sequence[Tuple[int, Any]]) -> None:
     dst_off = (ctypes.c_uint64 * n)(*(off for off, _ in sources))
     sizes = (ctypes.c_uint64 * n)(*sizes_list)
     lib.ts_gather_copy(ctypes.c_void_p(dst_addr), src_ptrs, dst_off, sizes, n)
+
+
+# ------------------------------------------------------- fused copy + crc
+
+def copy_crc32c(dst, src) -> Optional[int]:
+    """``dst[:] = src[:]`` and return the bytes' CRC32C, reading the source
+    ONCE (async_take staging fuses its consistency copy with the integrity
+    checksum — one memory pass instead of two). Returns None when the
+    native extension is unavailable; callers fall back to copy-then-hash.
+    Both buffers must be contiguous and equal-sized."""
+    lib = _load()
+    if lib is None:
+        return None
+    dst_arr, dst_addr = _as_flat_u8(dst, writable_target=True)
+    if dst_arr.flags["WRITEABLE"] is False:
+        raise ValueError("copy_crc32c destination buffer is read-only")
+    src_arr, src_addr = _as_flat_u8(src)
+    if dst_arr.nbytes != src_arr.nbytes:
+        raise ValueError(
+            f"copy_crc32c size mismatch: dst={dst_arr.nbytes}B "
+            f"src={src_arr.nbytes}B"
+        )
+    if src_arr.nbytes == 0:
+        return 0
+    return lib.ts_copy_crc32c(
+        ctypes.c_void_p(dst_addr),
+        ctypes.c_void_p(src_addr),
+        src_arr.nbytes,
+        ctypes.c_uint32(0),
+    )

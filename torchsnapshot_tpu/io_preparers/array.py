@@ -17,7 +17,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import contextvars
+import os
 import threading
+import weakref
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -56,6 +58,61 @@ def zero_copy_staging():
         yield
     finally:
         _copy_for_consistency.reset(token)
+
+
+STAGING_POOL_ENV_VAR = "TORCHSNAPSHOT_TPU_STAGING_POOL_BYTES"
+_DEFAULT_STAGING_POOL_BYTES = 4 << 30
+
+
+class _StagingPool:
+    """Bounded free-list of staging buffers, recycled by the GC.
+
+    A training loop calls async_take every N minutes; without a pool each
+    call allocates the full state size in fresh buffers, and on
+    lazily-backed VMs first-touch page faults cost several x the copy
+    itself. ``get`` returns a view of a pooled slab with a finalizer:
+    when every reference dies (scheduler, storage plugin, a mirror's
+    background replica — whoever holds it longest), the slab returns to
+    the free list. GC-driven recycling means no component needs an
+    explicit release call, and a buffer still referenced anywhere can
+    never be handed out again."""
+
+    def __init__(self, limit_bytes: int) -> None:
+        self._limit = limit_bytes
+        self._lock = threading.Lock()
+        self._free: dict = {}
+        self._free_bytes = 0
+
+    def get(self, nbytes: int) -> np.ndarray:
+        with self._lock:
+            slabs = self._free.get(nbytes)
+            base = slabs.pop() if slabs else None
+            if base is not None:
+                self._free_bytes -= nbytes
+        if base is None:
+            base = np.empty(nbytes, np.uint8)
+        out = base[:]
+        weakref.finalize(out, self._put, base)
+        return out
+
+    def _put(self, base: np.ndarray) -> None:
+        with self._lock:
+            if self._free_bytes + base.nbytes <= self._limit:
+                self._free.setdefault(base.nbytes, []).append(base)
+                self._free_bytes += base.nbytes
+
+
+def _pool_limit() -> int:
+    raw = os.environ.get(STAGING_POOL_ENV_VAR, "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return _DEFAULT_STAGING_POOL_BYTES
+
+
+_staging_pool = _StagingPool(_pool_limit())
 
 
 def fast_copyto(dst: np.ndarray, src: np.ndarray) -> None:
@@ -119,28 +176,62 @@ class ArrayBufferStager(BufferStager):
         # scheduler then releases the buffer without writing it.
         self.io_skipped = False
 
-    def _stage_sync(self, arr) -> np.ndarray:
+    def _needs_consistency_copy(self, arr) -> bool:
+        """True when staging must copy ``arr`` so the snapshot can't alias
+        caller memory. CPU-backend jax arrays materialize as zero-copy
+        views of the device buffer (donation/deletion could corrupt the
+        snapshot); on TPU the DtoH transfer already produces host-owned
+        memory. Under zero_copy_staging (sync take) views are safe: the
+        caller is blocked until I/O drains."""
+        if not self.copy_for_consistency:
+            return False
         if _is_jax_array(arr):
-            host = np.asarray(arr)
-            # CPU-backend jax arrays materialize as zero-copy views of the
-            # device buffer; copy so donation/deletion can't corrupt the
-            # snapshot. On TPU the DtoH transfer already produced host-owned
-            # memory — no extra copy. Under zero_copy_staging (sync take)
-            # the view is safe: the caller is blocked until I/O drains.
-            devices = arr.sharding.device_set
-            if (
-                self.copy_for_consistency
-                and next(iter(devices)).platform == "cpu"
-            ):
-                host = np.array(host, copy=True)
-            return host
-        if self.copy_for_consistency:
-            return np.array(arr, copy=True)
-        return np.asarray(arr)
+            return next(iter(arr.sharding.device_set)).platform == "cpu"
+        return True
+
+    def _stage_sync(self, arr) -> np.ndarray:
+        host = np.asarray(arr)
+        if self._needs_consistency_copy(arr):
+            host = np.array(host, copy=True)
+        return host
+
+    def _stage_fused(self, arr) -> Optional[BufferType]:
+        """Consistency copy + CRC32C fused into ONE pass over the source
+        (native ts_copy_crc32c). Staging must both copy (the caller may
+        mutate/donate after async_take returns) and checksum (entries are
+        gathered right after staging), and the state is GBs — a second
+        read pass is real wall time. Returns None when not applicable
+        (no consistency copy needed, non-contiguous source, no native)."""
+        from .._native import copy_crc32c, native_available
+
+        # Check native BEFORE drawing from the pool: on a host without the
+        # extension, a pooled slab grabbed here would go unused yet be
+        # retained by the pool — doubling staging memory for nothing.
+        if not native_available():
+            return None
+        if not self._needs_consistency_copy(arr):
+            return None
+        src = np.asarray(arr)
+        if not src.flags["C_CONTIGUOUS"]:
+            return None
+        src_bytes = array_as_memoryview(src)
+        dst = _staging_pool.get(src_bytes.nbytes)
+        crc = copy_crc32c(dst, src_bytes)
+        if crc is None:
+            return None
+        self.entry.checksum = f"crc32c:{crc:08x}"
+        return memoryview(dst)
 
     def _stage_and_sum(self, arr) -> BufferType:
         """Runs in an executor thread: DtoH + serialize + (optional) hash —
         keeping GB-scale hashing off the event-loop thread."""
+        if self.entry is not None and self.dedup is None:
+            from ..integrity import checksums_enabled
+
+            if checksums_enabled():
+                fused = self._stage_fused(arr)
+                if fused is not None:
+                    return fused
         host = self._stage_sync(arr)
         buf = array_as_memoryview(host)
         if self.entry is not None:
