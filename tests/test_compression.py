@@ -1,0 +1,389 @@
+"""Payload compression: entry-recorded codecs (compression.py).
+
+No reference analogue (the reference stores raw serialized bytes only);
+the interaction matrix mirrors the house style of test_incremental.py /
+test_mirror_storage.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.compression import (
+    COMPRESSION_ENV_VAR,
+    UnknownCodecError,
+    compress,
+    decompress,
+    resolve_codec,
+)
+from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+
+def _compressible_state(n=200_000, v=1.0):
+    # arange fp32 compresses well; that's the point of the fixture
+    return StateDict(
+        w=np.arange(n, dtype=np.float32) * v,
+        b=np.zeros(n // 2, np.float32) + v,
+        step=int(v),
+    )
+
+
+def _payload_bytes(root):
+    total = 0
+    for r, _, fs in os.walk(root):
+        for f in fs:
+            if f != ".snapshot_metadata":
+                total += os.path.getsize(os.path.join(r, f))
+    return total
+
+
+def _entry_codecs(path):
+    from torchsnapshot_tpu.cli import _entry_payloads
+
+    meta = Snapshot(path).metadata
+    out = {}
+    for p, e in meta.manifest.items():
+        for location, _, _, _, _ in _entry_payloads(e):
+            out[location] = getattr(e, "codec", None)
+    # chunk/shard sub-entries carry their own codec
+    for p, e in meta.manifest.items():
+        for attr in ("chunks", "shards"):
+            for sub in getattr(e, attr, []) or []:
+                out[sub.array.location] = sub.array.codec
+    return out
+
+
+def test_resolve_codec_validation():
+    assert resolve_codec(None) is None
+    assert resolve_codec("none") is None
+    assert resolve_codec("off") is None
+    assert resolve_codec("zlib") == "zlib:6"
+    assert resolve_codec("zlib:1") == "zlib:1"
+    assert resolve_codec("zstd") == "zstd:3"
+    assert resolve_codec("ZSTD:9") == "zstd:9"
+    with pytest.raises(ValueError, match="unknown compression codec"):
+        resolve_codec("lz77")
+    with pytest.raises(ValueError, match="zlib level"):
+        resolve_codec("zlib:42")
+
+
+def test_compress_decompress_primitives():
+    data = bytes(range(256)) * 100
+    for codec in ("zstd:3", "zlib:6"):
+        packed = compress(codec, data)
+        assert len(packed) < len(data)
+        assert bytes(decompress(codec, packed, expected_size=len(data))) == data
+    with pytest.raises(UnknownCodecError):
+        decompress("snappy:1", b"xx")
+
+
+@pytest.mark.parametrize("codec", ["zstd", "zlib:1"])
+def test_round_trip_and_bytes_reduction(tmp_path, codec):
+    state = _compressible_state()
+    raw_root, comp_root = str(tmp_path / "raw"), str(tmp_path / "comp")
+    Snapshot.take(raw_root, {"app": state})
+    Snapshot.take(comp_root, {"app": state}, compression=codec)
+
+    raw_bytes, comp_bytes = _payload_bytes(raw_root), _payload_bytes(comp_root)
+    assert comp_bytes < raw_bytes / 2, (raw_bytes, comp_bytes)
+
+    recorded = [c for c in _entry_codecs(comp_root).values() if c]
+    assert recorded and all(c.startswith(codec.split(":")[0]) for c in recorded)
+
+    # restore verifies checksums (over stored/compressed bytes) + content
+    dst = _compressible_state(v=0.0)
+    Snapshot(comp_root).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state["w"])
+    np.testing.assert_array_equal(dst["b"], state["b"])
+    assert dst["step"] == 1
+
+    # structure-free read path decompresses too, and arrays are writable
+    loaded = Snapshot(comp_root).read_state_dict(key="app")
+    np.testing.assert_array_equal(loaded["w"], state["w"])
+    assert loaded["w"].flags["WRITEABLE"]
+
+
+def test_incompressible_payloads_stored_raw(tmp_path):
+    rng = np.random.default_rng(0)
+    state = StateDict(noise=rng.integers(0, 2**32, 100_000, np.uint32))
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": state}, compression="zstd")
+    assert not any(_entry_codecs(root).values())  # raw: no size win
+    dst = StateDict(noise=np.zeros(100_000, np.uint32))
+    Snapshot(root).restore({"app": dst})
+    np.testing.assert_array_equal(dst["noise"], state["noise"])
+
+
+def test_small_payloads_skip_compression(tmp_path):
+    state = StateDict(tiny=np.arange(16, dtype=np.float32))
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": state}, compression="zstd")
+    assert not any(_entry_codecs(root).values())
+
+
+def test_env_var_enables_compression(tmp_path, monkeypatch):
+    monkeypatch.setenv(COMPRESSION_ENV_VAR, "zlib:9")
+    root = str(tmp_path / "s")
+    state = _compressible_state()
+    Snapshot.take(root, {"app": state})
+    assert any(
+        c and c.startswith("zlib") for c in _entry_codecs(root).values()
+    )
+    monkeypatch.delenv(COMPRESSION_ENV_VAR)
+    dst = _compressible_state(v=0.0)
+    Snapshot(root).restore({"app": dst})  # restore needs no env
+    np.testing.assert_array_equal(dst["w"], state["w"])
+
+
+def test_invalid_codec_raises_before_io(tmp_path):
+    with pytest.raises(ValueError, match="unknown compression codec"):
+        Snapshot.take(str(tmp_path / "s"), {"app": _compressible_state()},
+                      compression="rle")
+    assert not os.path.exists(tmp_path / "s" / ".snapshot_metadata")
+
+
+def test_unknown_codec_on_restore_is_a_clear_error(tmp_path):
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": _compressible_state()}, compression="zlib")
+    meta_path = os.path.join(root, ".snapshot_metadata")
+    doctored = open(meta_path).read().replace("zlib:6", "futurecodec:1")
+    open(meta_path, "w").write(doctored)
+    dst = _compressible_state(v=0.0)
+    with pytest.raises(UnknownCodecError, match="futurecodec"):
+        Snapshot(root).restore({"app": dst})
+
+
+def test_async_take_with_compression(tmp_path):
+    state = _compressible_state()
+    pending = Snapshot.async_take(
+        str(tmp_path / "s"), {"app": state}, compression="zstd"
+    )
+    pending.wait()
+    dst = _compressible_state(v=0.0)
+    Snapshot(str(tmp_path / "s")).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state["w"])
+
+
+def test_incremental_chain_stable_across_codec_changes(tmp_path):
+    """Digests cover UNCOMPRESSED bytes: a raw base still elides writes
+    for a compressed incremental (and vice versa), and the deduplicated
+    entries carry the BASE's stored checksum/codec so restore reads the
+    base's actual bytes correctly."""
+    base_raw = str(tmp_path / "base_raw")
+    inc_zstd = str(tmp_path / "inc_zstd")
+    state = _compressible_state()
+    Snapshot.take(base_raw, {"app": state}, record_digests=True)
+    Snapshot.take(inc_zstd, {"app": state}, incremental_base=base_raw,
+                  compression="zstd")
+    # unchanged payloads elided in the incremental
+    assert _payload_bytes(inc_zstd) < _payload_bytes(base_raw) / 10
+    # deduplicated entries inherit the base's (raw) codec, i.e. none
+    codecs = _entry_codecs(inc_zstd)
+    assert not any(codecs.values()), codecs
+    dst = _compressible_state(v=0.0)
+    Snapshot(inc_zstd).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state["w"])
+
+    # now the other direction: compressed base, raw incremental re-save
+    base_z = str(tmp_path / "base_z")
+    inc_raw = str(tmp_path / "inc_raw")
+    Snapshot.take(base_z, {"app": state}, record_digests=True,
+                  compression="zstd")
+    Snapshot.take(inc_raw, {"app": state}, incremental_base=base_z)
+    codecs = _entry_codecs(inc_raw)
+    assert any(codecs.values()), (
+        "deduplicated entries must record the base's zstd codec"
+    )
+    dst = _compressible_state(v=0.0)
+    Snapshot(inc_raw).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state["w"])
+    np.testing.assert_array_equal(dst["b"], state["b"])
+
+
+def test_incremental_changed_payloads_compress(tmp_path):
+    base, inc = str(tmp_path / "b"), str(tmp_path / "i")
+    state = _compressible_state()
+    Snapshot.take(base, {"app": state}, record_digests=True, compression="zstd")
+    state2 = _compressible_state()
+    state2["w"] = state2["w"] + 1.0  # changed -> rewritten, compressed
+    Snapshot.take(inc, {"app": state2}, incremental_base=base,
+                  compression="zstd")
+    codecs = _entry_codecs(inc)
+    assert any(c and c.startswith("zstd") for c in codecs.values())
+    dst = _compressible_state(v=0.0)
+    Snapshot(inc).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state2["w"])
+
+
+def test_compression_with_mirror_both_tiers(tmp_path):
+    primary, mirror = str(tmp_path / "fast"), str(tmp_path / "durable")
+    state = _compressible_state()
+    Snapshot.take(primary, {"app": state},
+                  storage_options={"mirror_url": mirror}, compression="zstd")
+    for root in (primary, mirror):
+        dst = _compressible_state(v=0.0)
+        Snapshot(root).restore({"app": dst})
+        np.testing.assert_array_equal(dst["w"], state["w"])
+
+
+def test_compression_with_sharded_state_and_reshard(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = make_mesh({"data": 2, "model": 2}, devices=devices[:4])
+    arr = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    sharded = jax.device_put(arr, NamedSharding(mesh, P("data", "model")))
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": StateDict(x=sharded)}, compression="zstd")
+    codecs = _entry_codecs(root)
+    assert any(c and c.startswith("zstd") for c in codecs.values())
+
+    # restore into a DIFFERENT layout
+    mesh2 = make_mesh({"data": 4, "model": 1}, devices=devices[:4])
+    dst = jax.device_put(
+        jnp.zeros_like(arr), NamedSharding(mesh2, P("data", None))
+    )
+    out = StateDict(x=dst)
+    Snapshot(root).restore({"app": out})
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(arr))
+
+
+def test_compression_with_batching_composes(tmp_path, monkeypatch):
+    """Batched (byte-ranged) payloads skip compression by design; the
+    snapshot as a whole still round-trips."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    state = StateDict(
+        big=np.arange(300_000, dtype=np.float32),
+        **{f"small_{i}": np.full((64,), float(i), np.float32) for i in range(20)},
+    )
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": state}, compression="zstd")
+    dst = StateDict(
+        big=np.zeros(300_000, np.float32),
+        **{f"small_{i}": np.zeros((64,), np.float32) for i in range(20)},
+    )
+    Snapshot(root).restore({"app": dst})
+    np.testing.assert_array_equal(dst["big"], state["big"])
+    for i in range(20):
+        np.testing.assert_array_equal(dst[f"small_{i}"], state[f"small_{i}"])
+
+
+def test_objects_compress(tmp_path):
+    payload = {"text": "tok " * 50_000, "ids": list(range(1000))}
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": StateDict(obj=[payload])}, compression="zstd")
+    codecs = _entry_codecs(root)
+    assert any(c and c.startswith("zstd") for c in codecs.values())
+    loaded = Snapshot(root).read_state_dict(key="app")
+    assert loaded["obj"][0]["text"] == payload["text"]
+    assert loaded["obj"][0]["ids"] == payload["ids"]
+
+
+def test_consolidate_preserves_compression(tmp_path):
+    from torchsnapshot_tpu.dedup import consolidate
+
+    base, inc, flat = (str(tmp_path / n) for n in ("b", "i", "f"))
+    state = _compressible_state()
+    Snapshot.take(base, {"app": state}, record_digests=True, compression="zstd")
+    state2 = _compressible_state()
+    state2["w"] = state2["w"] * 2.0
+    Snapshot.take(inc, {"app": state2}, incremental_base=base,
+                  compression="zstd")
+    consolidate(inc, flat)
+    dst = _compressible_state(v=0.0)
+    Snapshot(flat).restore({"app": dst})
+    np.testing.assert_array_equal(dst["w"], state2["w"])
+    np.testing.assert_array_equal(dst["b"], state2["b"])
+
+
+def test_codec_survives_yaml_round_trip(tmp_path):
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": _compressible_state()}, compression="zlib:4")
+    text = open(os.path.join(root, ".snapshot_metadata")).read()
+    assert "zlib:4" in text
+    meta = SnapshotMetadata.from_yaml(text)
+    # uncompressed snapshots must not gain a codec key (on-disk format pin)
+    root2 = str(tmp_path / "raw")
+    Snapshot.take(root2, {"app": _compressible_state()})
+    assert "codec" not in open(os.path.join(root2, ".snapshot_metadata")).read()
+
+
+def test_replicated_codec_propagates_across_ranks():
+    """Replicated entries are recorded by every rank but staged only by
+    the writer; the codec must propagate to the other ranks' copies like
+    checksum/digest/origin do — a non-writer restoring a compressed chunk
+    without decompressing would fail (or worse)."""
+    from torchsnapshot_tpu.manifest import ArrayEntry, ChunkedArrayEntry, Shard
+    from torchsnapshot_tpu.snapshot import _propagate_checksums
+
+    def make(codec, checksum):
+        sub = ArrayEntry(
+            location="replicated/app/w_0", serializer="buffer_protocol",
+            dtype="float32", shape=[8], replicated=True,
+            checksum=checksum, codec=codec,
+        )
+        return ChunkedArrayEntry(
+            dtype="float32", shape=[8],
+            chunks=[Shard(offsets=[0], sizes=[8], array=sub)],
+            replicated=True,
+        )
+
+    manifest = {
+        "0/app/w": make("zstd:3", "crc32c:deadbeef"),  # the writing rank
+        "1/app/w": make(None, None),                   # recorded, not staged
+    }
+    _propagate_checksums(manifest)
+    other = manifest["1/app/w"].chunks[0].array
+    assert other.codec == "zstd:3"
+    assert other.checksum == "crc32c:deadbeef"
+
+
+def test_zstd_level_validated_up_front():
+    with pytest.raises(ValueError, match="zstd level"):
+        resolve_codec("zstd:99")
+    with pytest.raises(ValueError, match="zstd level"):
+        resolve_codec("zstd:0")
+
+
+def test_zlib_decompress_honors_expected_size_bound():
+    import zlib as _zlib
+
+    data = b"A" * 1_000_000
+    packed = _zlib.compress(data, 6)
+    # an entry lying about its size must not allocate the full stream
+    with pytest.raises(RuntimeError, match="exceeds expected|expected"):
+        decompress("zlib:6", packed, expected_size=1024)
+
+
+def test_dedup_keeps_verify_coverage_for_checksumless_raw_base(tmp_path, monkeypatch):
+    """Base saved with checksums disabled (raw): the deduplicated entry
+    in the incremental must still get a checksum computed from the
+    (identical) staged bytes, not silently lose verify coverage."""
+    base, inc = str(tmp_path / "b"), str(tmp_path / "i")
+    state = _compressible_state()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_CHECKSUM", "0")
+    Snapshot.take(base, {"app": state}, record_digests=True)
+    monkeypatch.delenv("TORCHSNAPSHOT_TPU_CHECKSUM")
+    Snapshot.take(inc, {"app": state}, incremental_base=base)
+
+    from torchsnapshot_tpu.cli import _entry_payloads
+
+    meta = Snapshot(inc).metadata
+    checksums = [
+        c
+        for e in meta.manifest.values()
+        for _, _, c, _, origin in _entry_payloads(e)
+        if origin is not None
+    ]
+    assert checksums and all(c is not None for c in checksums)
+    dst = _compressible_state(v=0.0)
+    Snapshot(inc).restore({"app": dst})  # verification runs and passes
+    np.testing.assert_array_equal(dst["w"], state["w"])

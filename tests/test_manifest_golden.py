@@ -143,6 +143,7 @@ def test_asdict_field_order_is_stable(metadata) -> None:
         "checksum",
         "digest",
         "origin",
+        "codec",
     ]
     d = asdict(metadata.manifest["0/extra/blob"])
     assert list(d.keys()) == [
@@ -155,6 +156,7 @@ def test_asdict_field_order_is_stable(metadata) -> None:
         "size",
         "digest",
         "origin",
+        "codec",
     ]
     # The incremental-snapshot fields are serialization-suppressed while
     # None (SnapshotMetadata.to_yaml), so the YAML golden files above—and

@@ -176,6 +176,18 @@ def cmd_info(args: argparse.Namespace) -> int:
         else:
             print("             (bases must remain intact for restore)")
     print(f"checksums:   {checksummed}/{len(payloads)} payloads")
+    codecs: Dict[str, int] = {}
+    for entry in meta.manifest.values():
+        subs = [entry]
+        for attr in ("chunks", "shards"):
+            subs.extend(s.array for s in getattr(entry, attr, []) or [])
+        for sub in subs:
+            codec = getattr(sub, "codec", None)
+            if codec is not None:
+                codecs[codec] = codecs.get(codec, 0) + 1
+    if codecs:
+        summary = ", ".join(f"{c} x{n}" for c, n in sorted(codecs.items()))
+        print(f"compression: {summary}")
     return 0
 
 

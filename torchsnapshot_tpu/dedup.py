@@ -76,11 +76,19 @@ def compute_digest(buf) -> str:
 
 @dataclass(frozen=True)
 class PayloadRef:
-    """Where a base snapshot holds a payload, and what its content was."""
+    """Where a base snapshot holds a payload, and what its content was.
+
+    ``checksum``/``codec`` describe the base's STORED bytes: a dedup
+    match skips the write, so restore reads the base's payload — the new
+    entry must record the stored form's checksum and compression, not
+    this staging's (digests cover uncompressed content and stay equal;
+    compressed bytes need not, e.g. across codec/level changes)."""
 
     digest: str
     origin: str  # snapshot URL that physically holds the bytes
     nbytes: Optional[int]
+    checksum: Optional[str] = None
+    codec: Optional[str] = None
 
 
 def _iter_payload_entries(entry: Entry) -> Iterator[ArrayEntry]:
@@ -137,6 +145,8 @@ class DedupContext:
                         digest=p.digest,
                         origin=p.origin or base_path,
                         nbytes=nbytes,
+                        checksum=p.checksum,
+                        codec=p.codec,
                     ),
                 )
             if isinstance(entry, ObjectEntry) and entry.digest is not None:
@@ -146,6 +156,8 @@ class DedupContext:
                         digest=entry.digest,
                         origin=entry.origin or base_path,
                         nbytes=entry.size,
+                        checksum=entry.checksum,
+                        codec=entry.codec,
                     ),
                 )
         return cls(base_path=base_path, refs=refs)

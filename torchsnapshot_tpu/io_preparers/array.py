@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..compression import MIN_COMPRESS_BYTES
 from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry
 from ..serialization import (
@@ -169,9 +170,11 @@ class ArrayBufferStager(BufferStager):
         # mutation is visible in the persisted metadata).
         self.entry = entry
         self.copy_for_consistency = _copy_for_consistency.get()
+        from ..compression import active_codec
         from ..dedup import active_dedup_context
 
         self.dedup = active_dedup_context()
+        self.codec = active_codec()
         # Set at stage time when the payload matched the dedup base: the
         # scheduler then releases the buffer without writing it.
         self.io_skipped = False
@@ -222,10 +225,23 @@ class ArrayBufferStager(BufferStager):
         self.entry.checksum = f"crc32c:{crc:08x}"
         return memoryview(dst)
 
+    def _active_codec(self) -> Optional[str]:
+        """The codec this payload will be stored under, or None.
+
+        Byte-ranged payloads (write-batcher slabs) never compress: slab
+        offsets were planned from serialized sizes before staging runs."""
+        if self.entry is None or self.codec is None:
+            return None
+        if self.entry.byte_range is not None:
+            return None
+        return self.codec
+
     def _stage_and_sum(self, arr) -> BufferType:
-        """Runs in an executor thread: DtoH + serialize + (optional) hash —
-        keeping GB-scale hashing off the event-loop thread."""
-        if self.entry is not None and self.dedup is None:
+        """Runs in an executor thread: DtoH + serialize + (optional)
+        compress + hash — keeping GB-scale byte work off the event-loop
+        thread."""
+        codec = self._active_codec()
+        if self.entry is not None and self.dedup is None and codec is None:
             from ..integrity import checksums_enabled
 
             if checksums_enabled():
@@ -237,19 +253,45 @@ class ArrayBufferStager(BufferStager):
         if self.entry is not None:
             from ..integrity import checksums_enabled, compute_checksum
 
-            if checksums_enabled():
-                self.entry.checksum = compute_checksum(buf)
             if self.dedup is not None:
                 from ..dedup import compute_digest
 
+                # Digest covers the UNCOMPRESSED bytes: incremental
+                # chains stay stable across codec/level changes.
                 digest = compute_digest(buf)
                 self.entry.digest = digest
                 ref = self.dedup.match(self.entry.location, digest, buf.nbytes)
                 if ref is not None:
                     # Unchanged since the base snapshot: record where the
-                    # bytes already live and skip the storage write.
+                    # bytes already live and skip the storage write. The
+                    # checksum/codec must describe the BASE's stored
+                    # payload — that is what restore will read. A base
+                    # saved without checksums: when its payload is raw
+                    # its stored bytes equal this staged buffer, so
+                    # compute the checksum here rather than losing verify
+                    # coverage for the deduplicated entry.
                     self.entry.origin = ref.origin
+                    self.entry.codec = ref.codec
+                    if ref.checksum is None and ref.codec is None:
+                        if checksums_enabled():
+                            self.entry.checksum = compute_checksum(buf)
+                    else:
+                        self.entry.checksum = ref.checksum
                     self.io_skipped = True
+                    return buf
+            if codec is not None and buf.nbytes >= MIN_COMPRESS_BYTES:
+                from ..compression import compress
+
+                packed = compress(codec, buf)
+                # Never a size regression: incompressible payloads (bf16
+                # noise, already-compressed objects) are stored raw.
+                if len(packed) < buf.nbytes:
+                    self.entry.codec = codec
+                    buf = memoryview(packed)
+            if checksums_enabled():
+                # Checksum covers the STORED bytes — verification reads
+                # exactly what storage returns, before decompression.
+                self.entry.checksum = compute_checksum(buf)
         return buf
 
     async def stage_buffer(self, executor=None) -> BufferType:
@@ -295,6 +337,16 @@ class ArrayBufferConsumer(BufferConsumer):
             # so the recorded checksum applies directly.
             if verification_enabled():
                 verify_checksum(buf, self.entry.checksum, self.entry.location)
+        if self.entry.codec is not None:
+            from ..compression import decompress
+
+            buf = decompress(
+                self.entry.codec,
+                buf,
+                expected_size=array_size_bytes(
+                    self.entry.shape, self.entry.dtype
+                ),
+            )
         arr = array_from_buffer(buf, self.entry.dtype, self.entry.shape)
         if (
             self.dst_view is None
@@ -345,7 +397,11 @@ class ArrayIOPreparer:
         buffer_size_limit_bytes: Optional[int] = None,
         ensure_writable: bool = True,
     ) -> List[ReadReq]:
-        if buffer_size_limit_bytes is None:
+        # Compressed payloads can't be read by byte sub-ranges (no random
+        # access into the stream): whole-entry read, budget or not.
+        # Entries are <=512 MB by the chunking policy, so the budget's
+        # purpose (bounding single-buffer size) still roughly holds.
+        if buffer_size_limit_bytes is None or entry.codec is not None:
             consumer = ArrayBufferConsumer(
                 entry,
                 dst_view=dst_view,

@@ -64,9 +64,11 @@ class ObjectBufferStager(BufferStager):
         self.entry = entry  # checksum + size recorded at stage time when given
         self._size_estimate: Optional[int] = None
         self._probed_bytes: Optional[bytes] = None
+        from ..compression import active_codec
         from ..dedup import active_dedup_context
 
         self.dedup = active_dedup_context()
+        self.codec = active_codec()
         self.io_skipped = False
 
     def _stage_and_sum(self) -> BufferType:
@@ -76,20 +78,43 @@ class ObjectBufferStager(BufferStager):
         else:
             buf = object_as_bytes(self.obj)
         if self.entry is not None:
+            # size records the SERIALIZED (uncompressed) bytes — it feeds
+            # restore cost models and dedup size paranoia, both of which
+            # reason about the logical payload.
             self.entry.size = len(buf)
             from ..integrity import checksums_enabled, compute_checksum
 
-            if checksums_enabled():
-                self.entry.checksum = compute_checksum(buf)
             if self.dedup is not None:
                 from ..dedup import compute_digest
 
-                digest = compute_digest(buf)
+                digest = compute_digest(buf)  # uncompressed content
                 self.entry.digest = digest
                 ref = self.dedup.match(self.entry.location, digest, len(buf))
                 if ref is not None:
+                    # See ArrayBufferStager: the base's stored checksum/
+                    # codec describe what restore will read; a raw
+                    # checksum-less base falls back to hashing the staged
+                    # (identical) bytes.
                     self.entry.origin = ref.origin
+                    self.entry.codec = ref.codec
+                    if ref.checksum is None and ref.codec is None:
+                        if checksums_enabled():
+                            self.entry.checksum = compute_checksum(buf)
+                    else:
+                        self.entry.checksum = ref.checksum
                     self.io_skipped = True
+                    return buf
+            from ..compression import MIN_COMPRESS_BYTES, compress
+
+            # Objects are never slab-batched (the batcher packs arrays
+            # only), so no byte_range gate is needed here.
+            if self.codec is not None and len(buf) >= MIN_COMPRESS_BYTES:
+                packed = compress(self.codec, buf)
+                if len(packed) < len(buf):
+                    self.entry.codec = self.codec
+                    buf = packed
+            if checksums_enabled():
+                self.entry.checksum = compute_checksum(buf)  # stored bytes
         return buf
 
     async def stage_buffer(self, executor=None) -> BufferType:
@@ -126,6 +151,10 @@ class ObjectBufferConsumer(BufferConsumer):
 
             if verification_enabled():
                 verify_checksum(buf, self.entry.checksum, self.entry.location)
+        if self.entry.codec is not None:
+            from ..compression import decompress
+
+            buf = decompress(self.entry.codec, buf, expected_size=self.entry.size)
         return object_from_bytes(buf)
 
     async def consume_buffer(self, buf: BufferType, executor=None) -> None:

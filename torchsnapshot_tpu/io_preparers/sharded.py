@@ -140,6 +140,17 @@ class _ShardScatterConsumer(BufferConsumer):
                 verify_checksum(
                     buf, self.shard.array.checksum, self.shard.array.location
                 )
+        if self.shard.array.codec is not None:
+            from ..compression import decompress
+            from ..serialization import array_size_bytes
+
+            buf = decompress(
+                self.shard.array.codec,
+                buf,
+                expected_size=array_size_bytes(
+                    self.shard.array.shape, self.shard.array.dtype
+                ),
+            )
         arr = array_from_buffer(
             buf, self.shard.array.dtype, self.shard.array.shape
         )

@@ -58,6 +58,10 @@ class ArrayEntry(Entry):
     # unset so non-incremental snapshots keep their on-disk format.
     digest: Optional[str] = None  # "sha256:<hexdigest>" of the payload
     origin: Optional[str] = None  # base snapshot URL holding the payload
+    # Payload compression (compression.py): canonical codec spec of the
+    # STORED bytes ("zstd:3"); checksum covers the stored bytes, digest
+    # the uncompressed ones. Omitted from YAML when unset.
+    codec: Optional[str] = None
 
     def __init__(
         self,
@@ -70,6 +74,7 @@ class ArrayEntry(Entry):
         checksum: Optional[str] = None,
         digest: Optional[str] = None,
         origin: Optional[str] = None,
+        codec: Optional[str] = None,
     ) -> None:
         super().__init__(type="array")
         self.location = location
@@ -81,6 +86,7 @@ class ArrayEntry(Entry):
         self.checksum = checksum
         self.digest = digest
         self.origin = origin
+        self.codec = codec
 
 
 @dataclass
@@ -130,6 +136,7 @@ class ObjectEntry(Entry):
     size: Optional[int] = None  # serialized bytes, recorded at stage time
     digest: Optional[str] = None  # "sha256:<hexdigest>" (see ArrayEntry)
     origin: Optional[str] = None  # base snapshot URL holding the payload
+    codec: Optional[str] = None  # compression of the stored bytes
 
     def __init__(
         self,
@@ -141,6 +148,7 @@ class ObjectEntry(Entry):
         size: Optional[int] = None,
         digest: Optional[str] = None,
         origin: Optional[str] = None,
+        codec: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
@@ -151,6 +159,7 @@ class ObjectEntry(Entry):
         self.size = size
         self.digest = digest
         self.origin = origin
+        self.codec = codec
 
 
 _PRIMITIVE_TYPES = ("int", "float", "str", "bool", "bytes", "NoneType")
@@ -343,7 +352,7 @@ class SnapshotMetadata:
         # tests/test_manifest_golden.py); absent keys read back as None.
         def strip(node: Any) -> None:
             if isinstance(node, dict):
-                for k in ("digest", "origin"):
+                for k in ("digest", "origin", "codec"):
                     if node.get(k, "sentinel") is None:
                         del node[k]
                 for v in node.values():

@@ -154,6 +154,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         incremental_base: Optional[str] = None,
         record_digests: bool = False,
+        compression: Optional[str] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` at ``path``.
 
@@ -164,6 +165,12 @@ class Snapshot:
         itself). ``record_digests`` records content digests so a FUTURE
         take can use this snapshot as its base; implied by
         ``incremental_base``.
+
+        ``compression`` enables payload compression ("zstd", "zstd:<lvl>",
+        "zlib", "zlib:<lvl>"); default is the
+        ``TORCHSNAPSHOT_TPU_COMPRESSION`` env var, else off. The codec is
+        recorded per entry, so mixed-codec snapshots/chains restore
+        transparently (see compression.py for the full design rules).
         """
         cls._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
@@ -190,6 +197,7 @@ class Snapshot:
                     incremental_base=incremental_base,
                     record_digests=record_digests,
                     storage_options=storage_options,
+                    compression=compression,
                 )
             pending_io_work.sync_complete(event_loop)
             _drain_background_storage(storage, event_loop)
@@ -240,6 +248,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         incremental_base: Optional[str] = None,
         record_digests: bool = False,
+        compression: Optional[str] = None,
     ) -> "PendingSnapshot":
         """Non-blocking take. Returns once *staging* (DtoH copy + serialize)
         completes — after that, mutations to the app state do not affect the
@@ -266,6 +275,7 @@ class Snapshot:
             incremental_base=incremental_base,
             record_digests=record_digests,
             storage_options=storage_options,
+            compression=compression,
         )
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -292,13 +302,21 @@ class Snapshot:
         incremental_base: Optional[str] = None,
         record_digests: bool = False,
         storage_options: Optional[Dict[str, Any]] = None,
+        compression: Optional[str] = None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         timer = timer or _PhaseTimer("Snapshot.take")  # unlogged unless the caller logs
         rank = pg_wrapper.get_rank()
         world_size = pg_wrapper.get_world_size()
         app_state = dict(app_state)
 
+        from .compression import compression_staging, env_codec, resolve_codec
         from .dedup import DedupContext, canonical_base_url, dedup_staging
+
+        # Validate the codec spec before any I/O happens; the explicit
+        # argument wins over TORCHSNAPSHOT_TPU_COMPRESSION.
+        codec = (
+            resolve_codec(compression) if compression is not None else env_codec()
+        )
 
         if incremental_base is not None:
             # Recorded origins must resolve from any working directory /
@@ -405,10 +423,11 @@ class Snapshot:
                 flattened, replicated_paths, rank, world_size
             )
 
-            # Stagers capture the dedup context at construction (prepare
-            # time) and consult it at stage time — digest recording and
-            # unchanged-payload write elision for incremental snapshots.
-            with dedup_staging(dedup_ctx):
+            # Stagers capture the dedup context and active codec at
+            # construction (prepare time) and consult them at stage time —
+            # digest recording / unchanged-payload write elision for
+            # incremental snapshots, payload compression when enabled.
+            with dedup_staging(dedup_ctx), compression_staging(codec):
                 for logical_path in sorted(flattened.keys()):
                     obj = flattened[logical_path]
                     is_repl = logical_path in replicated_paths
@@ -1000,8 +1019,8 @@ class Snapshot:
 def _propagate_checksums(global_manifest: Manifest) -> None:
     """Replicated entries are recorded by every rank but staged only by the
     rank that writes each chunk; copy the stage-time metadata — checksum,
-    content digest, and dedup origin — to the other ranks' copies of the
-    same storage location. Origin propagation is load-bearing: when an
+    content digest, dedup origin, and compression codec — to the other
+    ranks' copies of the same storage location. Origin propagation is load-bearing: when an
     incremental take deduplicates a replicated chunk, only the writing
     rank learns the payload lives in the base snapshot, and every other
     rank restores its OWN copy of the entry (manifest.get_available_entries),
@@ -1017,10 +1036,12 @@ def _propagate_checksums(global_manifest: Manifest) -> None:
                 yield part.array
 
     known: Dict[Tuple[str, str], str] = {}
-    blanks: Dict[str, List[Any]] = {"checksum": [], "digest": [], "origin": []}
+    blanks: Dict[str, List[Any]] = {
+        "checksum": [], "digest": [], "origin": [], "codec": []
+    }
     for entry in global_manifest.values():
         for sub in sub_entries(entry):
-            for field in ("checksum", "digest", "origin"):
+            for field in ("checksum", "digest", "origin", "codec"):
                 value = getattr(sub, field)
                 if value is not None:
                     known.setdefault((field, sub.location), value)
