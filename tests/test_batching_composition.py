@@ -1,0 +1,193 @@
+"""Write batching composed with the other storage features.
+
+The suite-wide conftest pins batching OFF (layout-dependent tests); every
+test here opts back in. Reference matrix pattern:
+tests/test_batcher.py:188-192 in the reference exercises batching across
+dtypes — here the axis is FEATURES: incremental, mirror, async fault
+injection, resharding.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+
+def _small_state(v=1.0, n_small=24):
+    # many small arrays => the batcher packs them into slabs
+    return StateDict(
+        big=np.arange(100_000, dtype=np.float32) * v,
+        **{
+            f"s{i}": np.full((32,), v * i, np.float32)
+            for i in range(n_small)
+        },
+    )
+
+
+def _zero_state(n_small=24):
+    return StateDict(
+        big=np.zeros(100_000, np.float32),
+        **{f"s{i}": np.zeros((32,), np.float32) for i in range(n_small)},
+    )
+
+
+def _assert_equal(dst, src, n_small=24):
+    np.testing.assert_array_equal(dst["big"], src["big"])
+    for i in range(n_small):
+        np.testing.assert_array_equal(dst[f"s{i}"], src[f"s{i}"])
+
+
+def _slab_files(root):
+    return [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(root)
+        for f in fs
+        if "batched" in os.path.join(r, f)
+    ]
+
+
+def test_batching_with_incremental_warns_and_stays_correct(
+    tmp_path, monkeypatch, caplog
+):
+    """Batched (slab) payloads opt out of dedup by design — the library
+    says so loudly — but the COMBINATION must stay correct: everything
+    restores, and digests that were recorded still serve non-batched
+    payloads."""
+    import logging
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    base, inc = str(tmp_path / "b"), str(tmp_path / "i")
+    state = _small_state()
+    # replicated entries keep deterministic per-payload locations (never
+    # batched), so 'big' is the one payload that CAN dedup here
+    with caplog.at_level(logging.WARNING):
+        Snapshot.take(base, {"app": state}, record_digests=True,
+                      replicated=["app/big"])
+    assert any("batched" in r.message.lower() for r in caplog.records)
+    assert _slab_files(base), "setup must actually produce slabs"
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        Snapshot.take(inc, {"app": state}, incremental_base=base,
+                      replicated=["app/big"])
+    assert any("batch" in r.message.lower() for r in caplog.records)
+
+    # the replicated (non-batched) payload deduplicates; slabs rewrite
+    from torchsnapshot_tpu.cli import _entry_payloads
+
+    meta = Snapshot(inc).metadata
+    origins = [
+        origin
+        for e in meta.manifest.values()
+        for _, _, _, _, origin in _entry_payloads(e)
+    ]
+    assert any(o is not None for o in origins), "big payload should dedup"
+
+    dst = _zero_state()
+    Snapshot(inc).restore({"app": dst})
+    _assert_equal(dst, state)
+
+
+def test_unbatched_base_batched_incremental(tmp_path, monkeypatch):
+    """Base saved without batching, incremental with it: slab locations
+    can never match the base's per-payload locations, so slabs rewrite;
+    restore must be correct either way."""
+    base, inc = str(tmp_path / "b"), str(tmp_path / "i")
+    state = _small_state()
+    Snapshot.take(base, {"app": state}, record_digests=True)
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    Snapshot.take(inc, {"app": state}, incremental_base=base)
+    dst = _zero_state()
+    Snapshot(inc).restore({"app": dst})
+    _assert_equal(dst, state)
+
+
+def test_batching_with_mirror_both_tiers(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    primary, mirror = str(tmp_path / "fast"), str(tmp_path / "durable")
+    state = _small_state(2.0)
+    Snapshot.take(primary, {"app": state},
+                  storage_options={"mirror_url": mirror})
+    assert _slab_files(primary) and _slab_files(mirror)
+    for root in (primary, mirror):
+        dst = _zero_state()
+        Snapshot(root).restore({"app": dst})
+        _assert_equal(dst, state)
+
+    # mirror read fallback with a slab: delete a PRIMARY slab, restore
+    # through the mirrored options
+    for slab in _slab_files(primary):
+        os.remove(slab)
+    dst = _zero_state()
+    Snapshot(primary, storage_options={"mirror_url": mirror}).restore(
+        {"app": dst}
+    )
+    _assert_equal(dst, state)
+
+
+class _FailSlabPlugin(FSStoragePlugin):
+    """Fails exactly the slab writes — the batched path's fault lane."""
+
+    async def write(self, write_io) -> None:
+        if "batched" in write_io.path:
+            raise RuntimeError("injected slab write failure")
+        await super().write(write_io)
+
+
+def test_batching_async_fault_leaves_no_committed_metadata(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+        _FailSlabPlugin,
+    )
+    pending = Snapshot.async_take(
+        str(tmp_path / "snap"), {"app": _small_state()}
+    )
+    with pytest.raises(RuntimeError, match="injected slab write failure"):
+        pending.wait()
+    assert not (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+
+
+def test_batching_async_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    state = _small_state(3.0)
+    pending = Snapshot.async_take(str(tmp_path / "snap"), {"app": state})
+    snap = pending.wait()
+    dst = _zero_state()
+    snap.restore({"app": dst})
+    _assert_equal(dst, state)
+
+
+def test_batching_sharded_reshard_roundtrip(tmp_path, monkeypatch):
+    """Sharded sub-entries are batchable; restoring into a different
+    layout reads slab ranges for shard overlap regions."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs >=4 devices")
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    mesh = make_mesh({"data": 4, "model": 1}, devices=devices[:4])
+    arr = jnp.arange(32 * 16, dtype=jnp.float32).reshape(32, 16)
+    sharded = jax.device_put(arr, NamedSharding(mesh, P("data", None)))
+    root = str(tmp_path / "s")
+    Snapshot.take(root, {"app": StateDict(x=sharded)})
+
+    mesh2 = make_mesh({"data": 2, "model": 2}, devices=devices[:4])
+    dst = jax.device_put(
+        jnp.zeros_like(arr), NamedSharding(mesh2, P("data", "model"))
+    )
+    out = StateDict(x=dst)
+    Snapshot(root).restore({"app": out})
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(arr))

@@ -387,3 +387,36 @@ def test_dedup_keeps_verify_coverage_for_checksumless_raw_base(tmp_path, monkeyp
     dst = _compressible_state(v=0.0)
     Snapshot(inc).restore({"app": dst})  # verification runs and passes
     np.testing.assert_array_equal(dst["w"], state["w"])
+
+
+def test_diff_does_not_flag_raw_vs_compressed_as_changed(tmp_path, capsys):
+    """Checksums cover stored bytes, so the same content saved raw vs
+    compressed hashes differently — diff must fall through to 'unknown'
+    (or use digests), never claim 'changed'."""
+    from torchsnapshot_tpu.cli import main
+
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    state = _compressible_state()
+    Snapshot.take(a, {"app": state})
+    Snapshot.take(b, {"app": state}, compression="zstd")
+    assert main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "0 changed" in out, out
+    assert "indeterminate" in out, out
+
+    # with digests recorded on both sides the verdict is decisive: same
+    a2, b2 = str(tmp_path / "a2"), str(tmp_path / "b2")
+    Snapshot.take(a2, {"app": state}, record_digests=True)
+    Snapshot.take(b2, {"app": state}, record_digests=True, compression="zstd")
+    assert main(["diff", a2, b2]) == 0
+    out2 = capsys.readouterr().out
+    assert "0 changed" in out2, out2
+    assert "0 indeterminate" in out2, out2
+
+
+def test_zstd_bomb_header_rejected_before_allocation():
+    zstandard = pytest.importorskip("zstandard")
+    payload = compress("zstd:3", b"x" * 100_000)
+    # entry lies: expected far smaller than the frame header declares
+    with pytest.raises(RuntimeError, match="declares"):
+        decompress("zstd:3", payload, expected_size=512)

@@ -176,7 +176,9 @@ def cmd_info(args: argparse.Namespace) -> int:
         else:
             print("             (bases must remain intact for restore)")
     print(f"checksums:   {checksummed}/{len(payloads)} payloads")
-    codecs: Dict[str, int] = {}
+    # Per distinct payload like the stats above — replicated entries
+    # repeat under every rank prefix but share storage.
+    codec_of: Dict[Tuple[str, Optional[Tuple[int, int]]], str] = {}
     for entry in meta.manifest.values():
         subs = [entry]
         for attr in ("chunks", "shards"):
@@ -184,8 +186,12 @@ def cmd_info(args: argparse.Namespace) -> int:
         for sub in subs:
             codec = getattr(sub, "codec", None)
             if codec is not None:
-                codecs[codec] = codecs.get(codec, 0) + 1
-    if codecs:
+                br = getattr(sub, "byte_range", None)
+                codec_of[(sub.location, tuple(br) if br else None)] = codec
+    if codec_of:
+        codecs: Dict[str, int] = {}
+        for codec in codec_of.values():
+            codecs[codec] = codecs.get(codec, 0) + 1
         summary = ", ".join(f"{c} x{n}" for c, n in sorted(codecs.items()))
         print(f"compression: {summary}")
     return 0
@@ -377,12 +383,17 @@ def _leaf_compare(ea: Entry, eb: Entry) -> str:
     for box, sub_a in pa.items():
         sub_b = pb[box]
         if sub_a.digest is not None and sub_b.digest is not None:
+            # Digests cover the uncompressed content — codec-independent.
             if sub_a.digest != sub_b.digest:
                 return "changed"
         elif (
             sub_a.checksum is not None
             and sub_b.checksum is not None
             and sub_a.checksum.partition(":")[0] == sub_b.checksum.partition(":")[0]
+            # Checksums cover the STORED bytes: only comparable when both
+            # sides stored the same form (same codec, or both raw) —
+            # identical content saved raw vs compressed hashes differently.
+            and getattr(sub_a, "codec", None) == getattr(sub_b, "codec", None)
         ):
             if sub_a.checksum != sub_b.checksum:
                 return "changed"

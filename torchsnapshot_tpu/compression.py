@@ -95,18 +95,24 @@ def env_codec() -> Optional[str]:
 
 
 def compress(codec: str, buf) -> bytes:
-    """Compress ``buf`` (bytes-like) under a canonical codec spec."""
+    """Compress ``buf`` (bytes-like) under a canonical codec spec.
+
+    The input is passed to the codec via the buffer protocol — no
+    intermediate copy: staging buffers are GB-scale and an extra copy
+    here would inflate the staging peak outside the scheduler's cost
+    accounting."""
     name, _, level_s = codec.partition(":")
     level = int(level_s)
+    view = buf if isinstance(buf, memoryview) else memoryview(buf)
     if name == "zstd":
         zstd = _zstd()
         if zstd is None:
             raise UnknownCodecError(
                 "zstd compression requested but zstandard is not installed"
             )
-        return zstd.ZstdCompressor(level=level).compress(bytes(buf))
+        return zstd.ZstdCompressor(level=level).compress(view)
     if name == "zlib":
-        return zlib.compress(bytes(buf), level)
+        return zlib.compress(view, level)
     raise UnknownCodecError(f"unknown compression codec {codec!r}")
 
 
@@ -117,6 +123,7 @@ def decompress(codec: str, buf, expected_size: Optional[int] = None):
     decompression-bomb bound and an integrity cross-check.
     """
     name, _, _ = codec.partition(":")
+    view = buf if isinstance(buf, memoryview) else memoryview(buf)
     if name == "zstd":
         zstd = _zstd()
         if zstd is None:
@@ -124,22 +131,37 @@ def decompress(codec: str, buf, expected_size: Optional[int] = None):
                 f"snapshot payload is compressed with {codec!r} but "
                 "zstandard is not installed on this host"
             )
+        if expected_size is not None:
+            # Enforce the bomb bound BEFORE decompressing: zstandard's
+            # decompress allocates from the frame header's declared
+            # content size (max_output_size is ignored when the header
+            # carries one), so a corrupt/crafted header could demand a
+            # huge allocation. Our compressor always embeds the size.
+            params = zstd.get_frame_parameters(view)
+            if params.content_size not in (
+                expected_size,
+                zstd.CONTENTSIZE_UNKNOWN,
+            ):
+                raise RuntimeError(
+                    f"compressed payload declares {params.content_size} "
+                    f"bytes, expected {expected_size} ({codec})"
+                )
         out = zstd.ZstdDecompressor().decompress(
-            bytes(buf), max_output_size=expected_size or 0
+            view, max_output_size=expected_size or 0
         )
     elif name == "zlib":
         if expected_size is not None:
             # Honor the bomb bound: cap the output at expected_size and
             # require the stream to end exactly there.
             d = zlib.decompressobj()
-            out = d.decompress(bytes(buf), expected_size)
+            out = d.decompress(view, expected_size)
             if d.unconsumed_tail or d.decompress(b"", 1):
                 raise RuntimeError(
                     f"decompressed payload exceeds expected "
                     f"{expected_size} bytes (zlib)"
                 )
         else:
-            out = zlib.decompress(bytes(buf))
+            out = zlib.decompress(view)
     else:
         raise UnknownCodecError(
             f"snapshot payload records unknown codec {codec!r}; upgrade "
