@@ -411,7 +411,7 @@ def test_diff_does_not_flag_raw_vs_compressed_as_changed(tmp_path, capsys):
     assert main(["diff", a2, b2]) == 0
     out2 = capsys.readouterr().out
     assert "0 changed" in out2, out2
-    assert "0 indeterminate" in out2, out2
+    assert "3 unchanged" in out2, out2
 
 
 def test_zstd_bomb_header_rejected_before_allocation():
