@@ -24,19 +24,32 @@ from torchsnapshot_tpu import Snapshot, StateDict
 AWS_GATE = "TORCHSNAPSHOT_TPU_ENABLE_AWS_TEST"
 GCP_GATE = "TORCHSNAPSHOT_TPU_ENABLE_GCP_TEST"
 
+
+def _gate_on(name: str) -> bool:
+    # Same off-convention as the library's env flags: unset/0/empty/false
+    # all mean off (batcher.batching_enabled).
+    return os.environ.get(name, "0") not in ("0", "", "false")
+
+
 aws_gated = pytest.mark.skipif(
-    os.environ.get(AWS_GATE) is None,
-    reason=f"set {AWS_GATE}=1 (and _AWS_TEST_BUCKET) to run against real S3",
+    not _gate_on(AWS_GATE),
+    reason=f"set {AWS_GATE}=1 and TORCHSNAPSHOT_TPU_AWS_TEST_BUCKET to run "
+    "against real S3",
 )
 gcp_gated = pytest.mark.skipif(
-    os.environ.get(GCP_GATE) is None,
-    reason=f"set {GCP_GATE}=1 (and _GCP_TEST_BUCKET) to run against real GCS",
+    not _gate_on(GCP_GATE),
+    reason=f"set {GCP_GATE}=1 and TORCHSNAPSHOT_TPU_GCP_TEST_BUCKET to run "
+    "against real GCS",
 )
 
 
 def _bucket(kind: str) -> str:
     var = f"TORCHSNAPSHOT_TPU_{kind}_TEST_BUCKET"
-    bucket = os.environ.get(var, "torchsnapshot-tpu-test")
+    bucket = os.environ.get(var)
+    if not bucket:
+        # Never guess a bucket name: a squattable default could send real
+        # snapshot data to a third party's bucket.
+        pytest.skip(f"{var} not set; refusing to guess a bucket name")
     return bucket
 
 
@@ -45,11 +58,48 @@ def _roundtrip(url: str) -> None:
         w=np.random.default_rng(0).standard_normal(250_000).astype(np.float32),
         step=7,
     )
-    Snapshot.take(url, {"app": state})
-    dst = StateDict(w=np.zeros(250_000, np.float32), step=0)
-    Snapshot(url).restore({"app": dst})
-    np.testing.assert_array_equal(dst["w"], state["w"])
-    assert dst["step"] == 7
+    try:
+        Snapshot.take(url, {"app": state})
+        dst = StateDict(w=np.zeros(250_000, np.float32), step=0)
+        Snapshot(url).restore({"app": dst})
+        np.testing.assert_array_equal(dst["w"], state["w"])
+        assert dst["step"] == 7
+    finally:
+        _cleanup_snapshot(url)
+
+
+def _cleanup_snapshot(url: str) -> None:
+    """Best-effort: delete every payload the manifest names, then the
+    metadata — gated runs must not accrue orphaned objects in the test
+    bucket."""
+    import asyncio
+
+    from torchsnapshot_tpu.cli import _entry_payloads
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    try:
+        meta = Snapshot(url).metadata
+    except Exception:
+        return  # take never committed; nothing durable to clean
+    locations = {
+        location
+        for e in meta.manifest.values()
+        for location, _, _, _, _ in _entry_payloads(e)
+    }
+    plugin = url_to_storage_plugin(url)
+
+    async def run() -> None:
+        for location in locations:
+            try:
+                await plugin.delete(location)
+            except Exception:
+                pass
+        try:
+            await plugin.delete(".snapshot_metadata")
+        finally:
+            await plugin.close()
+
+    asyncio.new_event_loop().run_until_complete(run())
 
 
 def _plugin_ops(plugin) -> None:
@@ -96,10 +146,11 @@ def test_gcs_write_read_delete_real_bucket() -> None:
     _plugin_ops(GCSStoragePlugin(f"{_bucket('GCP')}/{uuid.uuid4()}"))
 
 
-def test_gate_markers_reference_real_env_vars() -> None:
-    """The skip conditions must track the documented env vars — a rename
-    on one side would silently never-run (or always-run) the suite."""
-    assert AWS_GATE == "TORCHSNAPSHOT_TPU_ENABLE_AWS_TEST"
-    assert GCP_GATE == "TORCHSNAPSHOT_TPU_ENABLE_GCP_TEST"
-    assert AWS_GATE in aws_gated.kwargs["reason"]
-    assert GCP_GATE in gcp_gated.kwargs["reason"]
+def test_gate_off_values_skip(monkeypatch) -> None:
+    """Exporting the gate as 0/empty/false must keep the suite OFF —
+    matching the library's env-flag convention."""
+    for off in ("0", "", "false"):
+        monkeypatch.setenv(AWS_GATE, off)
+        assert not _gate_on(AWS_GATE)
+    monkeypatch.setenv(AWS_GATE, "1")
+    assert _gate_on(AWS_GATE)

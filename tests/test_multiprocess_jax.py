@@ -141,3 +141,116 @@ def test_multiprocess_auto_replication(tmp_path) -> None:
         if f != ".snapshot_metadata"
     ]
     assert all(p.startswith("replicated/") for p in repl_files), repl_files
+
+
+def _full_flow_worker(rank, world_size, base_path, inc_path, mirror_base,
+                      mirror_inc, port):
+    """The production flow end to end under REAL jax.distributed:
+    sync take (digests + mirror) -> train step -> async_take incremental
+    (+ mirror) -> restore the incremental into a different layout."""
+    from jax.sharding import PartitionSpec as P
+
+    jax = _init_jax_dist(rank, world_size, port)
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    frozen = _make_global_array(jax, P("x", None))  # row-sharded, unchanged
+    head = np.full((4,), 1.0, np.float32)  # replicated host state, trains
+    app = {"m": StateDict(frozen=frozen, head=head, step=0)}
+    Snapshot.take(
+        base_path, app, record_digests=True,
+        replicated=["m/head"],
+        storage_options={"mirror_url": mirror_base},
+    )
+
+    head2 = head + 1.0  # the training step: only the head moves
+    app2 = {"m": StateDict(frozen=frozen, head=head2, step=1)}
+    pending = Snapshot.async_take(
+        inc_path, app2, incremental_base=base_path,
+        replicated=["m/head"],
+        storage_options={"mirror_url": mirror_inc},
+    )
+    pending.wait()
+
+    # Restore the incremental into a DIFFERENT layout (col-sharded).
+    dst = _make_global_array(jax, P(None, "x")) * 0
+    out = StateDict(frozen=dst, head=np.zeros((4,), np.float32), step=-1)
+    Snapshot(inc_path).restore({"m": out})
+    assert out["step"] == 1
+    np.testing.assert_array_equal(out["head"], head2)
+    for shard in out["frozen"].addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), _global_data()[shard.index]
+        )
+    return "ok"
+
+
+def test_multiprocess_4proc_async_incremental_mirror(tmp_path) -> None:
+    """VERDICT r2 item 7: 4 real processes, async_take + incremental +
+    mirrored storage together under jax.distributed."""
+    port = _find_free_port()
+    base, inc = str(tmp_path / "base"), str(tmp_path / "inc")
+    mb, mi = str(tmp_path / "mirror_base"), str(tmp_path / "mirror_inc")
+    results = run_with_subprocesses(
+        _full_flow_worker, 4, base, inc, mb, mi, port, timeout=360.0
+    )
+    assert all(v == "ok" for v in results.values())
+
+    # Dedup across processes: the unchanged sharded payloads must NOT be
+    # rewritten in the incremental (4 shard files in base, none in inc).
+    def shard_files(root):
+        return [
+            f
+            for dp, _, fs in os.walk(root)
+            for f in fs
+            if "m/frozen" in os.path.join(dp, f)
+        ]
+
+    assert len(shard_files(base)) == 4
+    assert len(shard_files(inc)) == 0, shard_files(inc)
+
+    # Both mirror tiers are committed, complete snapshots; the inc's
+    # mirror records the base's mirror for disaster recovery.
+    from torchsnapshot_tpu import Snapshot
+    from torchsnapshot_tpu.dedup import canonical_base_url
+
+    for tier in (mb, mi):
+        assert os.path.isfile(os.path.join(tier, ".snapshot_metadata")), tier
+    meta = Snapshot(mi).metadata
+    assert meta.origin_mirrors
+    assert meta.origin_mirrors.get(canonical_base_url(base)) == canonical_base_url(mb)
+
+
+def _staging_failure_worker(rank, world_size, snap_path, port):
+    """Rank 2's staging fails; EVERY rank must abort (the error rides the
+    manifest gather) and no metadata may be committed."""
+    from jax.sharding import PartitionSpec as P
+
+    jax = _init_jax_dist(rank, world_size, port)
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    if rank == 2:
+        from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+
+        def boom(self, arr):
+            raise RuntimeError("injected staging failure on rank 2")
+
+        ArrayBufferStager._stage_and_sum = boom
+
+    arr = _make_global_array(jax, P("x", None))
+    try:
+        Snapshot.take(snap_path, {"m": StateDict(emb=arr)})
+    except RuntimeError as e:
+        msg = str(e)
+        assert "injected staging failure" in msg or "peer rank" in msg, msg
+        return "aborted"
+    return "NOT-ABORTED"
+
+
+def test_multiprocess_4proc_staging_failure_aborts_all_ranks(tmp_path) -> None:
+    port = _find_free_port()
+    snap = str(tmp_path / "snap")
+    results = run_with_subprocesses(
+        _staging_failure_worker, 4, snap, port, timeout=360.0
+    )
+    assert all(v == "aborted" for v in results.values()), results
+    assert not os.path.exists(os.path.join(snap, ".snapshot_metadata"))
