@@ -1,6 +1,8 @@
 from .mesh import make_mesh, shard_pytree  # noqa: F401
 from .pipeline import (  # noqa: F401
+    pipeline_1f1b_spmd,
     pipeline_param_sharding,
     pipeline_spmd,
     pipelined_apply,
+    pipelined_value_and_grad,
 )
