@@ -36,6 +36,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 LayerFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def _varying_over(axes):
+    """Cast-to-device-varying over any of ``axes`` a value isn't already
+    varying on — scan carry initializers must declare the vma their
+    outputs will have (ppermute/axis_index make carries varying)."""
+
+    def cast(v):
+        vma = getattr(jax.typeof(v), "vma", frozenset())
+        missing = tuple(a for a in axes if a not in vma)
+        if missing:
+            return jax.lax.pcast(v, missing, to="varying")
+        return v
+
+    return cast
+
+
 def _stage_apply(stage_params: Any, x: jax.Array, layer_fn: LayerFn) -> jax.Array:
     """Apply this stage's layers (leading dim = local layers) in order."""
 
@@ -92,15 +107,8 @@ def pipeline_spmd(
     act0 = jnp.where(
         stage == 0, x_micro[0], jnp.zeros_like(x_micro[0])
     )
-    # The carry becomes device-varying over the pipe axis inside the scan
-    # (ppermute + axis_index); the initializers must declare that too.
     outs0 = jnp.zeros_like(x_micro)
-    vma = getattr(jax.typeof(outs0), "vma", frozenset())
-    if axis_name not in vma:
-        outs0 = jax.lax.pcast(outs0, (axis_name,), to="varying")
-    vma = getattr(jax.typeof(act0), "vma", frozenset())
-    if axis_name not in vma:
-        act0 = jax.lax.pcast(act0, (axis_name,), to="varying")
+    act0, outs0 = map(_varying_over((axis_name,)), (act0, outs0))
     (_, outs), _ = jax.lax.scan(tick, (act0, outs0), jnp.arange(n_ticks))
     # Everyone needs the outputs (e.g. for a replicated loss): zero out all
     # but the last stage's banked copy and sum over the pipe axis.
@@ -293,17 +301,6 @@ def pipeline_1f1b_spmd(
     # psums their cotangent over the batch axis each tick — grads stay
     # varying over the PIPE axis only.
     want_axes = tuple(varying_axes or (axis_name,))
-
-    def _varying_over(axes):
-        def cast(v):
-            vma = getattr(jax.typeof(v), "vma", frozenset())
-            missing = tuple(a for a in axes if a not in vma)
-            if missing:
-                return jax.lax.pcast(v, missing, to="varying")
-            return v
-
-        return cast
-
     carry0 = (
         *jax.tree_util.tree_map(_varying_over(want_axes), (act0, g0, stash0)),
         jax.tree_util.tree_map(_varying_over((axis_name,)), grads0),
