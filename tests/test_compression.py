@@ -420,3 +420,27 @@ def test_zstd_bomb_header_rejected_before_allocation():
     # entry lies: expected far smaller than the frame header declares
     with pytest.raises(RuntimeError, match="declares"):
         decompress("zstd:3", payload, expected_size=512)
+
+
+def test_interop_export_from_compressed_snapshot(tmp_path):
+    """Migrating a COMPRESSED native snapshot to the reference's on-disk
+    format must transparently decompress (the reference format has no
+    codec concept) — interop is unaffected by compression."""
+    from torchsnapshot_tpu.tricks.torchsnapshot_interop import (
+        load_torchsnapshot,
+        migrate_to_torchsnapshot,
+    )
+
+    native, exported = str(tmp_path / "native"), str(tmp_path / "exported")
+    state = _compressible_state()
+    Snapshot.take(native, {"app": state}, compression="zstd")
+    migrate_to_torchsnapshot(native, exported)
+
+    # the export is reference-format: read it back with the black-box
+    # reference reader and compare content
+    loaded = load_torchsnapshot(exported)
+    np.testing.assert_array_equal(np.asarray(loaded["app"]["w"]), state["w"])
+    np.testing.assert_array_equal(np.asarray(loaded["app"]["b"]), state["b"])
+    # no codec keys may leak into the reference-format metadata
+    meta = open(os.path.join(exported, ".snapshot_metadata")).read()
+    assert "codec" not in meta
