@@ -1,0 +1,210 @@
+"""CheckpointManager: cadence, retention, resume (manager.py).
+
+No reference analogue (the ecosystem analogue is orbax's
+CheckpointManager); composes the features the rest of the suite covers
+individually.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import CheckpointManager, Snapshot, StateDict
+
+
+def _state(v: float):
+    return StateDict(w=np.full((2048,), v, np.float32), step=int(v))
+
+
+def _names(root):
+    return sorted(
+        n
+        for n in os.listdir(root)
+        if os.path.isfile(os.path.join(root, n, ".snapshot_metadata"))
+    )
+
+
+def test_cadence_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=5)
+    for step in range(12):
+        saved = mgr.save(step, {"app": _state(step)})
+        assert saved == (step % 5 == 0), step
+    assert mgr.all_steps() == [0, 5, 10]
+    assert mgr.latest_step() == 10
+
+    # force saves off-cadence
+    mgr.save(12, {"app": _state(12)}, force=True)
+    assert mgr.latest_step() == 12
+
+
+def test_restore_latest_and_specific(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    for step in range(3):
+        mgr.save(step, {"app": _state(step)})
+
+    dst = _state(-1)
+    restored = mgr.restore({"app": dst})
+    assert restored == 2
+    np.testing.assert_array_equal(dst["w"], np.full((2048,), 2.0, np.float32))
+
+    dst = _state(-1)
+    assert mgr.restore({"app": dst}, step=1) == 1
+    assert dst["step"] == 1
+
+
+def test_keep_last_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, keep_last=2)
+    for step in range(5):
+        mgr.save(step, {"app": _state(step)})
+    assert mgr.all_steps() == [3, 4]
+
+
+def test_keep_every_archival(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, keep_last=1, keep_every=2
+    )
+    for step in range(5):
+        mgr.save(step, {"app": _state(step)})
+    # multiples of 2 survive as archival keeps; newest always survives
+    assert mgr.all_steps() == [0, 2, 4]
+
+
+def test_incremental_chain_bases_survive_retention(tmp_path):
+    """keep_last=1 with an incremental chain: the newest snapshot's
+    transitive bases must be SPARED (deleting them would break restore),
+    and restore from the survivor still works."""
+    mgr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, keep_last=1, incremental=True
+    )
+    # frozen payload identical across saves => every save after the first
+    # dedups against its predecessor, chaining back to step_0
+    frozen = np.arange(4096, dtype=np.float32)
+    for step in range(4):
+        state = StateDict(frozen=frozen, head=np.full((8,), float(step)))
+        mgr.save(step, {"app": state})
+
+    steps = mgr.all_steps()
+    assert 3 in steps  # the kept survivor
+    assert 0 in steps  # the chain's physical payload holder, spared
+    dst = StateDict(frozen=np.zeros(4096, np.float32), head=np.zeros(8))
+    assert mgr.restore({"app": dst}) == 3
+    np.testing.assert_array_equal(dst["frozen"], frozen)
+    np.testing.assert_array_equal(dst["head"], np.full((8,), 3.0))
+
+
+def test_async_save_single_inflight_and_wait(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, async_save=True, keep_last=2
+    )
+    for step in range(4):
+        mgr.save(step, {"app": _state(step)})
+    mgr.wait()
+    assert mgr.all_steps() == [2, 3]
+    dst = _state(-1)
+    assert mgr.restore({"app": dst}) == 3
+
+
+def test_resume_discovers_existing_snapshots(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, incremental=True
+    )
+    for step in range(2):
+        mgr.save(step, {"app": _state(step)})
+
+    # a NEW manager (fresh process) picks up where the old one left off:
+    # latest_step discovered, incremental chains against it
+    mgr2 = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, incremental=True
+    )
+    assert mgr2.latest_step() == 1
+    mgr2.save(2, {"app": _state(1)})  # same content as step 1 => dedups
+    meta = Snapshot(mgr2.path_for(2)).metadata
+    from torchsnapshot_tpu.cli import _entry_payloads
+
+    origins = [
+        o
+        for e in meta.manifest.values()
+        for _, _, _, _, o in _entry_payloads(e)
+    ]
+    assert any(o is not None for o in origins), "must chain to step 1"
+
+
+def test_compression_and_options_pass_through(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, compression="zlib:1"
+    )
+    state = StateDict(w=np.arange(100_000, dtype=np.float32))
+    mgr.save(0, {"app": state})
+    meta = Snapshot(mgr.path_for(0)).metadata
+    codecs = [
+        sub.array.codec
+        for e in meta.manifest.values()
+        for sub in getattr(e, "chunks", []) or []
+    ]
+    assert any(c and c.startswith("zlib") for c in codecs)
+
+
+def test_validation_errors(tmp_path):
+    with pytest.raises(ValueError, match="save_interval_steps"):
+        CheckpointManager(str(tmp_path), save_interval_steps=0)
+    with pytest.raises(ValueError, match="keep_last"):
+        CheckpointManager(str(tmp_path), keep_last=0)
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(ValueError, match="step must be"):
+        mgr.path_for(-1)
+    with pytest.raises(RuntimeError, match="no committed snapshots"):
+        mgr.restore({"app": _state(0)})
+
+
+def test_failed_async_save_raises_on_next_save(tmp_path, monkeypatch):
+    from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    class Faulty(FSStoragePlugin):
+        async def write(self, write_io) -> None:
+            if write_io.path != SNAPSHOT_METADATA_FNAME:
+                raise RuntimeError("injected storage failure")
+            await super().write(write_io)
+
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, async_save=True)
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin", Faulty
+    )
+    mgr.save(0, {"app": _state(0)})
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="injected storage failure"):
+        mgr.save(1, {"app": _state(1)})  # drains the failed pending first
+    # the failed save never committed
+    assert mgr.all_steps() == []
+
+
+def test_resume_step_is_never_overwritten(tmp_path):
+    """README resume recipe: the loop re-runs the restored step; a
+    re-save must NOT overwrite the committed snapshot (non-atomic, and
+    under incremental it would dedup against itself)."""
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1,
+                            incremental=True)
+    mgr.save(0, {"app": _state(0)})
+
+    mgr2 = CheckpointManager(str(tmp_path), save_interval_steps=1,
+                             incremental=True)
+    assert mgr2.latest_step() == 0
+    assert mgr2.save(0, {"app": _state(99)}) is False  # skipped
+    dst = _state(-1)
+    mgr2.restore({"app": dst})
+    assert dst["step"] == 0  # the original survived untouched
+    assert mgr2.save(1, {"app": _state(1)}) is True
+
+
+def test_foreign_snapshot_names_not_deleted(tmp_path):
+    """Snapshots the manager didn't name (unpadded, other tools) are
+    invisible to discovery and NEVER deleted by retention."""
+    foreign = tmp_path / "step_123"  # unpadded: not manager-named
+    Snapshot.take(str(foreign), {"app": _state(7)})
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, keep_last=1)
+    assert mgr.all_steps() == []  # not discovered
+    for step in range(3):
+        mgr.save(step, {"app": _state(step)})
+    assert mgr.all_steps() == [2]
+    assert (foreign / ".snapshot_metadata").exists()  # untouched

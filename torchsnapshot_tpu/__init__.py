@@ -9,5 +9,6 @@ try:
     from .state_dict import StateDict  # noqa: F401
     from .rng_state import RNGState  # noqa: F401
     from .snapshot import PendingRestore, PendingSnapshot, Snapshot  # noqa: F401
+    from .manager import CheckpointManager  # noqa: F401
 except ImportError:  # pragma: no cover - during incremental bring-up only
     pass

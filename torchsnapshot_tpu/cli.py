@@ -550,110 +550,29 @@ def _scan_snapshot_dir(dirpath: str):
 
 def cmd_prune(args: argparse.Namespace) -> int:
     import os
-    import shutil
+
+    from .retention import apply_retention, plan_retention
 
     if "://" in args.dir and not args.dir.startswith("fs://"):
         print("error: prune operates on local filesystem directories only",
               file=sys.stderr)
         return 2
     dirpath = args.dir[len("fs://"):] if args.dir.startswith("fs://") else args.dir
-    names, origins_of, origin_locations_of, payloads_of = _scan_snapshot_dir(dirpath)
-    if not names:
-        print(f"no snapshots found under {dirpath}")
-        return 2
     if args.keep < 1:
         print("error: --keep must be >= 1", file=sys.stderr)
         return 2
-
-    keep = set(names[-args.keep:])  # newest N by metadata mtime
-    canon_of = {
-        name: _canon_snapshot_url(os.path.join(dirpath, name)) for name in names
-    }
-    name_of_canon = {c: n for n, c in canon_of.items()}
-    # Every surviving snapshot's restore closure must survive. Origins name
-    # each payload's physical writer directly, but a SPARED base's own
-    # payloads can reference yet another snapshot the kept set never
-    # mentions — so the required set is a transitive closure via a
-    # worklist, not one pass over the kept snapshots.
-    required_names = set()
-    by_name_matches = set()
-    unresolved = set()
-    frontier = list(keep)
-    visited = set()
-    while frontier:
-        name = frontier.pop()
-        if name in visited:
-            continue
-        visited.add(name)
-        for origin in origins_of.get(name, ()):
-            canon = _canon_snapshot_url(origin)
-            locations = origin_locations_of.get(name, {}).get(origin, {})
-
-            def _holds_payloads(candidate: str) -> bool:
-                # Identity, not identity of path/name or mere file
-                # existence: an unrelated snapshot of the SAME model
-                # (same tree shape, same sizes, different values) can
-                # occupy the base's old path or name. The deduplicated
-                # entry recorded the payload's content checksum at take
-                # time; the true base's manifest records the same
-                # checksum for the same bytes — compare them. Only
-                # checksum-less legacy snapshots fall back to
-                # size + file existence.
-                cand = payloads_of.get(candidate, {})
-                if not locations:
-                    return False
-                for loc, (csum, nbytes) in locations.items():
-                    have = cand.get(loc)
-                    if have is None:
-                        return False
-                    have_csum, have_nbytes = have
-                    if csum is not None and have_csum is not None:
-                        if csum != have_csum:
-                            return False
-                    elif (
-                        nbytes is not None
-                        and have_nbytes is not None
-                        and nbytes != have_nbytes
-                    ):
-                        return False
-                    if not os.path.isfile(
-                        os.path.join(dirpath, candidate, loc)
-                    ):
-                        return False
-                return True
-
-            base_name = name_of_canon.get(canon)
-            if base_name is not None and not _holds_payloads(base_name):
-                base_name = None
-            if base_name is None:
-                # Origins record absolute realpaths at take time. If the
-                # tree was moved/copied or is scanned via a different
-                # mount path, those paths resolve to nothing here — a
-                # same-basename snapshot holding the referenced payloads
-                # is the moved base.
-                tail = os.path.basename(canon.rstrip("/"))
-                if tail in origins_of and _holds_payloads(tail):
-                    base_name = tail
-                    by_name_matches.add(tail)
-            if base_name is None:
-                unresolved.add(canon)
-                continue
-            required_names.add(base_name)
-            if base_name not in visited:
-                frontier.append(base_name)
-    spared, doomed = [], []
-    for name in names:
-        if name in keep:
-            continue
-        if name in required_names:
-            spared.append(name)
-        else:
-            doomed.append(name)
-
-    for name in sorted(keep):
+    # One scan for both discovery and the plan: the keep-N policy is
+    # evaluated inside plan_retention on its own scan, so a snapshot
+    # committing concurrently can never be discovered-but-unprotected.
+    plan = plan_retention(dirpath, args.keep)
+    if not (plan.keep or plan.spared or plan.doomed):
+        print(f"no snapshots found under {dirpath}")
+        return 2
+    unresolved, doomed = plan.unresolved, plan.doomed
+    for name in plan.keep:
         print(f"keep    {name}")
-    for name in spared:
-        suffix = ", matched by name" if name in by_name_matches else ""
+    for name, by_name in plan.spared:
+        suffix = ", matched by name" if by_name else ""
         print(f"keep    {name}  (base of a kept snapshot{suffix})")
     for name in doomed:
         print(f"delete  {name}")
@@ -682,9 +601,8 @@ def cmd_prune(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 2
-    for name in doomed:
-        shutil.rmtree(os.path.join(dirpath, name))
-    print(f"deleted {len(doomed)} snapshot(s)")
+    n = apply_retention(dirpath, plan)
+    print(f"deleted {n} snapshot(s)")
     return 0
 
 

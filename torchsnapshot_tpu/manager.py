@@ -1,0 +1,270 @@
+"""CheckpointManager: training-loop cadence + retention over Snapshot.
+
+The layer a training loop actually wants (orbax's ``CheckpointManager``
+is the ecosystem analogue; the reference has no equivalent): call
+``save(step, app_state)`` every step and the manager decides when a
+snapshot is due, names it, chains it incrementally against the previous
+one, keeps the retention policy enforced, and exposes
+``latest_step``/``restore`` for resume. It composes every Snapshot
+feature — async saves, incremental dedup, compression, mirrored
+two-tier storage — through plain constructor arguments::
+
+    mgr = CheckpointManager(
+        "fs:///ckpts",
+        save_interval_steps=1000,
+        keep_last=3,            # newest 3 survive
+        keep_every=10_000,      # plus archival keeps at these steps
+        async_save=True,        # block only for staging
+        incremental=True,       # dedup against the previous snapshot
+        compression="zstd",
+        storage_options={"mirror_url": "gs://bucket/ckpts"},
+    )
+    for step in range(n_steps):
+        ...
+        mgr.save(step, app_state)     # no-op unless due
+    mgr.wait()                        # drain a pending async save
+
+    # on restart:
+    step = mgr.latest_step()
+    if step is not None:
+        mgr.restore(app_state)
+
+Semantics worth knowing:
+
+- Snapshots live at ``<root>/step_<N:010d>`` (lexical sort == numeric).
+- At most ONE async save is in flight; a due save first drains the
+  previous pending one (its retention pass included).
+- Retention runs on rank 0 after each commit, via
+  :func:`~torchsnapshot_tpu.retention.plan_retention`: the newest
+  ``keep_last`` and every ``keep_every`` multiple survive, PLUS any
+  snapshot that is a (transitively, checksum-verified) required base of
+  a survivor. Snapshots whose bases cannot be resolved are never
+  deleted. Retention — and ``latest_step`` discovery — need a local
+  filesystem root; on remote roots retention is skipped and resume
+  needs an explicit ``step=``.
+- ``incremental=True`` records digests on every save and chains each
+  snapshot to the previous COMMITTED one; retention's base-closure
+  keeps chains restorable (consolidate before archiving elsewhere).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+from .pg_wrapper import PGWrapper, ProcessGroup
+from .snapshot import PendingSnapshot, Snapshot
+from .stateful import AppState
+
+logger = logging.getLogger(__name__)
+
+# Only the manager's OWN naming (10-digit zero-padded) is discovered:
+# accepting foreign step_<N> spellings would make latest_step() find
+# snapshots that path_for()/retention then address under a different
+# (padded) name — unreachable by restore and wrongly deletable.
+_STEP_RE = re.compile(r"^step_(\d{10})$")
+
+
+def _step_name(step: int) -> str:
+    if step < 0:
+        raise ValueError(f"step must be >= 0, got {step}")
+    return f"step_{step:010d}"
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        root: str,
+        *,
+        save_interval_steps: int = 1,
+        keep_last: Optional[int] = None,
+        keep_every: Optional[int] = None,
+        async_save: bool = False,
+        incremental: bool = False,
+        compression: Optional[str] = None,
+        replicated: Optional[List[str]] = None,
+        storage_options: Optional[Dict[str, Any]] = None,
+        pg: Optional[ProcessGroup] = None,
+    ) -> None:
+        if save_interval_steps < 1:
+            raise ValueError("save_interval_steps must be >= 1")
+        if keep_last is not None and keep_last < 1:
+            raise ValueError("keep_last must be >= 1 (or None to keep all)")
+        if keep_every is not None and keep_every < 1:
+            raise ValueError("keep_every must be >= 1 (or None)")
+        self.root = root
+        self.save_interval_steps = save_interval_steps
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self.async_save = async_save
+        self.incremental = incremental
+        self.compression = compression
+        self.replicated = replicated
+        self.storage_options = storage_options
+        self.pg = pg
+        self._pending: Optional[PendingSnapshot] = None
+        self._pending_step: Optional[int] = None
+        self._last_committed: Optional[int] = self.latest_step()
+
+    # ----------------------------------------------------------- paths
+
+    def _local_dir(self) -> Optional[str]:
+        if self.root.startswith("fs://"):
+            return self.root[len("fs://"):]
+        if "://" in self.root:
+            return None
+        return self.root
+
+    def path_for(self, step: int) -> str:
+        sep = "" if self.root.endswith("/") else "/"
+        return f"{self.root}{sep}{_step_name(step)}"
+
+    # ------------------------------------------------------- inventory
+
+    def all_steps(self) -> List[int]:
+        """Committed steps under a local root, ascending ([] for remote)."""
+        dirpath = self._local_dir()
+        if dirpath is None or not os.path.isdir(dirpath):
+            return []
+        steps = []
+        for name in os.listdir(dirpath):
+            m = _STEP_RE.match(name)
+            if m and os.path.isfile(
+                os.path.join(dirpath, name, ".snapshot_metadata")
+            ):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ------------------------------------------------------------ save
+
+    def should_save(self, step: int) -> bool:
+        return step % self.save_interval_steps == 0
+
+    def save(self, step: int, app_state: AppState, *, force: bool = False) -> bool:
+        """Snapshot ``app_state`` if ``step`` is due (or ``force``).
+
+        Returns True when a save was started/completed. Blocks only for
+        staging when ``async_save`` (draining any previous pending save
+        first — one in flight at a time)."""
+        if not force and not self.should_save(step):
+            return False
+        self.wait()  # at most one pending; also runs its retention
+        if step == self._last_committed or (
+            self._local_dir() is not None and step in self.all_steps()
+        ):
+            # Resume loops re-run the restored step (README recipe); a
+            # re-save would overwrite the committed snapshot in place —
+            # non-atomically, and under incremental=True with ITSELF as
+            # the dedup base. Never overwrite a committed step.
+            logger.info("step %d already has a committed snapshot; skipping", step)
+            return False
+
+        path = self.path_for(step)
+        base = (
+            self.path_for(self._last_committed)
+            if self.incremental and self._last_committed is not None
+            else None
+        )
+        kwargs: Dict[str, Any] = dict(
+            pg=self.pg,
+            replicated=self.replicated,
+            storage_options=self.storage_options,
+            incremental_base=base,
+            record_digests=self.incremental,
+            compression=self.compression,
+        )
+        if self.async_save:
+            self._pending = Snapshot.async_take(path, app_state, **kwargs)
+            self._pending_step = step
+        else:
+            Snapshot.take(path, app_state, **kwargs)
+            self._committed(step)
+        return True
+
+    def wait(self) -> None:
+        """Drain a pending async save (no-op otherwise); re-raises its
+        failure. Runs the retention pass for the committed snapshot."""
+        if self._pending is None:
+            return
+        pending, step = self._pending, self._pending_step
+        self._pending = None
+        self._pending_step = None
+        pending.wait()
+        assert step is not None
+        self._committed(step)
+
+    def _committed(self, step: int) -> None:
+        self._last_committed = step
+        self._apply_retention()
+
+    # ------------------------------------------------------- retention
+
+    def _keep_names(self, names: List[str]) -> set:
+        """The keep policy, evaluated on plan_retention's own scan."""
+        steps = sorted(
+            int(m.group(1)) for m in map(_STEP_RE.match, names) if m
+        )
+        keep = set(steps[-self.keep_last:]) if self.keep_last else set(steps)
+        if self.keep_every is not None:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        kept_names = {_step_name(s) for s in keep}
+        # Foreign (non-manager-named) snapshots in the directory are not
+        # this manager's to delete.
+        kept_names.update(n for n in names if not _STEP_RE.match(n))
+        return kept_names
+
+    def _apply_retention(self) -> None:
+        # keep_every without keep_last prunes nothing (every step is
+        # kept); only keep_last bounds the set.
+        if self.keep_last is None:
+            return
+        if PGWrapper(self.pg).get_rank() != 0:
+            return  # commit already barriered; rank 0 owns deletion
+        dirpath = self._local_dir()
+        if dirpath is None:
+            logger.debug("remote root %s: retention skipped", self.root)
+            return
+        from .retention import apply_retention, plan_retention
+
+        plan = plan_retention(dirpath, self._keep_names)
+        if plan.unresolved:
+            logger.warning(
+                "retention: kept snapshot(s) under %s reference base(s) "
+                "outside this directory (%s); nothing unsafe is deleted",
+                dirpath,
+                ", ".join(sorted(plan.unresolved)),
+            )
+        n = apply_retention(dirpath, plan)
+        if n:
+            logger.info(
+                "retention: deleted %d snapshot(s) under %s (kept %d + %d "
+                "required base(s))",
+                n,
+                dirpath,
+                len(plan.keep),
+                len(plan.spared),
+            )
+
+    # --------------------------------------------------------- restore
+
+    def restore(self, app_state: AppState, step: Optional[int] = None) -> int:
+        """Restore ``app_state`` from ``step`` (default: latest). Returns
+        the step restored from."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise RuntimeError(
+                    f"no committed snapshots under {self.root} (remote "
+                    "roots need an explicit step=)"
+                )
+        Snapshot(
+            self.path_for(step), pg=self.pg, storage_options=self.storage_options
+        ).restore(app_state)
+        return step

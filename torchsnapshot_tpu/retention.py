@@ -1,0 +1,151 @@
+"""Retention planning for snapshot directories.
+
+Shared by the ``prune`` CLI and :class:`~torchsnapshot_tpu.manager.
+CheckpointManager`: given the snapshots the caller wants to KEEP, compute
+which others must be SPARED anyway (transitive bases of kept incremental
+snapshots — deleting one would break restore) and which are safe to
+delete. Base matching verifies payload-content checksums from the
+manifests, not mere path/name/file existence — an unrelated snapshot of
+the same model occupying a base's old path must never be spared in its
+place (see cli.py's prune tests for the attack shapes).
+
+A directory "snapshot" here is a subdirectory holding a committed
+``.snapshot_metadata``; ordering is metadata mtime (name-tiebroken).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Sequence, Set, Tuple, Union
+
+
+@dataclass
+class RetentionPlan:
+    """What survives and what may be deleted under a retention policy."""
+
+    keep: List[str]                       # caller-requested survivors
+    spared: List[Tuple[str, bool]]        # (name, matched_by_basename)
+    doomed: List[str]                     # deletable, oldest first
+    # Origins of kept snapshots that resolve to NO verified snapshot in
+    # the directory: deletion cannot be proven safe while these exist.
+    unresolved: Set[str] = field(default_factory=set)
+
+
+KeepPolicy = Union[int, Callable[[Sequence[str]], Set[str]]]
+
+
+def plan_retention(dirpath: str, keep: KeepPolicy) -> RetentionPlan:
+    """Plan deletion of snapshots under ``dirpath`` not kept by ``keep``
+    and not a (transitively) required base of a kept one.
+
+    ``keep`` is either the number of NEWEST snapshots to keep, or a
+    callable receiving the scanned names (mtime-ascending) and returning
+    the set to keep. The policy is evaluated on the SAME directory scan
+    the plan is built from — a snapshot that commits concurrently is
+    either in both or in neither, never discovered-but-unprotected."""
+    from .cli import _canon_snapshot_url, _scan_snapshot_dir
+
+    names, origins_of, origin_locations_of, payloads_of = _scan_snapshot_dir(
+        dirpath
+    )
+    if callable(keep):
+        keep = set(keep(names)) & set(names)
+    else:
+        keep = set(names[-int(keep):]) if keep else set()
+    canon_of = {
+        name: _canon_snapshot_url(os.path.join(dirpath, name)) for name in names
+    }
+    name_of_canon = {c: n for n, c in canon_of.items()}
+
+    # Every surviving snapshot's restore closure must survive. Origins
+    # name each payload's physical writer directly, but a SPARED base's
+    # own payloads can reference yet another snapshot the kept set never
+    # mentions — the required set is a transitive closure via a worklist.
+    required_names: Set[str] = set()
+    by_name_matches: Set[str] = set()
+    unresolved: Set[str] = set()
+    frontier = list(keep)
+    visited: Set[str] = set()
+    while frontier:
+        name = frontier.pop()
+        if name in visited:
+            continue
+        visited.add(name)
+        for origin in origins_of.get(name, ()):
+            canon = _canon_snapshot_url(origin)
+            locations = origin_locations_of.get(name, {}).get(origin, {})
+
+            def _holds_payloads(candidate: str) -> bool:
+                # Identity, not identity of path/name or mere file
+                # existence: compare the content checksums the kept
+                # snapshot's deduplicated entries recorded against the
+                # candidate's own manifest; checksum-less legacy
+                # snapshots fall back to size + file existence.
+                cand = payloads_of.get(candidate, {})
+                if not locations:
+                    return False
+                for loc, (csum, nbytes) in locations.items():
+                    have = cand.get(loc)
+                    if have is None:
+                        return False
+                    have_csum, have_nbytes = have
+                    if csum is not None and have_csum is not None:
+                        if csum != have_csum:
+                            return False
+                    elif (
+                        nbytes is not None
+                        and have_nbytes is not None
+                        and nbytes != have_nbytes
+                    ):
+                        return False
+                    if not os.path.isfile(
+                        os.path.join(dirpath, candidate, loc)
+                    ):
+                        return False
+                return True
+
+            base_name = name_of_canon.get(canon)
+            if base_name is not None and not _holds_payloads(base_name):
+                base_name = None
+            if base_name is None:
+                # Origins record absolute realpaths at take time: after a
+                # tree move (or a different mount path) they resolve to
+                # nothing here — a same-basename snapshot holding the
+                # referenced payloads is the moved base.
+                tail = os.path.basename(canon.rstrip("/"))
+                if tail in origins_of and _holds_payloads(tail):
+                    base_name = tail
+                    by_name_matches.add(tail)
+            if base_name is None:
+                unresolved.add(canon)
+                continue
+            required_names.add(base_name)
+            if base_name not in visited:
+                frontier.append(base_name)
+
+    spared: List[Tuple[str, bool]] = []
+    doomed: List[str] = []
+    for name in names:
+        if name in keep:
+            continue
+        if name in required_names:
+            spared.append((name, name in by_name_matches))
+        else:
+            doomed.append(name)
+    return RetentionPlan(
+        keep=sorted(keep),
+        spared=spared,
+        doomed=doomed,
+        unresolved=unresolved,
+    )
+
+
+def apply_retention(dirpath: str, plan: RetentionPlan) -> int:
+    """Delete the plan's doomed snapshots; returns how many. The caller
+    decides policy for ``plan.unresolved`` (refuse / warn / proceed)."""
+    import shutil
+
+    for name in plan.doomed:
+        shutil.rmtree(os.path.join(dirpath, name))
+    return len(plan.doomed)
