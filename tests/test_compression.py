@@ -444,3 +444,11 @@ def test_interop_export_from_compressed_snapshot(tmp_path):
     # no codec keys may leak into the reference-format metadata
     meta = open(os.path.join(exported, ".snapshot_metadata")).read()
     assert "codec" not in meta
+
+
+def test_read_object_decompresses(tmp_path):
+    root = str(tmp_path / "s")
+    state = _compressible_state()
+    Snapshot.take(root, {"app": state}, compression="zstd")
+    w = Snapshot(root).read_object("0/app/w")
+    np.testing.assert_array_equal(np.asarray(w), state["w"])
