@@ -208,3 +208,39 @@ def test_foreign_snapshot_names_not_deleted(tmp_path):
         mgr.save(step, {"app": _state(step)})
     assert mgr.all_steps() == [2]
     assert (foreign / ".snapshot_metadata").exists()  # untouched
+
+
+def test_mirror_url_is_per_step(tmp_path):
+    """A configured mirror_url is the mirror ROOT: each step must mirror
+    into its own subdirectory (a shared directory would overwrite the
+    previous step's replica in place), and restore's mirror fallback
+    must look in the right one."""
+    mirror_root = tmp_path / "mirror"
+    mgr = CheckpointManager(
+        str(tmp_path / "primary"), save_interval_steps=1,
+        storage_options={"mirror_url": str(mirror_root)},
+    )
+    for step in range(2):
+        mgr.save(step, {"app": _state(step)})
+
+    # each step has its own complete, independently restorable replica
+    for step in range(2):
+        mdir = mirror_root / f"step_{step:010d}"
+        assert (mdir / ".snapshot_metadata").exists()
+        dst = _state(-1)
+        Snapshot(str(mdir)).restore({"app": dst})
+        assert dst["step"] == step
+
+    # primary loses a payload; restore falls back to THAT step's mirror
+    victims = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(tmp_path / "primary" / "step_0000000001")
+        for f in fs
+        if f != ".snapshot_metadata"
+    ]
+    assert victims
+    for v in victims:
+        os.remove(v)
+    dst = _state(-1)
+    assert mgr.restore({"app": dst}, step=1) == 1
+    assert dst["step"] == 1

@@ -121,6 +121,18 @@ class CheckpointManager:
         sep = "" if self.root.endswith("/") else "/"
         return f"{self.root}{sep}{_step_name(step)}"
 
+    def _options_for(self, step: int) -> Optional[Dict[str, Any]]:
+        """Per-save storage options: a configured ``mirror_url`` is the
+        mirror ROOT — each step mirrors into its own subdirectory, or
+        every step's replica would overwrite the previous one's payloads
+        and metadata in place."""
+        if not self.storage_options or not self.storage_options.get("mirror_url"):
+            return self.storage_options
+        opts = dict(self.storage_options)
+        mirror_root = opts["mirror_url"].rstrip("/")
+        opts["mirror_url"] = f"{mirror_root}/{_step_name(step)}"
+        return opts
+
     # ------------------------------------------------------- inventory
 
     def all_steps(self) -> List[int]:
@@ -174,7 +186,7 @@ class CheckpointManager:
         kwargs: Dict[str, Any] = dict(
             pg=self.pg,
             replicated=self.replicated,
-            storage_options=self.storage_options,
+            storage_options=self._options_for(step),
             incremental_base=base,
             record_digests=self.incremental,
             compression=self.compression,
@@ -265,6 +277,7 @@ class CheckpointManager:
                     "roots need an explicit step=)"
                 )
         Snapshot(
-            self.path_for(step), pg=self.pg, storage_options=self.storage_options
+            self.path_for(step), pg=self.pg,
+            storage_options=self._options_for(step),
         ).restore(app_state)
         return step
