@@ -363,6 +363,19 @@ def test_zlib_decompress_honors_expected_size_bound():
         decompress("zlib:6", packed, expected_size=1024)
 
 
+def test_zlib_trailing_garbage_rejected():
+    """Bytes appended after a complete zlib stream must be rejected even
+    when the stream itself decompresses to exactly expected_size — with
+    checksums disabled, nothing downstream would catch the mutation."""
+    import zlib as _zlib
+
+    data = b"B" * 4096
+    packed = _zlib.compress(data, 6)
+    assert decompress("zlib:6", packed, expected_size=len(data)) == data
+    with pytest.raises(RuntimeError, match="trailing"):
+        decompress("zlib:6", packed + b"junk", expected_size=len(data))
+
+
 def test_dedup_keeps_verify_coverage_for_checksumless_raw_base(tmp_path, monkeypatch):
     """Base saved with checksums disabled (raw): the deduplicated entry
     in the incremental must still get a checksum computed from the

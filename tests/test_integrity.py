@@ -264,6 +264,36 @@ def test_staging_pool_recycles_on_gc():
     assert pool._free_bytes <= 1 << 20
 
 
+def test_staging_pool_derived_view_pins_slab():
+    """A numpy-level slice of a pooled buffer must keep the slab checked
+    out even after the originally-returned array dies — otherwise the
+    slab is recycled and handed to a new owner while the derived view
+    still aliases it (silent checkpoint corruption)."""
+    import gc
+
+    import numpy as np
+
+    from torchsnapshot_tpu.io_preparers.array import _StagingPool
+
+    pool = _StagingPool(limit_bytes=1 << 20)
+    buf = pool.get(4096)
+    buf[:] = 7
+    view = buf[10:20]  # numpy slice, NOT a memoryview
+    ptr = buf.ctypes.data
+    del buf
+    gc.collect()
+    # The slab must NOT come back while `view` aliases it.
+    other = pool.get(4096)
+    assert other.ctypes.data != ptr
+    other[:] = 99
+    assert np.all(view == 7)  # new owner's writes are not visible
+    del view, other
+    gc.collect()
+    # With all references dead, the slab finally recycles.
+    free_ptrs = {s.ctypes.data for slabs in pool._free.values() for s in slabs}
+    assert ptr in free_ptrs
+
+
 def test_async_take_fused_checksum_verifies_on_restore(tmp_path):
     """async_take stages through the fused copy+CRC path (consistency
     copy + checksum in one pass); the recorded checksums must verify on

@@ -244,3 +244,32 @@ def test_mirror_url_is_per_step(tmp_path):
     dst = _state(-1)
     assert mgr.restore({"app": dst}, step=1) == 1
     assert dst["step"] == 1
+
+
+def _committed_skip_worker(rank, world_size, roots):
+    """Per-rank (NON-shared) roots: only rank 0's root carries the
+    committed step_0 snapshot, so a rank-local `step in all_steps()`
+    check would make rank 0 skip while peers enter the collective
+    Snapshot.take and hang. The decision must be rank 0's, broadcast."""
+    from torchsnapshot_tpu.manager import CheckpointManager
+    from torchsnapshot_tpu.pg_wrapper import get_default_pg
+
+    mgr = CheckpointManager(roots[rank], pg=get_default_pg())
+    saved = mgr.save(0, {"app": _state(0.0)})
+    return saved
+
+
+def test_committed_skip_is_rank0_broadcast(tmp_path):
+    """A prior run committed step 0 on rank 0's root only; every rank of
+    the resumed world must uniformly skip re-saving it (no hang, no
+    non-atomic overwrite)."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    world = 2
+    roots = [str(tmp_path / f"rank{r}") for r in range(world)]
+    # Seed rank 0's root with a committed step_0 from a "previous run".
+    CheckpointManager(roots[0]).save(0, {"app": _state(0.0)})
+    assert _names(roots[0]) == ["step_0000000000"]
+
+    results = run_with_subprocesses(_committed_skip_worker, world, roots)
+    assert results == {0: False, 1: False}

@@ -160,6 +160,14 @@ def decompress(codec: str, buf, expected_size: Optional[int] = None):
                     f"decompressed payload exceeds expected "
                     f"{expected_size} bytes (zlib)"
                 )
+            if d.eof and d.unused_data:
+                # Trailing bytes after a complete stream: with checksums
+                # disabled nothing else would catch the mutation (the
+                # stream itself decompressed to exactly expected_size).
+                raise RuntimeError(
+                    f"{len(d.unused_data)} trailing bytes after zlib "
+                    "stream end; stored payload is corrupt"
+                )
         else:
             out = zlib.decompress(view)
     else:

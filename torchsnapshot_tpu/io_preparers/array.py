@@ -18,6 +18,7 @@ import asyncio
 import contextlib
 import contextvars
 import os
+import sys
 import threading
 import weakref
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -65,16 +66,45 @@ STAGING_POOL_ENV_VAR = "TORCHSNAPSHOT_TPU_STAGING_POOL_BYTES"
 _DEFAULT_STAGING_POOL_BYTES = 4 << 30
 
 
+# Pure-Python buffer exporters (__buffer__) are honored from CPython 3.12
+# (PEP 688); earlier interpreters cannot express the holder pattern below,
+# so they skip pooling entirely — correctness over recycling.
+_BUFFER_PROTOCOL_OK = sys.version_info >= (3, 12)
+
+
+class _SlabHolder:
+    """Weakref-able buffer exporter that owns a pooled slab (PEP 688).
+
+    Arrays built over this holder (``np.frombuffer(holder)``) record it —
+    not the slab — as their base, and numpy's base-chain collapsing stops
+    at the first non-ndarray base: every numpy view derived from the
+    staged buffer therefore keeps the holder (and through it the slab)
+    alive. Attaching the recycle finalizer to a plain ndarray view would
+    not have this property — numpy collapses ndarray base chains, so a
+    derived slice would reference the slab directly and the intermediate
+    view could die (recycling the slab) while the slice still aliases it.
+    """
+
+    __slots__ = ("__weakref__", "_slab")
+
+    def __init__(self, slab: np.ndarray) -> None:
+        self._slab = slab
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return memoryview(self._slab)
+
+
 class _StagingPool:
     """Bounded free-list of staging buffers, recycled by the GC.
 
     A training loop calls async_take every N minutes; without a pool each
     call allocates the full state size in fresh buffers, and on
     lazily-backed VMs first-touch page faults cost several x the copy
-    itself. ``get`` returns a view of a pooled slab with a finalizer:
-    when every reference dies (scheduler, storage plugin, a mirror's
-    background replica — whoever holds it longest), the slab returns to
-    the free list. GC-driven recycling means no component needs an
+    itself. ``get`` returns an array over a pooled slab whose base is a
+    ``_SlabHolder`` carrying a finalizer: when every reference dies
+    (scheduler, storage plugin, a mirror's background replica, any numpy
+    view a consumer derived — whoever holds it longest), the slab returns
+    to the free list. GC-driven recycling means no component needs an
     explicit release call, and a buffer still referenced anywhere can
     never be handed out again."""
 
@@ -85,6 +115,8 @@ class _StagingPool:
         self._free_bytes = 0
 
     def get(self, nbytes: int) -> np.ndarray:
+        if not _BUFFER_PROTOCOL_OK:  # pragma: no cover (3.12 CI)
+            return np.empty(nbytes, np.uint8)
         with self._lock:
             slabs = self._free.get(nbytes)
             base = slabs.pop() if slabs else None
@@ -92,9 +124,9 @@ class _StagingPool:
                 self._free_bytes -= nbytes
         if base is None:
             base = np.empty(nbytes, np.uint8)
-        out = base[:]
-        weakref.finalize(out, self._put, base)
-        return out
+        holder = _SlabHolder(base)
+        weakref.finalize(holder, self._put, base)
+        return np.frombuffer(holder, np.uint8)
 
     def _put(self, base: np.ndarray) -> None:
         with self._lock:

@@ -158,6 +158,37 @@ class CheckpointManager:
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0
 
+    def _already_committed(self, step: int) -> bool:
+        """Collectively-consistent "step already has a committed snapshot".
+
+        ``.snapshot_metadata`` is written by rank 0 only, so the on-disk
+        scan is meaningful only there: on a non-shared per-rank root a
+        rank-local check would let rank 0 skip while other ranks enter the
+        collective ``Snapshot.take`` and hang. Rank 0 decides; the
+        decision is broadcast so every rank takes the same branch. On
+        remote roots there is nothing to scan — only the in-memory
+        ``_last_committed`` (seeded by this manager's own saves/restores)
+        guards against re-saving, so a freshly-constructed manager on a
+        remote root cannot detect a prior run's committed step.
+        """
+        def local_opinion() -> bool:
+            # _last_committed is seeded from a rank-local disk scan at
+            # construction, so even this fast path can diverge across
+            # ranks — it must stay inside the broadcast.
+            return (
+                step == self._last_committed
+                or (self._local_dir() is not None and step in self.all_steps())
+            )
+
+        pg = PGWrapper(self.pg)
+        if pg.get_world_size() == 1:
+            return local_opinion()
+        committed = local_opinion() if pg.get_rank() == 0 else None
+        try:
+            return bool(pg.broadcast_object(committed, src=0))
+        finally:
+            pg.retire()  # release the handshake/bcast store keys
+
     def save(self, step: int, app_state: AppState, *, force: bool = False) -> bool:
         """Snapshot ``app_state`` if ``step`` is due (or ``force``).
 
@@ -167,9 +198,7 @@ class CheckpointManager:
         if not force and not self.should_save(step):
             return False
         self.wait()  # at most one pending; also runs its retention
-        if step == self._last_committed or (
-            self._local_dir() is not None and step in self.all_steps()
-        ):
+        if self._already_committed(step):
             # Resume loops re-run the restored step (README recipe); a
             # re-save would overwrite the committed snapshot in place —
             # non-atomically, and under incremental=True with ITSELF as
@@ -280,4 +309,11 @@ class CheckpointManager:
             self.path_for(step), pg=self.pg,
             storage_options=self._options_for(step),
         ).restore(app_state)
+        # Seed the re-save guard: a resumed loop re-runs this step and
+        # calls save(step) again; on remote roots this in-memory mark is
+        # the ONLY thing preventing a non-atomic in-place overwrite of
+        # the committed snapshot. Also makes the next incremental save
+        # chain against the restored step. Deliberately NOT _committed():
+        # restoring must not trigger a retention pass.
+        self._last_committed = step
         return step
