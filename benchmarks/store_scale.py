@@ -1,0 +1,218 @@
+"""Coordination-plane stress at pod-scale world sizes.
+
+The snapshot commit path rides one TCP KV server (dist_store._StoreServer)
+for ALL metadata traffic: per-key lockstep barriers, the replication
+negotiation gathers, and — the heavyweight — the full-manifest all-gather
+at commit (snapshot.py). The north star is a v5p-128 pod: 128 ranks, a
+manifest with tens of thousands of shard entries. This benchmark stands up
+one real server and drives it with `world` thread-ranks, each over its own
+TCP connection, measuring:
+
+1. ``barrier``    — p50/p99 wall per full-world PGWrapper.barrier round.
+2. ``gather``     — the commit-path shape: every rank contributes a
+                    manifest shard (``entries_per_rank`` ArrayEntry-shaped
+                    dicts) and receives all world shards. Reports wall,
+                    server-side payload traffic, and per-rank RTT counts.
+3. ``lockstep``   — K sequential broadcast+barrier cycles (the per-key
+                    lockstep pattern in Snapshot's restore/save loops).
+
+Thread-ranks on one host measure the SERVER's scalability (requests ride
+real sockets); client-side GIL contention makes absolute walls pessimistic
+vs a real pod where each rank is its own host. No O(world²) blowup must
+appear: gather wall should grow ~linearly in world (payload volume), not
+quadratically (round trips).
+
+Usage: python benchmarks/store_scale.py [--worlds 32,64,128]
+                                        [--entries-per-rank 400]
+Emits one JSON line per (leg, world).
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_utils import report  # noqa: E402
+
+from torchsnapshot_tpu.dist_store import TCPStore  # noqa: E402
+from torchsnapshot_tpu.pg_wrapper import PGWrapper, ProcessGroup  # noqa: E402
+
+
+def _manifest_shard(rank: int, n_entries: int) -> dict:
+    """ArrayEntry-shaped payload: what one rank contributes to the commit
+    gather for a sharded model (realistic key paths, shapes, checksums)."""
+    return {
+        f"0/model/layer_{i // 4}/param_{i % 4}": {
+            "type": "sharded",
+            "location": f"sharded/model.layer_{i // 4}.param_{i % 4}_{rank}_{i}",
+            "serializer": "buffer_protocol",
+            "dtype": "bfloat16",
+            "shape": [8192, 1024],
+            "byte_range": [0, 16777216],
+            "checksum": f"crc32c:{(rank * 1000003 + i) & 0xFFFFFFFF:08x}",
+            "replicated": False,
+        }
+        for i in range(n_entries)
+    }
+
+
+def _run_ranks(world: int, fn) -> list:
+    """Run fn(rank, pg_wrapper_factory) in `world` threads; returns results."""
+    server = TCPStore("127.0.0.1", None, is_server=True)
+    results = [None] * world
+    errors = []
+
+    def runner(rank: int) -> None:
+        store = server.clone() if rank else server
+        pg = ProcessGroup(store, rank, world)
+        try:
+            results[rank] = fn(rank, pg)
+        except BaseException as e:  # noqa: B036
+            errors.append((rank, e))
+        finally:
+            if rank:
+                store.close()
+
+    threads = [
+        threading.Thread(target=runner, args=(r,), daemon=True)
+        for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    server.close()
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+def bench_barrier(world: int, rounds: int = 20) -> None:
+    def rank_fn(rank: int, pg: ProcessGroup):
+        w = PGWrapper(pg, namespace=f"stress/barrier/{world}")
+        walls = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            w.barrier()
+            walls.append(time.perf_counter() - t0)
+        return walls
+
+    t0 = time.perf_counter()
+    per_rank = _run_ranks(world, rank_fn)
+    total = time.perf_counter() - t0
+    # A round's wall is the slowest rank's (barrier releases together);
+    # aggregate across rounds for the distribution.
+    rounds_wall = [
+        max(per_rank[r][i] for r in range(world)) for i in range(rounds)
+    ]
+    report(
+        "store_scale/barrier",
+        {
+            "world": world,
+            "rounds": rounds,
+            "p50_ms": round(statistics.median(rounds_wall) * 1e3, 2),
+            "p99_ms": round(
+                sorted(rounds_wall)[max(0, int(len(rounds_wall) * 0.99) - 1)] * 1e3,
+                2,
+            ),
+            "total_s": round(total, 3),
+        },
+    )
+
+
+def bench_gather(world: int, entries_per_rank: int) -> None:
+    shard_template = _manifest_shard(0, entries_per_rank)
+
+    def rank_fn(rank: int, pg: ProcessGroup):
+        w = PGWrapper(pg, namespace=f"stress/gather/{world}")
+        shard = _manifest_shard(rank, entries_per_rank)
+        t0 = time.perf_counter()
+        gathered = w.all_gather_object(shard)
+        wall = time.perf_counter() - t0
+        assert len(gathered) == world
+        total_entries = sum(len(g) for g in gathered)
+        return wall, total_entries
+
+    import pickle
+
+    from torchsnapshot_tpu.pg_wrapper import _dumps, _loads
+
+    shard_bytes = len(pickle.dumps(shard_template))
+    # Client-side decode cost of the leader-assembled blob, measured once:
+    # with `world` thread-ranks sharing THIS host's GIL, total wall is
+    # dominated by world × this (serialized); on a real pod each rank
+    # decodes on its own host, in parallel.
+    assembled = [_manifest_shard(r, entries_per_rank) for r in range(world)]
+    blob = _dumps(assembled)
+    t0 = time.perf_counter()
+    _loads(blob)
+    decode_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    results = _run_ranks(world, rank_fn)
+    total = time.perf_counter() - t0
+    walls = [r[0] for r in results]
+    assert all(r[1] == world * entries_per_rank for r in results)
+    report(
+        "store_scale/gather",
+        {
+            "world": world,
+            "entries_per_rank": entries_per_rank,
+            "total_entries": world * entries_per_rank,
+            "shard_pickle_kb": round(shard_bytes / 1e3, 1),
+            "logical_traffic_mb": round(world * world * shard_bytes / 1e6, 1),
+            "assembled_blob_mb": round(len(blob) / 1e6, 2),
+            "per_rank_decode_s": round(decode_s, 3),
+            "server_side_s_est": round(max(0.0, total - world * decode_s), 3),
+            "p50_rank_wall_s": round(statistics.median(walls), 3),
+            "max_rank_wall_s": round(max(walls), 3),
+            "total_s": round(total, 3),
+        },
+    )
+
+
+def bench_lockstep(world: int, n_keys: int = 10) -> None:
+    def rank_fn(rank: int, pg: ProcessGroup):
+        w = PGWrapper(pg, namespace=f"stress/lockstep/{world}")
+        t0 = time.perf_counter()
+        for i in range(n_keys):
+            w.broadcast_object({"key": i, "plan": rank} if rank == 0 else None)
+            w.barrier()
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    results = _run_ranks(world, rank_fn)
+    total = time.perf_counter() - t0
+    report(
+        "store_scale/lockstep",
+        {
+            "world": world,
+            "n_keys": n_keys,
+            "per_key_ms": round(max(results) / n_keys * 1e3, 2),
+            "total_s": round(total, 3),
+        },
+    )
+
+
+def main() -> int:
+    worlds = [32, 64, 128]
+    entries = 400
+    for a in sys.argv[1:]:
+        if a.startswith("--worlds="):
+            worlds = [int(x) for x in a.split("=", 1)[1].split(",")]
+        elif a.startswith("--entries-per-rank="):
+            entries = int(a.split("=", 1)[1])
+    for world in worlds:
+        bench_barrier(world)
+        bench_gather(world, entries)
+        bench_lockstep(world)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,3 +135,41 @@ def test_create_store_rendezvous() -> None:
     assert server.get("hello") == b"world"
     client.close()
     server.close()
+
+
+def test_mset_and_collect(store) -> None:
+    store.mset({f"batch/{i}": str(i).encode() for i in range(5)})
+    stopped, items = store.collect("batch/", 5, timeout=5.0)
+    assert stopped is None
+    assert items == {f"batch/{i}": str(i).encode() for i in range(5)}
+
+
+def test_collect_blocks_until_count(store) -> None:
+    import threading
+    import time
+
+    def fill():
+        for i in range(3):
+            time.sleep(0.05)
+            store.clone().set(f"slow/{i}", b"x")
+
+    t = threading.Thread(target=fill)
+    t.start()
+    stopped, items = store.collect("slow/", 3, timeout=10.0)
+    t.join()
+    assert stopped is None and len(items) == 3
+
+
+def test_collect_stop_key_short_circuits(store) -> None:
+    store.set("err/0", b"boom")
+    # only 1 of 99 keys present; the stop key returns immediately
+    stopped, items = store.collect("never/", 99, stop_keys=["err/0"], timeout=5.0)
+    assert stopped == "err/0"
+    assert items["err/0"] == b"boom"
+
+
+def test_collect_timeout(store) -> None:
+    import pytest
+
+    with pytest.raises(TimeoutError):
+        store.collect("absent/", 2, timeout=0.2)

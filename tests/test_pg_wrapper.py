@@ -113,3 +113,52 @@ def test_single_process_trivial_collectives() -> None:
     assert w.broadcast_object("y") == "y"
     assert w.scatter_object(["z"]) == "z"
     w.barrier()  # no-op
+
+
+def _large_payload_worker(rank: int, world_size: int):
+    # A manifest-sized, highly-compressible payload: exercises the
+    # compressed (\x01) wire format through every collective.
+    payload = {"rank": rank, "entries": [f"layer/{i}/weight" for i in range(20000)]}
+    pg = PGWrapper()
+    got = pg.broadcast_object(payload if rank == 0 else None, src=0)
+    assert got["rank"] == 0 and len(got["entries"]) == 20000
+    gathered = pg.all_gather_object(payload)
+    assert [g["rank"] for g in gathered] == list(range(world_size))
+    assert all(len(g["entries"]) == 20000 for g in gathered)
+    return "ok"
+
+
+def test_large_payload_collectives_compress() -> None:
+    from torchsnapshot_tpu.pg_wrapper import _dumps, _loads
+
+    big = {"entries": [f"layer/{i}/weight" for i in range(20000)]}
+    wire = _dumps(big)
+    assert wire[:1] == b"\x01"  # compressed marker
+    assert _loads(wire) == big
+    import pickle
+
+    assert len(wire) < len(pickle.dumps(big)) // 3
+    results = run_with_subprocesses(_large_payload_worker, 2)
+    assert all(v == "ok" for v in results.values())
+
+
+def _gather_error_worker(rank: int, world_size: int):
+    pg = PGWrapper()
+    pg.barrier()  # establish the namespace on every rank
+    if rank == 0:
+        pg.report_error(ValueError("gather-boom"))
+        return "reported"
+    try:
+        # Rank 0 never contributes; the collect-based gather must observe
+        # the error channel instead of blocking for the store timeout.
+        pg.all_gather_object(rank)
+    except RuntimeError as e:
+        assert isinstance(e.__cause__, ValueError)
+        return "raised"
+    raise AssertionError("all_gather did not observe the peer error")
+
+
+def test_error_channel_unblocks_all_gather() -> None:
+    results = run_with_subprocesses(_gather_error_worker, 2)
+    assert results[0] == "reported"
+    assert results[1] == "raised"

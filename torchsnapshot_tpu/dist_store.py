@@ -120,6 +120,36 @@ class _StoreServer:
                     if remaining <= 0:
                         return {"ok": False, "timeout": True}
                     self._cond.wait(timeout=min(remaining, 1.0))
+            elif op == "mset":
+                self._data.update(req["items"])
+                self._cond.notify_all()
+                return {"ok": True}
+            elif op == "collect":
+                # Block until `count` keys with `prefix` exist, then return
+                # them all in one response — the server-side half of a
+                # scalable all-gather (one RTT per rank instead of one per
+                # peer). A stop key (error channel) short-circuits.
+                prefix = req["prefix"]
+                count = req["count"]
+                stop_keys = req.get("stop_keys") or []
+                deadline = time.monotonic() + req["timeout"]
+                while True:
+                    for sk in stop_keys:
+                        if sk in self._data:
+                            return {
+                                "ok": True,
+                                "stopped": sk,
+                                "value": self._data[sk],
+                            }
+                    found = {
+                        k: v for k, v in self._data.items() if k.startswith(prefix)
+                    }
+                    if len(found) >= count:
+                        return {"ok": True, "items": found}
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return {"ok": False, "timeout": True}
+                    self._cond.wait(timeout=min(remaining, 1.0))
             elif op == "check":
                 return {"ok": True, "value": key in self._data}
             elif op == "num_keys":
@@ -211,6 +241,34 @@ class TCPStore:
 
     def add(self, key: str, amount: int) -> int:
         return self._request({"op": "add", "key": key, "amount": amount})["value"]
+
+    def mset(self, items: Dict[str, bytes]) -> None:
+        """Set many keys in one round trip (scatter's leader-side write)."""
+        self._request({"op": "mset", "items": {k: bytes(v) for k, v in items.items()}})
+
+    def collect(
+        self,
+        prefix: str,
+        count: int,
+        stop_keys: Optional[List[str]] = None,
+        timeout: Optional[float] = None,
+    ) -> Tuple[Optional[str], Dict[str, bytes]]:
+        """Block until ``count`` keys under ``prefix`` exist; return them all
+        in ONE round trip. Returns ``(stopped_key, items)``: if a stop key
+        (e.g. an error channel) appears first, ``stopped_key`` is set and
+        ``items`` maps it to its value."""
+        resp = self._request(
+            {
+                "op": "collect",
+                "prefix": prefix,
+                "count": count,
+                "stop_keys": stop_keys or [],
+                "timeout": timeout or self.timeout,
+            }
+        )
+        if "stopped" in resp:
+            return resp["stopped"], {resp["stopped"]: resp["value"]}
+        return None, resp["items"]
 
     def check(self, key: str) -> bool:
         return self._request({"op": "check", "key": key})["value"]
