@@ -27,7 +27,10 @@ from torchsnapshot_tpu.manifest import (
     get_manifest_for_rank,
 )
 
-GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "data", "golden_manifest.yaml")
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "data", "golden_manifest.json")
+LEGACY_YAML_PATH = os.path.join(
+    os.path.dirname(__file__), "data", "golden_manifest.yaml"
+)
 
 
 @pytest.fixture()
@@ -41,8 +44,28 @@ def metadata(golden_text: str) -> SnapshotMetadata:
     return SnapshotMetadata.from_yaml(golden_text)
 
 
-def test_yaml_round_trip_is_byte_exact(golden_text, metadata) -> None:
+def test_round_trip_is_byte_exact(golden_text, metadata) -> None:
     assert metadata.to_yaml() == golden_text
+
+
+def test_legacy_yaml_golden_still_loads(metadata) -> None:
+    """Snapshots written before the round-4 JSON switch carry YAML
+    metadata; they must parse to exactly the same manifest."""
+    with open(LEGACY_YAML_PATH) as f:
+        legacy = SnapshotMetadata.from_yaml(f.read())
+    assert asdict(legacy) == asdict(metadata)
+
+
+def test_emission_is_readable_by_yaml_loaders() -> None:
+    """Builds predating the JSON switch parse ``.snapshot_metadata`` with
+    a YAML loader; JSON emission must stay within what it accepts."""
+    import json
+
+    import yaml
+
+    with open(GOLDEN_PATH) as f:
+        text = f.read()
+    assert yaml.safe_load(text) == json.loads(text)
 
 
 def test_all_entry_types_parse(metadata) -> None:
