@@ -58,7 +58,15 @@ def main() -> None:
             Snapshot.take(f"{tmp}/sync", app_state)
         report("embedding_save/sync", res, nbytes)
 
+        # Cold = first async_take of the process, with the staging pool
+        # pre-faulted by warmup_staging (the production recipe: warm up
+        # once after building state, off the training-loop critical path).
+        from torchsnapshot_tpu import warmup_staging
+
         res = {}
+        t0 = time.perf_counter()
+        res["warmup_mb"] = round(warmup_staging(app_state) / 1e6, 1)
+        res["warmup_s"] = round(time.perf_counter() - t0, 3)
         t0 = time.perf_counter()
         pending = Snapshot.async_take(f"{tmp}/async", app_state)
         res["caller_blocked_s"] = round(time.perf_counter() - t0, 3)

@@ -157,3 +157,69 @@ def test_async_take_all_or_nothing(tmp_path) -> None:
     )
     assert all(r.startswith("error") for r in results.values()), results
     assert not os.path.exists(os.path.join(snap_path, SNAPSHOT_METADATA_FNAME))
+
+
+def test_warmup_staging_prefaults_exact_sizes(tmp_path):
+    """warmup_staging must draw the same slab sizes the real staging pass
+    will: a second warmup reports nothing left to fault, and an
+    async_take after warmup recycles the warmed slabs instead of
+    allocating fresh ones."""
+    import gc
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, warmup_staging
+    from torchsnapshot_tpu.io_preparers.array import _staging_pool
+
+    state = {
+        "app": StateDict(
+            a=np.random.default_rng(0).standard_normal((1 << 18,)).astype(np.float32),
+            b=np.arange(1 << 16, dtype=np.int64),
+        )
+    }
+    nbytes = sum(x.nbytes for x in state["app"].values())
+    warmed = warmup_staging(state)
+    assert warmed >= nbytes  # everything faulted up front
+    assert warmup_staging(state) == 0  # already pooled: nothing to do
+
+    before = {
+        n: [s.ctypes.data for s in slabs] for n, slabs in _staging_pool._free.items()
+    }
+    Snapshot.async_take(str(tmp_path / "s"), state).wait()
+    gc.collect()
+    # The staged buffers came from (and returned to) the warmed slabs.
+    after = {
+        n: [s.ctypes.data for s in slabs] for n, slabs in _staging_pool._free.items()
+    }
+    for size, ptrs in before.items():
+        assert set(ptrs) <= set(after.get(size, [])), size
+    assert warmup_staging(state) == 0
+
+
+def test_warmup_staging_sharded_piece_sizes():
+    """For a GSPMD-sharded array, warmup sizes the pool from the owned
+    write pieces, not the full array."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from torchsnapshot_tpu import StateDict, warmup_staging
+    from torchsnapshot_tpu.io_preparers.sharded import ShardedArrayIOPreparer
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        import pytest
+
+        pytest.skip("needs multiple devices")
+    mesh = Mesh(np.array(devs), ("x",))
+    arr = jax.device_put(
+        jnp.arange(8 * len(devs) * 128, dtype=jnp.float32).reshape(
+            8 * len(devs), 128
+        ),
+        NamedSharding(mesh, PartitionSpec("x", None)),
+    )
+    piece_sizes = ShardedArrayIOPreparer.staged_piece_sizes(arr)
+    assert sum(piece_sizes) == arr.nbytes  # single process owns every piece
+    assert len(piece_sizes) == len(devs)
+    warmed = warmup_staging({"app": StateDict(w=arr)})
+    assert warmed >= sum(piece_sizes)

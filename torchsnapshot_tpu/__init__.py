@@ -10,5 +10,6 @@ try:
     from .rng_state import RNGState  # noqa: F401
     from .snapshot import PendingRestore, PendingSnapshot, Snapshot  # noqa: F401
     from .manager import CheckpointManager  # noqa: F401
+    from .io_preparers.array import warmup_staging  # noqa: F401
 except ImportError:  # pragma: no cover - during incremental bring-up only
     pass

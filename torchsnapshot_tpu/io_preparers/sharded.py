@@ -191,15 +191,18 @@ class ShardedArrayIOPreparer:
     # ------------------------------------------------------------------ save
 
     @classmethod
-    def prepare_write(
-        cls, storage_path_prefix: str, arr
-    ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
+    def _owned_pieces(cls, arr):
+        """Yield ``(p_off, p_sz, piece_data)`` for every piece THIS process
+        writes: its owned boxes (deduped, hash-balanced election), each
+        subdivided to the shard size cap. The single source of the write
+        partition — prepare_write builds entries from it, and the staging
+        warmup (io_preparers.array.warmup_staging) sizes pool slabs from
+        it without planning a real write."""
         import jax
 
         sharding = arr.sharding
         shape = tuple(arr.shape)
-        dtype_str = dtype_to_string(arr.dtype)
-        itemsize = string_to_dtype(dtype_str).itemsize
+        itemsize = string_to_dtype(dtype_to_string(arr.dtype)).itemsize
         process_index = jax.process_index()
 
         # box -> holder process indices (computed identically on every process)
@@ -215,8 +218,6 @@ class ShardedArrayIOPreparer:
             if box not in local_data:
                 local_data[box] = shard.data
 
-        shards: List[Shard] = []
-        write_reqs: List[WriteReq] = []
         for box in sorted(holders.keys()):
             if _stable_owner(box, holders[box]) != process_index:
                 continue
@@ -233,22 +234,43 @@ class ShardedArrayIOPreparer:
                     for po, o, ps in zip(p_off, offsets, p_sz)
                 )
                 piece = data[local_slices] if local_slices else data
-                location = f"{storage_path_prefix}_{'_'.join(map(str, p_off))}"
-                entry = ArrayEntry(
-                    location=location,
-                    serializer="buffer_protocol",
-                    dtype=dtype_str,
-                    shape=list(p_sz),
-                    replicated=False,
-                )
-                shards.append(Shard(offsets=list(p_off), sizes=list(p_sz), array=entry))
-                write_reqs.append(
-                    WriteReq(
-                        path=location, buffer_stager=ArrayBufferStager(piece, entry)
-                    )
-                )
+                yield p_off, p_sz, piece
+
+    @classmethod
+    def staged_piece_sizes(cls, arr) -> List[int]:
+        """Byte sizes of the staging buffers this process will draw for
+        ``arr`` (pool-warmup planning; no data is touched)."""
+        itemsize = string_to_dtype(dtype_to_string(arr.dtype)).itemsize
+        sizes = []
+        for _, p_sz, _ in cls._owned_pieces(arr):
+            n = itemsize
+            for s in p_sz:
+                n *= s
+            sizes.append(n)
+        return sizes
+
+    @classmethod
+    def prepare_write(
+        cls, storage_path_prefix: str, arr
+    ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
+        dtype_str = dtype_to_string(arr.dtype)
+        shards: List[Shard] = []
+        write_reqs: List[WriteReq] = []
+        for p_off, p_sz, piece in cls._owned_pieces(arr):
+            location = f"{storage_path_prefix}_{'_'.join(map(str, p_off))}"
+            entry = ArrayEntry(
+                location=location,
+                serializer="buffer_protocol",
+                dtype=dtype_str,
+                shape=list(p_sz),
+                replicated=False,
+            )
+            shards.append(Shard(offsets=list(p_off), sizes=list(p_sz), array=entry))
+            write_reqs.append(
+                WriteReq(path=location, buffer_stager=ArrayBufferStager(piece, entry))
+            )
         return (
-            ShardedArrayEntry(dtype=dtype_str, shape=list(shape), shards=shards),
+            ShardedArrayEntry(dtype=dtype_str, shape=list(arr.shape), shards=shards),
             write_reqs,
         )
 

@@ -155,6 +155,17 @@ class CheckpointManager:
 
     # ------------------------------------------------------------ save
 
+    def warmup(self, app_state: AppState) -> int:
+        """Pre-fault staging buffers for ``app_state`` so the first
+        ``save`` blocks like a steady-state one (async saves especially:
+        the cold caller-blocked interval is dominated by first-touch page
+        faults in fresh staging slabs). Call once after building the app
+        state; cheap to call again after shapes change. Returns bytes
+        newly faulted."""
+        from .io_preparers.array import warmup_staging
+
+        return warmup_staging(app_state)
+
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0
 
