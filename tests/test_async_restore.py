@@ -92,6 +92,7 @@ def _async_restore_worker(rank, world_size, snap_path):
     return "ok"
 
 
+@pytest.mark.multiprocess
 def test_async_restore_multiprocess(tmp_path):
     from torchsnapshot_tpu.test_utils import run_with_subprocesses
 

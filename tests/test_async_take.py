@@ -108,6 +108,7 @@ def _async_take_worker(rank: int, world_size: int, snap_path: str):
     return sorted(snapshot.get_manifest().keys())
 
 
+@pytest.mark.multiprocess
 def test_async_take_multiprocess(tmp_path) -> None:
     snap_path = str(tmp_path / "snap")
     results = run_with_subprocesses(_async_take_worker, 2, snap_path)
@@ -148,6 +149,7 @@ def _async_take_one_rank_fails_worker(rank: int, world_size: int, snap_path: str
             return f"error: {e}"
 
 
+@pytest.mark.multiprocess
 def test_async_take_all_or_nothing(tmp_path) -> None:
     """If any rank fails, no rank commits and everyone sees an error
     (reference: tests/test_async_take.py:107-115)."""

@@ -9,6 +9,8 @@ import pytest
 
 from torchsnapshot_tpu.test_utils import run_with_subprocesses
 
+pytestmark = [pytest.mark.multiprocess]
+
 
 def _replicated_take_worker(rank: int, world_size: int, snap_path: str):
     from torchsnapshot_tpu import Snapshot, StateDict

@@ -254,6 +254,7 @@ def _multiproc_incremental_worker(rank, world_size, base_path, inc_path):
     return "ok"
 
 
+@pytest.mark.multiprocess
 def test_multiprocess_replicated_incremental(tmp_path):
     from torchsnapshot_tpu.test_utils import run_with_subprocesses
 

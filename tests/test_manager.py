@@ -259,6 +259,7 @@ def _committed_skip_worker(rank, world_size, roots):
     return saved
 
 
+@pytest.mark.multiprocess
 def test_committed_skip_is_rank0_broadcast(tmp_path):
     """A prior run committed step 0 on rank 0's root only; every rank of
     the resumed world must uniformly skip re-saving it (no hang, no

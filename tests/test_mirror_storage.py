@@ -328,6 +328,7 @@ def _mirror_worker(rank, world_size, primary_dir, mirror_dir):
     return "ok"
 
 
+@pytest.mark.multiprocess
 def test_multiprocess_mirror_commit_is_complete(tmp_path):
     """Every rank's payload mirrors drain before the commit barrier, so
     the mirror metadata never publishes a mirror missing a rank's data."""

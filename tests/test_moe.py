@@ -43,6 +43,7 @@ def reference_moe_no_drops(params, x):
     return out
 
 
+@pytest.mark.slow
 def test_moe_shapes_and_finiteness() -> None:
     params = init_moe_params(jax.random.PRNGKey(0), 16, 32, 4)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
@@ -52,6 +53,7 @@ def test_moe_shapes_and_finiteness() -> None:
     assert float(aux) > 0
 
 
+@pytest.mark.slow
 def test_moe_matches_reference_routing() -> None:
     params = init_moe_params(jax.random.PRNGKey(2), 8, 16, 2)
     x = jax.random.normal(jax.random.PRNGKey(3), (12, 8))
@@ -73,6 +75,7 @@ def test_moe_capacity_drops_bounded() -> None:
 
 
 @pytest.mark.parametrize("dispatch", ["einsum", "sort"])
+@pytest.mark.slow
 def test_moe_gradients_flow(dispatch: str) -> None:
     params = init_moe_params(jax.random.PRNGKey(6), 8, 16, 2)
     x = jax.random.normal(jax.random.PRNGKey(7), (16, 8))
@@ -100,6 +103,7 @@ def test_moe_sort_dispatch_matches_einsum(capacity_factor: float) -> None:
     np.testing.assert_allclose(float(aux_s), float(aux_e), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_sort_dispatch_gradients_match_einsum() -> None:
     params = init_moe_params(jax.random.PRNGKey(10), 8, 16, 4)
     x = jax.random.normal(jax.random.PRNGKey(11), (32, 8))
@@ -114,6 +118,7 @@ def test_moe_sort_dispatch_gradients_match_einsum() -> None:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_sharded_all_to_all_matches_unsharded() -> None:
     """Explicit-EP (shard_map + lax.all_to_all) output matches the GSPMD
     single-call path when capacity is ample (per-device vs global capacity
@@ -160,6 +165,7 @@ def test_moe_sharded_gradients_flow() -> None:
         assert np.abs(arr).sum() > 0
 
 
+@pytest.mark.slow
 def test_moe_transformer_trains_and_checkpoints(tmp_path) -> None:
     from torchsnapshot_tpu import Snapshot, StateDict
     from torchsnapshot_tpu.models import transformer as T

@@ -18,6 +18,8 @@ import pytest
 
 from torchsnapshot_tpu.test_utils import _find_free_port, run_with_subprocesses
 
+pytestmark = [pytest.mark.multiprocess]
+
 SHAPE = (4, 8)
 
 

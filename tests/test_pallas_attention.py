@@ -103,6 +103,7 @@ def test_flash_default_blocks_snap_to_divisor() -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_transformer_flash_matches_dense() -> None:
     from torchsnapshot_tpu.models import transformer as T
 

@@ -6,6 +6,8 @@ import pytest
 from torchsnapshot_tpu.pg_wrapper import PGWrapper
 from torchsnapshot_tpu.test_utils import run_with_subprocesses
 
+pytestmark = [pytest.mark.multiprocess]
+
 
 def _collectives_worker(rank: int, world_size: int):
     pg = PGWrapper()

@@ -23,6 +23,8 @@ from torchsnapshot_tpu.serialization import (
     string_to_dtype,
 )
 
+pytestmark = [pytest.mark.hypothesis_fuzz]
+
 # Keys exercise the escaping path: slashes, percents, spaces, unicode.
 _KEY_ALPHABET = string.ascii_letters + string.digits + "/%._- é"
 _keys = st.text(alphabet=_KEY_ALPHABET, min_size=1, max_size=12)

@@ -75,6 +75,7 @@ def test_zigzag_ring_composes_with_head_sharding() -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_gradients_match_dense() -> None:
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
     q, k, v = make_qkv(seed=9)
@@ -125,6 +126,7 @@ def test_ring_composes_with_head_sharding() -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense() -> None:
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
     q, k, v = make_qkv(seed=3)
@@ -141,6 +143,7 @@ def test_ring_gradients_match_dense() -> None:
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_transformer_forward_matches_dense() -> None:
     """Full model: ring/cp sharded forward == single-device dense forward."""
     from torchsnapshot_tpu.models import transformer as T
@@ -213,6 +216,7 @@ def test_ulysses_composes_with_head_sharding() -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_gradients_match_dense() -> None:
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
     q, k, v = make_qkv(seed=6)
@@ -259,6 +263,7 @@ def test_ulysses_transformer_forward_matches_dense() -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_train_step_runs_and_checkpoints(tmp_path) -> None:
     """The cp-sharded training state round-trips through Snapshot."""
     from torchsnapshot_tpu import Snapshot, StateDict
@@ -319,6 +324,7 @@ def test_ring_flash_matches_dense(causal: bool, mesh_shape) -> None:
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_ring_flash_gradients_match_dense(causal: bool) -> None:
     """The custom VJP (per-hop flash backward with global lse, rotating
     dK/dV accumulators) == autodiff through the dense oracle."""
@@ -370,6 +376,7 @@ def test_zigzag_flash_matches_dense(mesh_shape) -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_flash_gradients_match_dense() -> None:
     from torchsnapshot_tpu.ops import zigzag_ring_flash_attention_sharded
 
@@ -392,6 +399,7 @@ def test_zigzag_flash_gradients_match_dense() -> None:
         )
 
 
+@pytest.mark.slow
 def test_zigzag_flash_in_layout() -> None:
     """in_layout=True (training loops keep activations zigzag end-to-end)
     equals the permute-in/permute-out path."""

@@ -51,6 +51,7 @@ def test_scan_with_initial_state() -> None:
     np.testing.assert_allclose(np.asarray(h), naive_scan(a, b, h0), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_chunked_scan_resumes_exactly() -> None:
     """Scanning two halves with the carried state == scanning the whole —
     the invariant that makes the final state a checkpointable cursor."""
@@ -96,6 +97,7 @@ def test_sharded_scan_with_initial_state() -> None:
     np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sharded_ssm_gradients_flow() -> None:
     """The sequence-parallel path must be trainable (reverse-mode through
     the cross-chunk carry fold)."""
@@ -144,6 +146,7 @@ def test_ssm_gradients_flow() -> None:
         assert np.abs(arr).sum() > 0
 
 
+@pytest.mark.slow
 def test_ssm_state_snapshot_roundtrip(tmp_path) -> None:
     """The recurrent state is a checkpointable cursor: snapshot mid-sequence,
     restore, resume — identical to the uninterrupted run."""
@@ -170,6 +173,7 @@ def test_ssm_state_snapshot_roundtrip(tmp_path) -> None:
     )
 
 
+@pytest.mark.slow
 def test_ssm_lm_trains_and_checkpoints(tmp_path) -> None:
     """The SSM LM trains on a dp x sp x tp mesh, checkpoints, restores onto
     the same mesh, and resumes — the model-family end-to-end loop."""
@@ -210,6 +214,7 @@ def test_ssm_lm_trains_and_checkpoints(tmp_path) -> None:
     assert int(state2["step"]) == 2 and np.isfinite(float(loss2))
 
 
+@pytest.mark.slow
 def test_ssm_lm_sharded_forward_matches_unsharded() -> None:
     from torchsnapshot_tpu.models import ssm_lm
 
