@@ -175,3 +175,119 @@ def test_end_to_end_snapshot_via_fake_s3(monkeypatch) -> None:
     ).restore({"model": dst})
     np.testing.assert_array_equal(dst["w"], state["w"])
     assert dst["step"] == 7
+
+
+class FakeMultipartS3Client(FakeS3Client):
+    """Adds the four multipart calls; parts assemble on complete."""
+
+    def __init__(self, fail_times: int = 0, fail_part_numbers=()):
+        super().__init__(fail_times)
+        self.uploads: dict = {}
+        self.aborted: list = []
+        self._fail_part_numbers = set(fail_part_numbers)
+        self.part_attempts = 0
+
+    def create_multipart_upload(self, Bucket, Key):
+        self._maybe_fail()
+        uid = f"upload-{len(self.uploads)}"
+        self.uploads[uid] = {}
+        return {"UploadId": uid}
+
+    def upload_part(self, Bucket, Key, UploadId, PartNumber, Body):
+        self.part_attempts += 1
+        data = Body.read()
+        if PartNumber in self._fail_part_numbers:
+            self._fail_part_numbers.discard(PartNumber)
+            raise ConnectionError("fake transient part failure")
+        self._maybe_fail()
+        self.uploads[UploadId][PartNumber] = bytes(data)
+        return {"ETag": f"etag-{PartNumber}"}
+
+    def complete_multipart_upload(self, Bucket, Key, UploadId, MultipartUpload):
+        self._maybe_fail()
+        parts = MultipartUpload["Parts"]
+        assert [p["PartNumber"] for p in parts] == sorted(
+            p["PartNumber"] for p in parts
+        )
+        assembled = b"".join(
+            self.uploads[UploadId][p["PartNumber"]] for p in parts
+        )
+        self.store[(Bucket, Key)] = assembled
+        del self.uploads[UploadId]
+
+    def abort_multipart_upload(self, Bucket, Key, UploadId):
+        self.aborted.append(UploadId)
+        self.uploads.pop(UploadId, None)
+
+
+def test_multipart_upload_round_trip(monkeypatch) -> None:
+    """Payloads past the threshold upload in parts and read back intact."""
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    monkeypatch.setattr(s3mod, "MULTIPART_PART_BYTES", 1024)
+    client = FakeMultipartS3Client()
+    plugin = make_plugin(client, multipart_threshold=2048)
+    data = np.random.default_rng(0).integers(0, 255, 5000, np.uint8).tobytes()
+    run(plugin.write(WriteIO(path="big.obj", buf=memoryview(data))))
+    assert client.store[("fake-bucket", "prefix/big.obj")] == data
+    assert client.part_attempts == 5  # ceil(5000/1024)
+    assert not client.uploads  # completed, nothing in flight
+
+    read_io = ReadIO(path="big.obj")
+    run(plugin.read(read_io))
+    assert bytes(read_io.buf) == data
+
+
+def test_multipart_part_retries_with_fresh_stream(monkeypatch) -> None:
+    """A transient part failure retries that part; the part's stream is
+    re-created so the retry uploads the full part, not a consumed one."""
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    monkeypatch.setattr(s3mod, "MULTIPART_PART_BYTES", 1024)
+    client = FakeMultipartS3Client(fail_part_numbers=[2])
+    plugin = make_plugin(
+        client,
+        multipart_threshold=2048,
+        retry_strategy=CollectiveRetryStrategy(base_backoff_s=0.01),
+    )
+    data = bytes(range(256)) * 12  # 3072 bytes -> 3 parts
+    run(plugin.write(WriteIO(path="retry.obj", buf=memoryview(data))))
+    assert client.store[("fake-bucket", "prefix/retry.obj")] == data
+    assert client.part_attempts == 4  # 3 parts + 1 retried
+
+
+def test_multipart_aborts_on_nontransient_failure(monkeypatch) -> None:
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    monkeypatch.setattr(s3mod, "MULTIPART_PART_BYTES", 1024)
+
+    class PoisonClient(FakeMultipartS3Client):
+        def upload_part(self, Bucket, Key, UploadId, PartNumber, Body):
+            if PartNumber == 2:
+                raise ValueError("permanent")
+            return super().upload_part(Bucket, Key, UploadId, PartNumber, Body)
+
+    client = PoisonClient()
+    plugin = make_plugin(client, multipart_threshold=2048)
+    data = b"z" * 3000
+    with pytest.raises(ValueError, match="permanent"):
+        run(plugin.write(WriteIO(path="bad.obj", buf=memoryview(data))))
+    assert client.aborted  # server-side cleanup requested
+    assert ("fake-bucket", "prefix/bad.obj") not in client.store
+
+
+def test_transfers_run_on_dedicated_cloud_pool() -> None:
+    """Cloud I/O must ride the bounded tsnap-cloud-io pool, not the
+    default loop executor."""
+    import threading
+
+    seen = []
+
+    class RecordingClient(FakeS3Client):
+        def put_object(self, Bucket, Key, Body):
+            seen.append(threading.current_thread().name)
+            return super().put_object(Bucket, Key, Body)
+
+    plugin = make_plugin(RecordingClient())
+    run(plugin.write(WriteIO(path="t.obj", buf=memoryview(b"x" * 64))))
+    assert seen and all(n.startswith("tsnap-cloud-io") for n in seen)

@@ -232,8 +232,8 @@ def test_progress_reporter_logs_pipeline_table(caplog) -> None:
     reporter.staged_count = 3
     reporter.staged_bytes = 3 << 20
     reporter.inflight_io = 1
-    reporter.written_count = 2
-    reporter.written_bytes = 2 << 20
+    reporter.completed_count = 2
+    reporter.completed_bytes = 2 << 20
     with caplog.at_level(logging.INFO, logger="torchsnapshot_tpu.scheduler"):
         reporter.log_table()
     assert caplog.records, "no progress table logged"
@@ -274,7 +274,7 @@ def test_write_pipeline_wires_progress_reporter(tmp_path) -> None:
     assert reporter is not None
     assert reporter.staged_count == 3
     pending.sync_complete(loop)
-    assert reporter.written_count == 3
-    assert reporter.written_bytes == 3 * 64 * 64 * 8
+    assert reporter.completed_count == 3
+    assert reporter.completed_bytes == 3 * 64 * 64 * 8
     loop.run_until_complete(storage.close())
     loop.close()

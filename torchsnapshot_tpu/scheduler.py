@@ -152,8 +152,11 @@ class _ProgressReporter:
         self.interval_s = interval_s
         self.staged_count = 0
         self.staged_bytes = 0
-        self.written_count = 0
-        self.written_bytes = 0
+        # Op-neutral completion counters: "written" entries for the write
+        # pipeline, "consumed" reads for the read pipeline (the log wording
+        # is per-op; the fields are shared).
+        self.completed_count = 0
+        self.completed_bytes = 0
         self.inflight_staging = 0
         self.inflight_io = 0
         self._begin = time.monotonic()
@@ -196,8 +199,8 @@ class _ProgressReporter:
                 elapsed,
                 self.total,
                 self.inflight_io,
-                self.written_count,
-                self.written_bytes / 1e9,
+                self.completed_count,
+                self.completed_bytes / 1e9,
                 self.budget.available / 1e9,
                 self.budget.budget_bytes / 1e9,
                 rss_delta / 1e9,
@@ -214,9 +217,9 @@ class _ProgressReporter:
             self.inflight_staging,
             self.staged_count,
             self.inflight_io,
-            self.written_count,
+            self.completed_count,
             self.staged_bytes / 1e9,
-            self.written_bytes / 1e9,
+            self.completed_bytes / 1e9,
             self.budget.available / 1e9,
             self.budget.budget_bytes / 1e9,
             rss_delta / 1e9,
@@ -290,8 +293,8 @@ class PendingIOWork:
                     self._throughput.add(pipeline.buf_size_bytes)
                     if reporter is not None:
                         reporter.inflight_io -= 1
-                        reporter.written_count += 1
-                        reporter.written_bytes += pipeline.buf_size_bytes
+                        reporter.completed_count += 1
+                        reporter.completed_bytes += pipeline.buf_size_bytes
         except BaseException:
             # Same cleanup as execute_write_reqs' failure path: a write
             # failing during the drain must not orphan sibling writes or
@@ -423,8 +426,8 @@ async def execute_write_reqs(
                     budget.release(pipeline.buf_size_bytes)
                     throughput.add(pipeline.buf_size_bytes)
                     reporter.inflight_io -= 1
-                    reporter.written_count += 1
-                    reporter.written_bytes += pipeline.buf_size_bytes
+                    reporter.completed_count += 1
+                    reporter.completed_bytes += pipeline.buf_size_bytes
             dispatch_io()
             dispatch_staging()
     except BaseException:
@@ -535,8 +538,8 @@ async def execute_read_reqs(
                 pipeline = task.result()
                 budget.release(pipeline.consuming_cost_bytes)
                 reporter.inflight_io -= 1
-                reporter.written_count += 1
-                reporter.written_bytes += pipeline.consuming_cost_bytes
+                reporter.completed_count += 1
+                reporter.completed_bytes += pipeline.consuming_cost_bytes
             dispatch()
     except BaseException:
         reporter.stop()

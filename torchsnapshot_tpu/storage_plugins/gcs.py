@@ -1,7 +1,7 @@
 """GCS storage plugin (reference: storage_plugins/gcs.py:47-270).
 
-Built on google-cloud-storage's sync client driven through the event loop's
-executor (the TPU-VM-typical setup: writes stream from host RAM to GCS over
+Built on google-cloud-storage's sync client driven through the dedicated
+bounded cloud-I/O pool (retry.cloud_io_executor; the TPU-VM-typical setup: writes stream from host RAM to GCS over
 the VM's NIC while the next step runs on device).
 
 Capabilities mirroring the reference, realized independently:
@@ -30,7 +30,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
-from .retry import CollectiveRetryStrategy, is_transient_error
+from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_error
 
 # Back-compat aliases: the retry machinery moved to .retry when it became
 # shared with the S3 plugin.
@@ -77,14 +77,15 @@ class GCSStoragePlugin(StoragePlugin):
         return f"{self.prefix}/{path}" if self.prefix else path
 
     async def _retrying(self, fn: Callable[[], Any]) -> Any:
-        """Run blocking ``fn`` in the loop executor under the collective
-        retry strategy; successful completion reports fleet progress."""
+        """Run blocking ``fn`` on the dedicated cloud-I/O pool under the
+        collective retry strategy; successful completion reports fleet
+        progress (see retry.cloud_io_executor)."""
         loop = asyncio.get_running_loop()
         attempt = 0
         while True:
             started = time.monotonic()
             try:
-                result = await loop.run_in_executor(None, fn)
+                result = await loop.run_in_executor(cloud_io_executor(), fn)
                 self.retry_strategy.report_progress()
                 return result
             except BaseException as e:  # noqa: B036

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -162,3 +163,40 @@ class CollectiveRetryStrategy:
         backoff = self.backoff_s(attempt)
         logger.warning("Transient storage error (%s); retrying in %.1fs", exc, backoff)
         await self._sleep(backoff)
+
+
+# ---------------------------------------------------------------- executor
+
+CLOUD_IO_THREADS_ENV_VAR = "TORCHSNAPSHOT_TPU_CLOUD_IO_THREADS"
+_DEFAULT_CLOUD_IO_THREADS = 16
+
+_executor = None
+_executor_lock = threading.Lock()
+
+
+def cloud_io_executor():
+    """The dedicated bounded thread pool for cloud-storage transfers.
+
+    The default asyncio loop executor is shared with everything else in
+    the process and sized by CPU count; 16-way transfer concurrency
+    borrowed from it competes with unrelated work and shrinks on small
+    hosts. Cloud I/O threads spend their time blocked in TLS reads and
+    socket writes (GIL released), so they are sized independently of
+    cores (``TORCHSNAPSHOT_TPU_CLOUD_IO_THREADS``, default 16 — the
+    scheduler's I/O concurrency ceiling). One pool per process, shared
+    by every S3/GCS plugin instance; threads are created lazily."""
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            import concurrent.futures
+            import os
+
+            raw = os.environ.get(CLOUD_IO_THREADS_ENV_VAR, "").strip()
+            try:
+                workers = int(raw) if raw else _DEFAULT_CLOUD_IO_THREADS
+            except ValueError:
+                workers = _DEFAULT_CLOUD_IO_THREADS
+            _executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, workers), thread_name_prefix="tsnap-cloud-io"
+            )
+        return _executor

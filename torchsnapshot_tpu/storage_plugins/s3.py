@@ -1,13 +1,18 @@
 """S3 storage plugin (reference: storage_plugins/s3.py:15-70).
 
-boto3's sync client driven through the event loop's executor; ranged GETs
+boto3's sync client driven through the dedicated bounded cloud-I/O pool
+(retry.cloud_io_executor — transfer concurrency independent of the host's
+core count and of unrelated executor work); ranged GETs
 use the HTTP Range header (reference: s3.py:53-60). Staged memoryviews are
 streamed via MemoryviewStream without copying (reference: s3.py:38-39).
 
 Beyond the reference: transfers run under the same
 :class:`~.retry.CollectiveRetryStrategy` as the GCS plugin — transient
 errors (throttling, 5xx, connection resets) retry with fleet-shared stall
-detection, and a retried upload rewinds its stream before resending.
+detection, a retried upload rewinds its stream before resending, and
+payloads >= 512 MiB upload via the multipart protocol (bounded part
+concurrency, per-part retry, abort-on-failure) instead of hitting S3's
+5 GiB single-PUT ceiling mid-save.
 
 A pre-built client can be injected via ``storage_options={"client": ...}``
 (used by the fake-backed tests, mirroring the GCS plugin's ``bucket``
@@ -17,11 +22,20 @@ injection).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 from typing import Any, Callable, Dict, Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
-from .retry import CollectiveRetryStrategy, is_transient_error
+from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_error
+
+# S3 hard limit for single-request PUTs is 5 GiB (and 5 TiB per object via
+# multipart). Array payloads are chunk/shard-split well below this upstream,
+# but ObjectEntry pickles (tokenizers, dataset state) are unbounded —
+# uploads at/above the threshold switch to the multipart protocol.
+MULTIPART_THRESHOLD_BYTES = 512 << 20
+MULTIPART_PART_BYTES = 256 << 20  # AWS minimum is 5 MiB/part, 10k parts max
+_MULTIPART_CONCURRENCY = 4
 
 
 class S3StoragePlugin(StoragePlugin):
@@ -34,6 +48,9 @@ class S3StoragePlugin(StoragePlugin):
         # A plugin is constructed per snapshot operation: a strategy reused
         # across operations must not inherit the previous fleet's deadline.
         self.retry_strategy.reset()
+        self.multipart_threshold = int(
+            options.get("multipart_threshold", MULTIPART_THRESHOLD_BYTES)
+        )
         self.client = options.get("client") or self._make_client(options)
 
     @staticmethod
@@ -52,14 +69,17 @@ class S3StoragePlugin(StoragePlugin):
         return f"{self.prefix}/{path}" if self.prefix else path
 
     async def _retrying(self, fn: Callable[[], Any]) -> Any:
-        """Run blocking ``fn`` in the loop executor under the collective
-        retry strategy; successful completion reports fleet progress."""
+        """Run blocking ``fn`` on the dedicated cloud-I/O pool under the
+        collective retry strategy; successful completion reports fleet
+        progress. (The default loop executor is NOT used: transfer
+        concurrency must not compete with unrelated executor work or
+        shrink with the host's core count.)"""
         loop = asyncio.get_running_loop()
         attempt = 0
         while True:
             started = time.monotonic()
             try:
-                result = await loop.run_in_executor(None, fn)
+                result = await loop.run_in_executor(cloud_io_executor(), fn)
                 self.retry_strategy.report_progress()
                 return result
             except BaseException as e:  # noqa: B036
@@ -73,9 +93,14 @@ class S3StoragePlugin(StoragePlugin):
     async def write(self, write_io: WriteIO) -> None:
         from ..memoryview_stream import MemoryviewStream
 
-        # Stream without copying — bytearray slabs included.
-        stream = MemoryviewStream(memoryview(write_io.buf))
+        mv = memoryview(write_io.buf)
         key = self._key(write_io.path)
+        if mv.nbytes >= self.multipart_threshold:
+            await self._multipart_upload(key, mv)
+            return
+
+        # Stream without copying — bytearray slabs included.
+        stream = MemoryviewStream(mv)
 
         def put() -> None:
             # Rewind before every attempt: a failed attempt may have
@@ -84,6 +109,63 @@ class S3StoragePlugin(StoragePlugin):
             self.client.put_object(Bucket=self.bucket, Key=key, Body=stream)
 
         await self._retrying(put)
+
+    async def _multipart_upload(self, key: str, mv: memoryview) -> None:
+        """Multipart PUT for payloads past the single-request limit zone:
+        parts upload concurrently (bounded) with per-part retry; any
+        failure aborts the upload server-side so incomplete parts don't
+        accrue storage."""
+        from ..memoryview_stream import MemoryviewStream
+
+        create = await self._retrying(
+            lambda: self.client.create_multipart_upload(Bucket=self.bucket, Key=key)
+        )
+        upload_id = create["UploadId"]
+        bounds = list(range(0, mv.nbytes, MULTIPART_PART_BYTES)) + [mv.nbytes]
+        sem = asyncio.Semaphore(_MULTIPART_CONCURRENCY)
+
+        async def put_part(number: int, lo: int, hi: int) -> Dict[str, Any]:
+            piece = mv[lo:hi]
+
+            def put() -> Dict[str, Any]:
+                stream = MemoryviewStream(piece)
+                return self.client.upload_part(
+                    Bucket=self.bucket,
+                    Key=key,
+                    UploadId=upload_id,
+                    PartNumber=number,
+                    Body=stream,
+                )
+
+            async with sem:
+                resp = await self._retrying(put)
+            return {"ETag": resp["ETag"], "PartNumber": number}
+
+        tasks = [
+            asyncio.ensure_future(put_part(i + 1, lo, hi))
+            for i, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:]))
+        ]
+        try:
+            parts = list(await asyncio.gather(*tasks))
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            with contextlib.suppress(Exception):
+                await self._retrying(
+                    lambda: self.client.abort_multipart_upload(
+                        Bucket=self.bucket, Key=key, UploadId=upload_id
+                    )
+                )
+            raise
+        await self._retrying(
+            lambda: self.client.complete_multipart_upload(
+                Bucket=self.bucket,
+                Key=key,
+                UploadId=upload_id,
+                MultipartUpload={"Parts": parts},
+            )
+        )
 
     async def read(self, read_io: ReadIO) -> None:
         kwargs: Dict[str, Any] = {
