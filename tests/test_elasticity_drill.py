@@ -1,0 +1,221 @@
+"""The flagship full-system elasticity drill (round-4 verdict item 7).
+
+One story end to end, composing every production feature at once:
+CheckpointManager (cadence + retention + resume) + incremental dedup +
+zstd compression + mirrored two-tier storage, across REAL
+``jax.distributed`` world-size changes:
+
+1. world=8 trains steps 0-2, checkpointing each (step 1 and 2 chain
+   incrementally against their predecessors), then the job "dies".
+2. world=4 resumes from the latest committed step, verifies the restored
+   state bit-exactly against the oracle, trains step 3, saves it
+   (chained against the RESTORED step — manager.restore seeds the
+   chain), and dies.
+3. world=16 resumes from step 3, reading transparently through the
+   incremental chain 3→2→1→0, and verifies bit-exactness again.
+
+Afterwards the single-process checks: `cli verify` passes on the final
+snapshot (checksums + chain closure), and each step's PER-STEP mirror
+replica restores independently after the primary tier is destroyed —
+total-primary-loss recovery.
+
+Elasticity rules seam: /root/reference/torchsnapshot/snapshot.py:112-155
+(world-size flexibility); this drill exercises them across three worlds
+with genuinely non-addressable shards (one CPU device per process).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.test_utils import _find_free_port, run_with_subprocesses
+
+pytestmark = [pytest.mark.multiprocess, pytest.mark.slow]
+
+ROWS, COLS = 16, 8  # divisible by 8, 4, and 16 ranks
+
+
+def _init_jax_dist(rank: int, world_size: int, port: int):
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=world_size,
+        process_id=rank,
+    )
+    return jax
+
+
+def _oracle(step: int) -> np.ndarray:
+    # Value of the "weights" after `step` completed training steps.
+    return np.arange(ROWS * COLS, dtype=np.float32).reshape(ROWS, COLS) + step
+
+
+def _assert_local_shards_equal(arr, expected: np.ndarray) -> None:
+    # device_get of a non-fully-addressable array is invalid; each process
+    # verifies the shards it owns (together the worlds cover every row).
+    for shard in arr.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data), expected[shard.index])
+
+
+def _make_sharded(jax, values: np.ndarray):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    return jax.make_array_from_callback(
+        values.shape, NamedSharding(mesh, P("x", None)), lambda idx: values[idx]
+    )
+
+
+def _manager(root: str, mirror: str):
+    from torchsnapshot_tpu import CheckpointManager
+    from torchsnapshot_tpu.pg_wrapper import get_default_pg
+
+    return CheckpointManager(
+        root,
+        incremental=True,
+        compression="zstd:3",
+        keep_every=1,  # archival: the drill inspects every step afterwards
+        # step and the frozen backbone are identical on every rank and
+        # must stay restorable on ranks beyond the saving world (per-rank
+        # entries are owner-only under the elasticity rules).
+        replicated=["train/step", "train/frozen"],
+        storage_options={"mirror_url": mirror},
+        pg=get_default_pg(),
+    )
+
+
+# Constant across steps AND worlds: every incremental save deduplicates it
+# against the previous step, so the drill genuinely reads through the
+# origin chain 3->2->1->0 at restore time.
+def _frozen() -> np.ndarray:
+    return np.linspace(0.0, 1.0, 4096, dtype=np.float32)
+
+
+def _phase_a_worker(rank, world_size, root, mirror, port):
+    """world=8: train steps 0..2, checkpoint each, die."""
+    jax = _init_jax_dist(rank, world_size, port)
+    from torchsnapshot_tpu import StateDict
+
+    mgr = _manager(root, mirror)
+    for step in range(3):
+        w = _make_sharded(jax, _oracle(step))  # weights after `step` steps
+        state = {"train": StateDict(w=w, step=step, frozen=_frozen())}
+        assert mgr.save(step, state) is True
+    return "ok"
+
+
+def _phase_b_worker(rank, world_size, root, mirror, port):
+    """world=4: resume latest, verify, train one step, save, die."""
+    jax = _init_jax_dist(rank, world_size, port)
+    from torchsnapshot_tpu import StateDict
+
+    mgr = _manager(root, mirror)
+    latest = mgr.latest_step()
+    assert latest == 2, latest
+    dst = {"train": StateDict(w=_make_sharded(jax, np.zeros((ROWS, COLS), np.float32)), step=-1, frozen=np.zeros(4096, np.float32))}
+    assert mgr.restore(dst) == 2
+    _assert_local_shards_equal(dst["train"]["w"], _oracle(2))
+    assert dst["train"]["step"] == 2
+    np.testing.assert_array_equal(dst["train"]["frozen"], _frozen())
+
+    w = _make_sharded(jax, _oracle(3))  # step 3 of training
+    state = {"train": StateDict(w=w, step=3, frozen=_frozen())}
+    assert mgr.save(3, state) is True
+    return "ok"
+
+
+def _phase_c_worker(rank, world_size, root, mirror, port):
+    """world=16: resume step 3 through the incremental chain, verify."""
+    jax = _init_jax_dist(rank, world_size, port)
+    from torchsnapshot_tpu import StateDict
+
+    mgr = _manager(root, mirror)
+    assert mgr.latest_step() == 3
+    dst = {"train": StateDict(w=_make_sharded(jax, np.zeros((ROWS, COLS), np.float32)), step=-1, frozen=np.zeros(4096, np.float32))}
+    assert mgr.restore(dst) == 3
+    _assert_local_shards_equal(dst["train"]["w"], _oracle(3))
+    assert dst["train"]["step"] == 3
+    # frozen was never re-written after step 0: this read followed the
+    # recorded origin to step 0's payload.
+    np.testing.assert_array_equal(dst["train"]["frozen"], _frozen())
+    # A re-save of the restored step must be skipped on EVERY rank.
+    assert mgr.save(3, dst) is False
+    return "ok"
+
+
+def test_elasticity_drill_8_to_4_to_16(tmp_path) -> None:
+    root = str(tmp_path / "primary")
+    mirror = f"fs://{tmp_path}/mirror"
+
+    for world, worker, timeout in (
+        (8, _phase_a_worker, 420),
+        (4, _phase_b_worker, 300),
+        (16, _phase_c_worker, 600),
+    ):
+        port = _find_free_port()
+        results = run_with_subprocesses(
+            worker, world, root, mirror, port, timeout=timeout
+        )
+        assert all(v == "ok" for v in results.values()), (world, results)
+
+    steps = sorted(os.listdir(root))
+    assert steps == [f"step_{i:010d}" for i in range(4)]
+
+    # Chain integrity: cli verify checks every checksum, reading dedup'd
+    # payloads through their origin snapshots.
+    from torchsnapshot_tpu.cli import main as cli_main
+
+    assert cli_main(["verify", os.path.join(root, "step_0000000003")]) == 0
+
+    # Incremental actually elided bytes: each step's manifest records
+    # (transitive) origins for the unchanged frozen entry.
+    assert cli_main(["deps", root]) == 0
+    from torchsnapshot_tpu.cli import _entry_payloads
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    for step in (1, 2, 3):
+        with open(
+            os.path.join(root, f"step_{step:010d}", ".snapshot_metadata")
+        ) as f:
+            meta = SnapshotMetadata.from_yaml(f.read())
+        origins = {
+            origin
+            for e in meta.manifest.values()
+            for _, _, _, _, origin in _entry_payloads(e)
+            if origin
+        }
+        # Origins are TRANSITIVE: they name the snapshot physically
+        # holding the bytes — frozen was only ever written at step 0.
+        assert origins and all(
+            o.endswith("step_0000000000") for o in origins
+        ), (step, origins)
+
+    # Total primary loss: every step's PER-STEP mirror replica restores
+    # on its own (virtual mesh, single process).
+    shutil.rmtree(root)
+    import jax
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    for step in (0, 3):
+        mdir = f"{tmp_path}/mirror/step_{step:010d}"
+        assert os.path.isfile(os.path.join(mdir, ".snapshot_metadata")), mdir
+        dst = {"train": StateDict(w=np.zeros((ROWS, COLS), np.float32), step=-1, frozen=np.zeros(4096, np.float32))}
+        Snapshot(mdir).restore(dst)
+        np.testing.assert_array_equal(dst["train"]["w"], _oracle(step))
+        assert dst["train"]["step"] == step
+        np.testing.assert_array_equal(dst["train"]["frozen"], _frozen())
