@@ -274,3 +274,22 @@ def test_committed_skip_is_rank0_broadcast(tmp_path):
 
     results = run_with_subprocesses(_committed_skip_worker, world, roots)
     assert results == {0: False, 1: False}
+
+
+def test_warmup_noop_under_incremental_or_compression(tmp_path):
+    """The staging pool only feeds the fused (no-dedup, no-codec) path;
+    warming it under incremental/compression would pin unused memory."""
+    # Prime-sized array: the process-global pool can't already hold a
+    # recycled slab of this size from earlier tests.
+    state = {"app": StateDict(w=np.zeros(100003, np.uint8))}
+    assert CheckpointManager(str(tmp_path / "a"), incremental=True).warmup(state) == 0
+    assert (
+        CheckpointManager(str(tmp_path / "b"), compression="zlib:6").warmup(state)
+        == 0
+    )
+    warmed = CheckpointManager(str(tmp_path / "c")).warmup(state)
+    from torchsnapshot_tpu._native import native_available
+    from torchsnapshot_tpu.integrity import checksums_enabled
+
+    if native_available() and checksums_enabled():
+        assert warmed > 0

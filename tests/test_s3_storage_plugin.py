@@ -291,3 +291,40 @@ def test_transfers_run_on_dedicated_cloud_pool() -> None:
     plugin = make_plugin(RecordingClient())
     run(plugin.write(WriteIO(path="t.obj", buf=memoryview(b"x" * 64))))
     assert seen and all(n.startswith("tsnap-cloud-io") for n in seen)
+
+
+def test_multipart_complete_commit_then_lost_response(monkeypatch) -> None:
+    """CompleteMultipartUpload is not idempotent: if the server commits
+    but the response is lost, the retry must detect the committed object
+    (head_object) instead of failing on the dead upload id."""
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    monkeypatch.setattr(s3mod, "MULTIPART_PART_BYTES", 1024)
+
+    class CommitThenDropClient(FakeMultipartS3Client):
+        def __init__(self):
+            super().__init__()
+            self.completes = 0
+
+        def complete_multipart_upload(self, Bucket, Key, UploadId, MultipartUpload):
+            self.completes += 1
+            super().complete_multipart_upload(Bucket, Key, UploadId, MultipartUpload)
+            if self.completes == 1:
+                # Server committed; response never reached the client.
+                raise ConnectionError("response lost")
+
+        def head_object(self, Bucket, Key):
+            if (Bucket, Key) not in self.store:
+                raise KeyError(Key)
+            return {"ContentLength": len(self.store[(Bucket, Key)])}
+
+    client = CommitThenDropClient()
+    plugin = make_plugin(
+        client,
+        multipart_threshold=2048,
+        retry_strategy=CollectiveRetryStrategy(base_backoff_s=0.01),
+    )
+    data = b"q" * 3000
+    run(plugin.write(WriteIO(path="lost.obj", buf=memoryview(data))))
+    assert client.store[("fake-bucket", "prefix/lost.obj")] == data
+    assert client.completes == 1  # the retry resolved via head_object

@@ -145,6 +145,8 @@ class _StagingPool:
         bytes newly faulted. Bounded by the pool limit."""
         from collections import Counter
 
+        if not _BUFFER_PROTOCOL_OK:  # pool is never drawn from pre-3.12
+            return 0
         want = Counter(int(s) for s in sizes if s > 0)
         warmed = 0
         for nbytes, cnt in want.items():
@@ -209,7 +211,7 @@ def to_host(arr) -> np.ndarray:
     return np.asarray(arr)
 
 
-def warmup_staging(app_state) -> int:
+def warmup_staging(app_state, pg=None) -> int:
     """Pre-fault the staging pool for ``app_state`` so the FIRST
     ``async_take`` blocks like a warm one.
 
@@ -221,15 +223,47 @@ def warmup_staging(app_state) -> int:
     does it on its ``warmup`` method); cheap to call again after state
     shapes change. Returns bytes newly faulted.
 
-    Sizes mirror the write partition: plain arrays (chunked at the
-    chunk-preparer's ranges when large), and for GSPMD-sharded jax arrays
-    the exact owned-piece sizes this process will stage
-    (``ShardedArrayIOPreparer.staged_piece_sizes``)."""
+    No-op (returns 0) whenever staging cannot draw from the pool: the
+    pool feeds only the fused copy+CRC path (``_stage_fused``), which
+    needs the PEP 688 holder (Python >= 3.12), the native extension, and
+    checksums enabled — warming slabs no save will ever draw would pin
+    pool-limit bytes for nothing. Dedup (incremental) and compression
+    also bypass the pool; CheckpointManager.warmup checks those, since
+    they are its configuration rather than process state.
+
+    Sizes mirror the write partition: for GSPMD-sharded jax arrays the
+    exact owned-piece sizes this process stages
+    (``ShardedArrayIOPreparer.staged_piece_sizes``); large dense arrays
+    at the chunk-preparer's ranges — under a multi-rank ``pg``,
+    replicated chunked entries are striped across ranks, so only
+    ~1/world of the chunk set is warmed (an approximation of the
+    deterministic striping partition; under-warming just faults the
+    difference on first use). Device arrays whose staging needs no
+    consistency copy (TPU-backed: DtoH already produces host-owned
+    memory) are skipped."""
     import jax
 
+    from .._native import native_available
+    from ..integrity import checksums_enabled
     from . import chunked
     from .prepare import is_sharded_jax_array
     from .sharded import ShardedArrayIOPreparer
+
+    if not _BUFFER_PROTOCOL_OK or not native_available() or not checksums_enabled():
+        return 0
+
+    if pg is not None:
+        from ..pg_wrapper import PGWrapper
+
+        wrapper = PGWrapper(pg)
+        world, rank = wrapper.get_world_size(), wrapper.get_rank()
+    else:
+        world, rank = 1, 0
+
+    def needs_copy(leaf) -> bool:
+        if _is_jax_array(leaf):
+            return next(iter(leaf.sharding.device_set)).platform == "cpu"
+        return True
 
     sizes: List[int] = []
     for stateful in app_state.values():
@@ -238,14 +272,18 @@ def warmup_staging(app_state) -> int:
             continue
         for leaf in jax.tree_util.tree_leaves(state_dict()):
             if is_sharded_jax_array(leaf):
-                sizes.extend(ShardedArrayIOPreparer.staged_piece_sizes(leaf))
+                if needs_copy(leaf):
+                    sizes.extend(ShardedArrayIOPreparer.staged_piece_sizes(leaf))
             elif _is_jax_array(leaf) or isinstance(leaf, np.ndarray):
+                if not needs_copy(leaf):
+                    continue
                 nbytes = array_nbytes(leaf)
                 if nbytes > chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES and leaf.shape:
                     row = nbytes // max(leaf.shape[0], 1)
-                    for lo, hi in chunked.ChunkedArrayIOPreparer.chunk_ranges(
+                    ranges = chunked.ChunkedArrayIOPreparer.chunk_ranges(
                         leaf.shape, dtype_to_string(leaf.dtype)
-                    ):
+                    )
+                    for lo, hi in ranges[rank::world]:
                         sizes.append((hi - lo) * row)
                 else:
                     sizes.append(nbytes)

@@ -192,12 +192,13 @@ class ShardedArrayIOPreparer:
 
     @classmethod
     def _owned_pieces(cls, arr):
-        """Yield ``(p_off, p_sz, piece_data)`` for every piece THIS process
+        """Yield ``(p_off, p_sz, get_piece)`` for every piece THIS process
         writes: its owned boxes (deduped, hash-balanced election), each
-        subdivided to the shard size cap. The single source of the write
-        partition — prepare_write builds entries from it, and the staging
-        warmup (io_preparers.array.warmup_staging) sizes pool slabs from
-        it without planning a real write."""
+        subdivided to the shard size cap. ``get_piece`` is a thunk — the
+        device-array slice only dispatches when called, so size-only
+        consumers (the staging warmup) never materialize data. The single
+        source of the write partition: prepare_write builds entries from
+        it, warmup_staging sizes pool slabs from it."""
         import jax
 
         sharding = arr.sharding
@@ -233,8 +234,11 @@ class ShardedArrayIOPreparer:
                     slice(po - o, po - o + ps)
                     for po, o, ps in zip(p_off, offsets, p_sz)
                 )
-                piece = data[local_slices] if local_slices else data
-                yield p_off, p_sz, piece
+
+                def get_piece(data=data, local_slices=local_slices):
+                    return data[local_slices] if local_slices else data
+
+                yield p_off, p_sz, get_piece
 
     @classmethod
     def staged_piece_sizes(cls, arr) -> List[int]:
@@ -256,7 +260,7 @@ class ShardedArrayIOPreparer:
         dtype_str = dtype_to_string(arr.dtype)
         shards: List[Shard] = []
         write_reqs: List[WriteReq] = []
-        for p_off, p_sz, piece in cls._owned_pieces(arr):
+        for p_off, p_sz, get_piece in cls._owned_pieces(arr):
             location = f"{storage_path_prefix}_{'_'.join(map(str, p_off))}"
             entry = ArrayEntry(
                 location=location,
@@ -267,7 +271,10 @@ class ShardedArrayIOPreparer:
             )
             shards.append(Shard(offsets=list(p_off), sizes=list(p_sz), array=entry))
             write_reqs.append(
-                WriteReq(path=location, buffer_stager=ArrayBufferStager(piece, entry))
+                WriteReq(
+                    path=location,
+                    buffer_stager=ArrayBufferStager(get_piece(), entry),
+                )
             )
         return (
             ShardedArrayEntry(dtype=dtype_str, shape=list(arr.shape), shards=shards),

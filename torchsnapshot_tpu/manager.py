@@ -161,10 +161,16 @@ class CheckpointManager:
         the cold caller-blocked interval is dominated by first-touch page
         faults in fresh staging slabs). Call once after building the app
         state; cheap to call again after shapes change. Returns bytes
-        newly faulted."""
+        newly faulted.
+
+        No-op under ``incremental`` or ``compression``: those staging
+        paths (dedup digesting, codec compression) never draw from the
+        pool, so warming it would pin memory no save uses."""
+        if self.incremental or self.compression:
+            return 0
         from .io_preparers.array import warmup_staging
 
-        return warmup_staging(app_state)
+        return warmup_staging(app_state, pg=self.pg)
 
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0

@@ -158,14 +158,32 @@ class S3StoragePlugin(StoragePlugin):
                     )
                 )
             raise
-        await self._retrying(
-            lambda: self.client.complete_multipart_upload(
+        # CompleteMultipartUpload is not idempotent: a transient failure
+        # AFTER the server committed (e.g. connection reset while reading
+        # the response) makes the retry hit a dead upload id. Before each
+        # retry, treat an existing object as success — the key was created
+        # by this upload. (A lost CREATE response can still orphan an
+        # upload id; S3's AbortIncompleteMultipartUpload lifecycle rule is
+        # the standard backstop for that.)
+        sent_once = False
+
+        def complete() -> None:
+            nonlocal sent_once
+            if sent_once:
+                try:
+                    self.client.head_object(Bucket=self.bucket, Key=key)
+                    return  # a prior attempt committed server-side
+                except Exception:
+                    pass
+            sent_once = True
+            self.client.complete_multipart_upload(
                 Bucket=self.bucket,
                 Key=key,
                 UploadId=upload_id,
                 MultipartUpload={"Parts": parts},
             )
-        )
+
+        await self._retrying(complete)
 
     async def read(self, read_io: ReadIO) -> None:
         kwargs: Dict[str, Any] = {
