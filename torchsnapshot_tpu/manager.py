@@ -45,6 +45,9 @@ Semantics worth knowing:
 - ``incremental=True`` records digests on every save and chains each
   snapshot to the previous COMMITTED one; retention's base-closure
   keeps chains restorable (consolidate before archiving elsewhere).
+- Retention governs the PRIMARY tier only: per-step mirror replicas
+  accumulate as archival history (bound them with the ``prune`` CLI
+  against the mirror root when it is scannable).
 """
 
 from __future__ import annotations
