@@ -59,7 +59,11 @@ class _StoreServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(128)
+        # Every rank of a pod (plus async-commit clones) connects at
+        # startup near-simultaneously; a short accept backlog would
+        # refuse some of that storm. The kernel caps this at
+        # net.core.somaxconn — listen() just must not be the limiter.
+        self._sock.listen(1024)
         self.port = self._sock.getsockname()[1]
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
