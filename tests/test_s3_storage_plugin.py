@@ -328,3 +328,32 @@ def test_multipart_complete_commit_then_lost_response(monkeypatch) -> None:
     run(plugin.write(WriteIO(path="lost.obj", buf=memoryview(data))))
     assert client.store[("fake-bucket", "prefix/lost.obj")] == data
     assert client.completes == 1  # the retry resolved via head_object
+
+
+def test_large_ranged_read_splits_into_concurrent_chunks(monkeypatch) -> None:
+    """Ranged GETs past the chunk size fetch concurrently and reassemble
+    bit-exactly; short chunk responses raise instead of zero-filling."""
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    monkeypatch.setattr(s3mod, "RANGED_READ_CHUNK_BYTES", 1024)
+    client = FakeS3Client()
+    plugin = make_plugin(client)
+    data = np.random.default_rng(1).integers(0, 255, 10_000, np.uint8).tobytes()
+    run(plugin.write(WriteIO(path="r.obj", buf=memoryview(data))))
+
+    read_io = ReadIO(path="r.obj", byte_range=(500, 9_500))
+    run(plugin.read(read_io))
+    assert bytes(read_io.buf) == data[500:9_500]
+    # ceil(9000/1024) = 9 chunk GETs hit the client
+    assert len(client.get_ranges) == 9
+
+    class TruncatingClient(FakeS3Client):
+        def get_object(self, Bucket, Key, Range=None):
+            resp = super().get_object(Bucket, Key, Range)
+            return {"Body": FakeBody(resp["Body"].read()[:-1])}
+
+    t_client = TruncatingClient()
+    t_client.store = dict(client.store)
+    t_plugin = make_plugin(t_client)
+    with pytest.raises(IOError, match="short read"):
+        run(t_plugin.read(ReadIO(path="r.obj", byte_range=(0, 8_000))))

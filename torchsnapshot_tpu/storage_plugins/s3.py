@@ -36,6 +36,10 @@ from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_erro
 MULTIPART_THRESHOLD_BYTES = 512 << 20
 MULTIPART_PART_BYTES = 256 << 20  # AWS minimum is 5 MiB/part, 10k parts max
 _MULTIPART_CONCURRENCY = 4
+# Ranged GETs past this size split into concurrent chunk GETs so a
+# single-large-entry restore is not bounded by one HTTP stream.
+RANGED_READ_CHUNK_BYTES = 100 << 20
+_RANGED_READ_CONCURRENCY = 4
 
 
 class S3StoragePlugin(StoragePlugin):
@@ -186,10 +190,17 @@ class S3StoragePlugin(StoragePlugin):
         await self._retrying(complete)
 
     async def read(self, read_io: ReadIO) -> None:
-        kwargs: Dict[str, Any] = {
-            "Bucket": self.bucket,
-            "Key": self._key(read_io.path),
-        }
+        key = self._key(read_io.path)
+        if read_io.byte_range is not None:
+            lo, hi = read_io.byte_range
+            if hi - lo > RANGED_READ_CHUNK_BYTES:
+                # Split a large ranged GET into concurrent chunk GETs (the
+                # GCS plugin's pattern): a single-large-entry restore is
+                # otherwise bounded by one HTTP stream's throughput.
+                await self._chunked_ranged_read(read_io, key, lo, hi)
+                return
+
+        kwargs: Dict[str, Any] = {"Bucket": self.bucket, "Key": key}
         if read_io.byte_range is not None:
             lo, hi = read_io.byte_range
             kwargs["Range"] = f"bytes={lo}-{hi - 1}"  # inclusive; zero-length
@@ -209,6 +220,42 @@ class S3StoragePlugin(StoragePlugin):
                     f"for range [{lo}, {hi})"
                 )
         read_io.buf = buf  # uncopied bytes
+
+    async def _chunked_ranged_read(
+        self, read_io: ReadIO, key: str, lo: int, hi: int
+    ) -> None:
+        out = bytearray(hi - lo)
+        ranges = []
+        pos = lo
+        while pos < hi:
+            ranges.append((pos, min(pos + RANGED_READ_CHUNK_BYTES, hi)))
+            pos = ranges[-1][1]
+        sem = asyncio.Semaphore(_RANGED_READ_CONCURRENCY)
+
+        async def fetch(p: int, q: int) -> None:
+            def get() -> bytes:
+                return self.client.get_object(
+                    Bucket=self.bucket, Key=key, Range=f"bytes={p}-{q - 1}"
+                )["Body"].read()
+
+            async with sem:
+                chunk = await self._retrying(get)
+            if len(chunk) != q - p:
+                raise IOError(
+                    f"short read on {read_io.path}: got {len(chunk)} bytes "
+                    f"for range [{p}, {q})"
+                )
+            out[p - lo : p - lo + len(chunk)] = chunk
+
+        tasks = [asyncio.ensure_future(fetch(p, q)) for p, q in ranges]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        read_io.buf = out
 
     async def delete(self, path: str) -> None:
         key = self._key(path)
