@@ -378,11 +378,16 @@ class LinearBarrier:
     def arrive(self, timeout: Optional[float] = None) -> None:
         self.store.set(self._key("arrive", str(self.rank)), b"1")
         if self.rank == self.leader_rank:
-            for r in range(self.world_size):
-                key, value = self.store.wait_any(
-                    [self._key("arrive", str(r)), self._err_key()], timeout
-                )
-                self._raise_if_error(key, value)
+            # One server-side collect instead of world sequential waits:
+            # the leader's arrival phase is on the commit critical path.
+            stopped, items = self.store.collect(
+                self._key("arrive") + "/",
+                self.world_size,
+                stop_keys=[self._err_key()],
+                timeout=timeout,
+            )
+            if stopped is not None:
+                self._raise_if_error(stopped, items[stopped])
 
     def depart(self, timeout: Optional[float] = None) -> None:
         if self.rank == self.leader_rank:
