@@ -173,3 +173,37 @@ def test_collect_timeout(store) -> None:
 
     with pytest.raises(TimeoutError):
         store.collect("absent/", 2, timeout=0.2)
+
+
+def test_liveness_publishes_on_connection_drop(store) -> None:
+    """A liveness-registered connection that drops without deregistering
+    publishes its death payload; a clean deregister does not."""
+    import time
+
+    from torchsnapshot_tpu.dist_store import TCPStore
+
+    dirty = store.clone()
+    dirty.register_liveness("death/dirty", b"rank-x-died")
+    clean = store.clone()
+    clean.register_liveness("death/clean", b"rank-y-died")
+    clean.deregister_liveness("death/clean")
+    dirty.close()
+    clean.close()
+    deadline = time.monotonic() + 10
+    while not store.check("death/dirty") and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert store.get("death/dirty", timeout=5.0) == b"rank-x-died"
+    assert not store.check("death/clean")
+
+
+def test_liveness_does_not_overwrite_existing_key(store) -> None:
+    """First death wins: a second dropped connection must not clobber an
+    already-published death/error payload."""
+    import time
+
+    c1 = store.clone()
+    c1.register_liveness("death/one", b"first")
+    store.set("death/one", b"already-there")
+    c1.close()
+    time.sleep(0.3)
+    assert store.get("death/one", timeout=5.0) == b"already-there"

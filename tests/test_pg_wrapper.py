@@ -164,3 +164,56 @@ def test_error_channel_unblocks_all_gather() -> None:
     results = run_with_subprocesses(_gather_error_worker, 2)
     assert results[0] == "reported"
     assert results[1] == "raised"
+
+
+def _peer_death_worker(rank, world, store_addr, q):
+    import os
+    import time
+
+    from torchsnapshot_tpu.dist_store import create_store
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper, init_process_group
+
+    store = create_store(rank=rank, addr=store_addr)
+    init_process_group(store=store, rank=rank, world_size=world)
+    pg = PGWrapper()
+    pg.barrier()  # everyone alive and registered
+    if rank == 2:
+        os._exit(1)  # dies WITHOUT deregistering — a real crash
+    t0 = time.monotonic()
+    try:
+        pg.all_gather_object(rank)  # rank 2 never contributes
+        q.put((rank, "no-error", None))
+    except RuntimeError as e:
+        assert "died" in str(e), e
+        q.put((rank, "death-detected", time.monotonic() - t0))
+
+
+def test_peer_death_unblocks_collectives_fast() -> None:
+    """A rank dying mid-collective must surface to peers in seconds (the
+    server publishes the death channel when its connection drops), not
+    after the 1800 s store timeout."""
+    import multiprocessing as mp
+
+    from torchsnapshot_tpu.test_utils import _find_free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    addr = f"127.0.0.1:{_find_free_port()}"
+    procs = [
+        ctx.Process(target=_peer_death_worker, args=(r, 3, addr, q), daemon=True)
+        for r in range(3)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(2):  # rank 2 never reports
+        rank, status, elapsed = q.get(timeout=120)
+        results[rank] = (status, elapsed)
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    assert set(results) == {0, 1}, results
+    for rank, (status, elapsed) in results.items():
+        assert status == "death-detected", results
+        assert elapsed < 30, f"rank {rank} took {elapsed:.1f}s to observe the death"

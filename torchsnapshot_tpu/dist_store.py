@@ -26,6 +26,10 @@ from typing import Any, Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+# Failure-detection channel shared with pg_wrapper: the server publishes
+# this key when a liveness-registered connection (one per rank) drops
+# without a clean deregister. Collective waits watch it.
+DEATH_KEY = "pgw/death"
 _LEN = struct.Struct(">Q")
 
 
@@ -82,14 +86,32 @@ class _StoreServer:
             ).start()
 
     def _handle(self, conn: socket.socket) -> None:
+        liveness: Dict[str, bytes] = {}
         try:
             while True:
                 req = _recv_msg(conn)
+                op = req.get("op")
+                if op == "register_liveness":
+                    # Failure detection: if this connection drops without a
+                    # deregister, publish the registered key so peers
+                    # blocked in collectives raise instead of timing out.
+                    liveness[req["key"]] = req["value"]
+                    _send_msg(conn, {"ok": True})
+                    continue
+                if op == "deregister_liveness":
+                    liveness.pop(req["key"], None)
+                    _send_msg(conn, {"ok": True})
+                    continue
                 _send_msg(conn, self._dispatch(req))
         except (ConnectionError, OSError, EOFError):
             pass
         finally:
             conn.close()
+            if liveness:
+                with self._cond:
+                    for key, value in liveness.items():
+                        self._data.setdefault(key, value)
+                    self._cond.notify_all()
 
     def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
         op = req["op"]
@@ -290,6 +312,18 @@ class TCPStore:
             {"op": "delete_prefix", "prefix": prefix, "except_keys": except_keys}
         )["value"]
 
+    def register_liveness(self, key: str, value: bytes) -> None:
+        """Publish ``key``=``value`` if THIS connection ever drops without
+        ``deregister_liveness`` — the failure-detection hook: a process
+        dying mid-collective makes its death visible to peers through a
+        key they already watch, instead of leaving them blocked until the
+        store timeout. Clones do NOT inherit registration (a background
+        thread closing its connection is not a process death)."""
+        self._request({"op": "register_liveness", "key": key, "value": bytes(value)})
+
+    def deregister_liveness(self, key: str) -> None:
+        self._request({"op": "deregister_liveness", "key": key})
+
     def clone(self) -> "TCPStore":
         """A new connection to the same server (for use from another thread)."""
         return TCPStore(self.host, self.port, is_server=False, timeout=self.timeout)
@@ -369,6 +403,10 @@ class LinearBarrier:
         self.store.set(self._err_key(), payload)
 
     def _raise_if_error(self, key: str, value: bytes) -> None:
+        if key == DEATH_KEY:
+            raise RuntimeError(
+                f"A peer rank died at barrier {self.prefix!r}."
+            ) from pickle.loads(value)
         if key == self._err_key():
             err = pickle.loads(value)
             raise RuntimeError(
@@ -383,7 +421,7 @@ class LinearBarrier:
             stopped, items = self.store.collect(
                 self._key("arrive") + "/",
                 self.world_size,
-                stop_keys=[self._err_key()],
+                stop_keys=[self._err_key(), DEATH_KEY],
                 timeout=timeout,
             )
             if stopped is not None:
@@ -398,6 +436,6 @@ class LinearBarrier:
             # rank has acked (pg_wrapper.PGWrapper.retire).
         else:
             key, value = self.store.wait_any(
-                [self._key("depart"), self._err_key()], timeout
+                [self._key("depart"), self._err_key(), DEATH_KEY], timeout
             )
             self._raise_if_error(key, value)

@@ -44,10 +44,16 @@ import uuid
 import zlib
 from typing import Any, Dict, List, Optional
 
-from .dist_store import TCPStore
+from .dist_store import DEATH_KEY, TCPStore
 
 _HANDSHAKE_SEQ_KEY = "pgw/seq"
 _HANDSHAKE_PREFIX = "pgw/handshake"
+# DEATH_KEY (dist_store): init_process_group registers each rank's
+# persistent store connection so the SERVER publishes that key if the
+# connection drops without a clean deregister. Every collective wait
+# watches it — a peer dying mid-collective surfaces in seconds instead of
+# the store timeout (reference behavior: torch.distributed would hang
+# until the collective timeout).
 
 # Collective payloads above this compress before hitting the store: at pod
 # scale the manifest all-gather moves world² × payload bytes through one
@@ -97,7 +103,9 @@ def init_process_group(
 
     With no arguments, derives identity from jax.distributed if initialized
     (requires a coordinator store to have been provided) or falls back to a
-    single-process group.
+    single-process group. Registers this process's store connection on the
+    death channel: if the process dies mid-collective, peers raise within
+    seconds instead of blocking until the store timeout.
     """
     global _default_pg
     if rank is None or world_size is None:
@@ -106,7 +114,32 @@ def init_process_group(
         rank = jax.process_index() if rank is None else rank
         world_size = jax.process_count() if world_size is None else world_size
     _default_pg = ProcessGroup(store, rank, world_size)
+    if store is not None and world_size > 1:
+        store.register_liveness(
+            DEATH_KEY,
+            pickle.dumps(
+                RuntimeError(
+                    f"rank {rank} died (store connection lost without a "
+                    "clean shutdown)"
+                )
+            ),
+        )
     return _default_pg
+
+
+def destroy_process_group() -> None:
+    """Clean shutdown: deregister this rank from the death channel and
+    drop the default group. Call when a rank finishes intentionally while
+    peers may still run (otherwise its normal exit is indistinguishable
+    from a mid-collective death)."""
+    global _default_pg
+    pg = _default_pg
+    _default_pg = None
+    if pg is not None and pg.store is not None and pg.world_size > 1:
+        try:
+            pg.store.deregister_liveness(DEATH_KEY)
+        except Exception:
+            pass
 
 
 def get_default_pg() -> Optional[ProcessGroup]:
@@ -229,12 +262,17 @@ class PGWrapper:
         self.pg.store.set(self._error_key(), payload)
 
     def _wait(self, key: str) -> bytes:
-        """Wait for ``key``, racing it against the error channel."""
-        got_key, value = self.pg.store.wait_any([key, self._error_key()])
+        """Wait for ``key``, racing it against the error channel and the
+        death channel."""
+        got_key, value = self.pg.store.wait_any(
+            [key, self._error_key(), DEATH_KEY]
+        )
         if got_key != key:
             err = pickle.loads(value)
             raise RuntimeError(
-                "A peer rank reported an error during a collective."
+                "A peer rank died during a collective."
+                if got_key == DEATH_KEY
+                else "A peer rank reported an error during a collective."
             ) from err
         return value
 
@@ -269,12 +307,16 @@ class PGWrapper:
         store = self.pg.store
         if self.get_rank() == 0:
             stopped, items = store.collect(
-                prefix, self.get_world_size() - 1, stop_keys=[self._error_key()]
+                prefix,
+                self.get_world_size() - 1,
+                stop_keys=[self._error_key(), DEATH_KEY],
             )
             if stopped is not None:
                 err = pickle.loads(items[stopped])
                 raise RuntimeError(
-                    "A peer rank reported an error during a collective."
+                    "A peer rank died during a collective."
+                    if stopped == DEATH_KEY
+                    else "A peer rank reported an error during a collective."
                 ) from err
             assembled = [obj] + [
                 _loads(items[f"{prefix}{r}"])

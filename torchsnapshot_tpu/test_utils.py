@@ -110,6 +110,10 @@ def _worker_entry(
         init_process_group(store=store, rank=rank, world_size=world_size)
         try:
             result = fn(rank, world_size, *args)
+            # Clean shutdown: this rank's exit is intentional, not a death.
+            from .pg_wrapper import destroy_process_group
+
+            destroy_process_group()
         finally:
             # Exit barrier: the store server lives in rank 0's process, so no
             # rank may exit (killing it) while peers still use the store.
