@@ -160,6 +160,17 @@ class _StoreServer:
                 stop_keys = req.get("stop_keys") or []
                 deadline = time.monotonic() + req["timeout"]
                 while True:
+                    # Data completeness BEFORE stop keys (mirrors
+                    # wait_any's list ordering): a completable collective
+                    # must complete even if a peer's death landed after
+                    # its contribution — e.g. a rank posting its piece for
+                    # the job's final collective and exiting while the
+                    # leader is still collecting.
+                    found = {
+                        k: v for k, v in self._data.items() if k.startswith(prefix)
+                    }
+                    if len(found) >= count:
+                        return {"ok": True, "items": found}
                     for sk in stop_keys:
                         if sk in self._data:
                             return {
@@ -167,11 +178,6 @@ class _StoreServer:
                                 "stopped": sk,
                                 "value": self._data[sk],
                             }
-                    found = {
-                        k: v for k, v in self._data.items() if k.startswith(prefix)
-                    }
-                    if len(found) >= count:
-                        return {"ok": True, "items": found}
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return {"ok": False, "timeout": True}

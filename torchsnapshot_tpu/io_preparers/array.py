@@ -211,7 +211,19 @@ def to_host(arr) -> np.ndarray:
     return np.asarray(arr)
 
 
-def warmup_staging(app_state, pg=None) -> int:
+def needs_consistency_copy(arr) -> bool:
+    """True when staging ``arr`` must copy so the snapshot can't alias
+    caller memory: CPU-backend jax arrays materialize as zero-copy views
+    of the device buffer, and numpy inputs alias caller memory directly;
+    a TPU DtoH transfer already produces host-owned memory. The single
+    source of the pool-draw platform rule — shared by the stager
+    (ArrayBufferStager) and the warmup size planner."""
+    if _is_jax_array(arr):
+        return next(iter(arr.sharding.device_set)).platform == "cpu"
+    return True
+
+
+def warmup_staging(app_state, pg=None, replicated=None) -> int:
     """Pre-fault the staging pool for ``app_state`` so the FIRST
     ``async_take`` blocks like a warm one.
 
@@ -234,17 +246,21 @@ def warmup_staging(app_state, pg=None) -> int:
     Sizes mirror the write partition: for GSPMD-sharded jax arrays the
     exact owned-piece sizes this process stages
     (``ShardedArrayIOPreparer.staged_piece_sizes``); large dense arrays
-    at the chunk-preparer's ranges — under a multi-rank ``pg``,
-    replicated chunked entries are striped across ranks, so only
-    ~1/world of the chunk set is warmed (an approximation of the
-    deterministic striping partition; under-warming just faults the
-    difference on first use). Device arrays whose staging needs no
-    consistency copy (TPU-backed: DtoH already produces host-owned
-    memory) are skipped."""
-    import jax
+    at the chunk-preparer's ranges. Under a multi-rank ``pg``, ONLY
+    replicated paths stripe across ranks — ``replicated`` takes the same
+    globs as ``Snapshot.take`` and process-replicated jax arrays are
+    auto-detected, matching ``_calculate_replicated_paths``; everything
+    else is fully staged per rank and warms fully (striping is an
+    approximation of the deterministic partition; under-warming just
+    faults the difference on first use). Device arrays whose staging
+    needs no consistency copy (TPU-backed: DtoH already produces
+    host-owned memory) are skipped."""
+    import fnmatch
 
     from .._native import native_available
+    from ..flatten import flatten
     from ..integrity import checksums_enabled
+    from ..snapshot import _is_process_replicated_jax_array
     from . import chunked
     from .prepare import is_sharded_jax_array
     from .sharded import ShardedArrayIOPreparer
@@ -259,31 +275,36 @@ def warmup_staging(app_state, pg=None) -> int:
         world, rank = wrapper.get_world_size(), wrapper.get_rank()
     else:
         world, rank = 1, 0
-
-    def needs_copy(leaf) -> bool:
-        if _is_jax_array(leaf):
-            return next(iter(leaf.sharding.device_set)).platform == "cpu"
-        return True
+    globs = list(replicated or [])
 
     sizes: List[int] = []
-    for stateful in app_state.values():
+    for key, stateful in app_state.items():
         state_dict = getattr(stateful, "state_dict", None)
         if state_dict is None:
             continue
-        for leaf in jax.tree_util.tree_leaves(state_dict()):
+        _, flattened = flatten(state_dict(), prefix=key)
+        for logical_path, leaf in flattened.items():
             if is_sharded_jax_array(leaf):
-                if needs_copy(leaf):
+                if needs_consistency_copy(leaf):
                     sizes.extend(ShardedArrayIOPreparer.staged_piece_sizes(leaf))
             elif _is_jax_array(leaf) or isinstance(leaf, np.ndarray):
-                if not needs_copy(leaf):
+                if not needs_consistency_copy(leaf):
                     continue
+                # Only REPLICATED paths stripe across ranks in the write
+                # partition; per-rank arrays are fully staged locally.
+                is_repl = world > 1 and (
+                    any(fnmatch.fnmatch(logical_path, g) for g in globs)
+                    or _is_process_replicated_jax_array(leaf)
+                )
                 nbytes = array_nbytes(leaf)
                 if nbytes > chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES and leaf.shape:
                     row = nbytes // max(leaf.shape[0], 1)
                     ranges = chunked.ChunkedArrayIOPreparer.chunk_ranges(
                         leaf.shape, dtype_to_string(leaf.dtype)
                     )
-                    for lo, hi in ranges[rank::world]:
+                    if is_repl:
+                        ranges = ranges[rank::world]
+                    for lo, hi in ranges:
                         sizes.append((hi - lo) * row)
                 else:
                     sizes.append(nbytes)
@@ -317,17 +338,12 @@ class ArrayBufferStager(BufferStager):
         self.io_skipped = False
 
     def _needs_consistency_copy(self, arr) -> bool:
-        """True when staging must copy ``arr`` so the snapshot can't alias
-        caller memory. CPU-backend jax arrays materialize as zero-copy
-        views of the device buffer (donation/deletion could corrupt the
-        snapshot); on TPU the DtoH transfer already produces host-owned
-        memory. Under zero_copy_staging (sync take) views are safe: the
-        caller is blocked until I/O drains."""
+        """The module-level platform rule (needs_consistency_copy), gated
+        by the zero_copy_staging opt-out: under sync ``Snapshot.take``
+        views are safe because the caller is blocked until I/O drains."""
         if not self.copy_for_consistency:
             return False
-        if _is_jax_array(arr):
-            return next(iter(arr.sharding.device_set)).platform == "cpu"
-        return True
+        return needs_consistency_copy(arr)
 
     def _stage_sync(self, arr) -> np.ndarray:
         host = np.asarray(arr)

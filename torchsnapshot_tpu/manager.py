@@ -173,7 +173,9 @@ class CheckpointManager:
             return 0
         from .io_preparers.array import warmup_staging
 
-        return warmup_staging(app_state, pg=self.pg)
+        return warmup_staging(
+            app_state, pg=self.pg, replicated=self.replicated
+        )
 
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0

@@ -175,8 +175,12 @@ class S3StoragePlugin(StoragePlugin):
             nonlocal sent_once
             if sent_once:
                 try:
-                    self.client.head_object(Bucket=self.bucket, Key=key)
-                    return  # a prior attempt committed server-side
+                    head = self.client.head_object(Bucket=self.bucket, Key=key)
+                    # Size-check before declaring success: a STALE object
+                    # at this key (snapshot re-taken to the same URL) must
+                    # not be mistaken for this upload's commit.
+                    if head.get("ContentLength") == mv.nbytes:
+                        return  # a prior attempt committed server-side
                 except Exception:
                     pass
             sent_once = True
