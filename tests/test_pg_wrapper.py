@@ -217,3 +217,68 @@ def test_peer_death_unblocks_collectives_fast() -> None:
     for rank, (status, elapsed) in results.items():
         assert status == "death-detected", results
         assert elapsed < 30, f"rank {rank} took {elapsed:.1f}s to observe the death"
+
+
+def _take_death_worker(rank, world, store_addr, snap_path, q):
+    import os
+    import time
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.dist_store import create_store
+    from torchsnapshot_tpu.pg_wrapper import init_process_group
+
+    store = create_store(rank=rank, addr=store_addr)
+    init_process_group(store=store, rank=rank, world_size=world)
+
+    class DieOnRank2(StateDict):
+        def state_dict(self):
+            if rank == 2:
+                os._exit(1)  # crash INSIDE take's materialization phase
+            return super().state_dict()
+
+    app = {"m": DieOnRank2(w=np.ones(1024, np.float32), r=rank)}
+    t0 = time.monotonic()
+    try:
+        Snapshot.take(snap_path, app)
+        q.put((rank, "no-error", None))
+    except RuntimeError as e:
+        q.put((rank, "death-detected", time.monotonic() - t0))
+
+
+def test_rank_crash_inside_take_unblocks_peers(tmp_path) -> None:
+    """A rank crashing inside Snapshot.take (mid-materialization) must
+    abort the take on every surviving rank within seconds — and commit
+    nothing."""
+    import multiprocessing as mp
+    import os
+
+    from torchsnapshot_tpu.test_utils import _find_free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    addr = f"127.0.0.1:{_find_free_port()}"
+    snap_path = str(tmp_path / "snap")
+    procs = [
+        ctx.Process(
+            target=_take_death_worker, args=(r, 3, addr, snap_path, q), daemon=True
+        )
+        for r in range(3)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(2):  # rank 2 never reports
+        rank, status, elapsed = q.get(timeout=180)
+        results[rank] = (status, elapsed)
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    assert set(results) == {0, 1}, results
+    for rank, (status, elapsed) in results.items():
+        assert status == "death-detected", results
+        assert elapsed < 60, f"rank {rank} took {elapsed:.1f}s"
+    # No commit anywhere.
+    assert not os.path.exists(os.path.join(snap_path, ".snapshot_metadata"))
