@@ -186,6 +186,12 @@ def _peer_death_worker(rank, world, store_addr, q):
     except RuntimeError as e:
         assert "died" in str(e), e
         q.put((rank, "death-detected", time.monotonic() - t0))
+    # Exit handshake: rank 0 hosts the store server — it must outlive
+    # rank 1's observation of the death key, or rank 1 sees a closed
+    # connection instead of the death error.
+    store.set(f"exit/{rank}", b"1")
+    if rank == 0:
+        store.get("exit/1", timeout=60.0)
 
 
 def test_peer_death_unblocks_collectives_fast() -> None:
@@ -245,6 +251,10 @@ def _take_death_worker(rank, world, store_addr, snap_path, q):
         q.put((rank, "no-error", None))
     except RuntimeError as e:
         q.put((rank, "death-detected", time.monotonic() - t0))
+    # Exit handshake (rank 0 hosts the store; see _peer_death_worker).
+    store.set(f"exit/{rank}", b"1")
+    if rank == 0:
+        store.get("exit/1", timeout=60.0)
 
 
 def test_rank_crash_inside_take_unblocks_peers(tmp_path) -> None:
