@@ -199,6 +199,54 @@ def bench_lockstep(world: int, n_keys: int = 10) -> None:
     )
 
 
+def bench_death_detection(world: int) -> None:
+    """Latency from a rank's connection dropping to every blocked peer
+    raising: world-1 waiters block in a collective-style wait on a key
+    that will never arrive (racing the death channel); one liveness-
+    registered connection closes abruptly."""
+    from torchsnapshot_tpu.dist_store import DEATH_KEY
+
+    server = TCPStore("127.0.0.1", None, is_server=True)
+    dier = server.clone()
+    dier.register_liveness(DEATH_KEY, b"rank-d-died")
+    latencies = [None] * (world - 1)
+    ready = threading.Barrier(world)
+
+    def waiter(i: int) -> None:
+        store = server.clone()
+        ready.wait()
+        key, _ = store.wait_any(["never/arrives", DEATH_KEY], timeout=60.0)
+        assert key == DEATH_KEY
+        latencies[i] = time.perf_counter()  # wake timestamp
+        store.close()
+
+    threads = [
+        threading.Thread(target=waiter, args=(i,), daemon=True)
+        for i in range(world - 1)
+    ]
+    for t in threads:
+        t.start()
+    ready.wait()  # all waiters blocked (modulo the final recv window)
+    time.sleep(0.2)
+    t_drop = time.perf_counter()
+    dier.close()  # the "crash"
+    for t in threads:
+        t.join(timeout=60)
+    server.close()
+    # Latency from the DROP to each waiter's wake.
+    walls = [v - t_drop for v in latencies if v is not None]
+    report(
+        "store_scale/death_detection",
+        {
+            "world": world,
+            "p50_ms": round(statistics.median(walls) * 1e3, 2),
+            "p99_ms": round(
+                sorted(walls)[max(0, int(len(walls) * 0.99) - 1)] * 1e3, 2
+            ),
+        },
+    )
+
+
 def main() -> int:
     worlds = [32, 64, 128]
     entries = 400
@@ -211,6 +259,7 @@ def main() -> int:
         bench_barrier(world)
         bench_gather(world, entries)
         bench_lockstep(world)
+        bench_death_detection(world)
     return 0
 
 
