@@ -365,3 +365,74 @@ def test_manifest_fast_paths_match_dataclass_truth(entries, mirror) -> None:
     assert asdict(back) == asdict(md)
     # Emission is deterministic and round-trip stable.
     assert back.to_yaml() == text
+
+
+@given(
+    world=st.integers(2, 8),
+    ops=st.lists(
+        st.tuples(
+            st.sampled_from(["bcast", "gather", "scatter", "barrier"]),
+            st.integers(0, 40_000),  # payload size: straddles the 16 KB
+            st.integers(0, 2**16),   # compression threshold
+        ),
+        min_size=1,
+        max_size=6,
+    ),
+)
+@settings(max_examples=25, deadline=None)
+def test_collective_sequences_fuzz(world, ops) -> None:
+    """Arbitrary op sequences over thread-ranks against a real store
+    server: every rank sees identical, correct results regardless of
+    payload size (raw vs compressed wire format) and op interleaving."""
+    import threading
+
+    from torchsnapshot_tpu.dist_store import TCPStore
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper, ProcessGroup
+
+    server = TCPStore("127.0.0.1", None, is_server=True)
+    errors = []
+
+    def payload(size, seed, rank):
+        # Deterministic, rank-tagged, compressible-ish payload.
+        return {"rank": rank, "blob": (str(seed) * 50)[: size // 8], "n": size}
+
+    def runner(rank):
+        store = server.clone() if rank else server
+        pg = ProcessGroup(store, rank, world)
+        w = PGWrapper(pg, namespace="fuzz/collectives")
+        try:
+            for i, (op, size, seed) in enumerate(ops):
+                if op == "bcast":
+                    got = w.broadcast_object(
+                        payload(size, seed, 0) if rank == 0 else None
+                    )
+                    assert got == payload(size, seed, 0), (i, op)
+                elif op == "gather":
+                    got = w.all_gather_object(payload(size, seed, rank))
+                    assert got == [payload(size, seed, r) for r in range(world)]
+                elif op == "scatter":
+                    objs = (
+                        [payload(size, seed, r) for r in range(world)]
+                        if rank == 0
+                        else None
+                    )
+                    got = w.scatter_object(objs)
+                    assert got == payload(size, seed, rank), (i, op)
+                else:
+                    w.barrier()
+        except BaseException as e:  # noqa: B036
+            errors.append((rank, e))
+        finally:
+            if rank:
+                store.close()
+
+    threads = [
+        threading.Thread(target=runner, args=(r,), daemon=True)
+        for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    server.close()
+    assert not errors, errors[0]
