@@ -80,6 +80,13 @@ def main() -> int:
     t_parse = time.perf_counter() - t0
     assert len(md2.manifest) == len(manifest)
 
+    from torchsnapshot_tpu.manifest import get_available_entries
+
+    t0 = time.perf_counter()
+    avail = get_available_entries(manifest, rank=3)
+    t_avail = time.perf_counter() - t0
+    assert len(avail) == len(manifest)
+
     # Commit-shaped write+read through a real temp file (page-cache I/O).
     import tempfile
 
@@ -105,6 +112,7 @@ def main() -> int:
             "parse_s": round(t_parse, 3),
             "commit_write_s": round(t_write, 3),
             "restore_read_s": round(t_read, 3),
+            "available_entries_s": round(t_avail, 3),
         },
     )
     return 0
