@@ -1,0 +1,110 @@
+"""bench.py backend-probe retry logic (driver contract robustness).
+
+The probe must retry clean failures within its time budget, respect
+cool-downs after killed (timed-out) probes, honor the DtoH floor, and
+always fall back to cpu so the driver records a number.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+class FakeResult:
+    def __init__(self, returncode=0, stdout="", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+@pytest.fixture(autouse=True)
+def _fast(monkeypatch):
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "60")
+    monkeypatch.setenv("BENCH_PROBE_TOTAL_S", "300")
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    yield sleeps
+
+
+def test_probe_success_first_try(monkeypatch):
+    monkeypatch.setattr(
+        bench.subprocess,
+        "run",
+        lambda *a, **k: FakeResult(0, "banner\ntpu 1 2.5000\n"),
+    )
+    assert bench._probe_backend() == "tpu"
+
+
+def test_probe_retries_clean_failure_then_succeeds(monkeypatch, _fast):
+    calls = []
+
+    def run(*a, **k):
+        calls.append(1)
+        if len(calls) < 3:
+            return FakeResult(1, "", "UNAVAILABLE")
+        return FakeResult(0, "tpu 1 1.0000\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    assert bench._probe_backend() == "tpu"
+    assert len(calls) == 3
+    assert all(s == 30 for s in _fast)  # clean-failure pause
+
+
+def test_probe_killed_gets_longer_cooldown(monkeypatch, _fast):
+    calls = []
+
+    def run(*a, **k):
+        calls.append(1)
+        if len(calls) == 1:
+            raise subprocess.TimeoutExpired(cmd="x", timeout=60)
+        return FakeResult(0, "tpu 1 1.0000\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    assert bench._probe_backend() == "tpu"
+    assert _fast == [120]  # killed probes cool down longer
+
+
+def test_probe_slow_dtoh_falls_back_to_cpu(monkeypatch):
+    monkeypatch.setattr(
+        bench.subprocess,
+        "run",
+        lambda *a, **k: FakeResult(0, "tpu 1 0.0100\n"),  # tunnel-grade DtoH
+    )
+    assert bench._probe_backend() == "cpu"
+
+
+def test_probe_exhausts_budget_and_falls_back(monkeypatch, _fast):
+    # Fake clock: each sleep advances it, so the budget drains without
+    # real waiting.
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+
+    def sleep(s):
+        clock[0] += s
+
+    monkeypatch.setattr(bench.time, "sleep", sleep)
+
+    calls = []
+
+    def run(*a, **k):
+        calls.append(1)
+        clock[0] += 50  # each probe consumes wall time
+        return FakeResult(1, "", "UNAVAILABLE")
+
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    assert bench._probe_backend() == "cpu"
+    assert 2 <= len(calls) <= 6  # bounded by the 300 s budget
+
+
+def test_force_cpu_env(monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    assert bench._probe_backend() == "cpu"
