@@ -113,19 +113,20 @@ def main() -> int:
     w = jax.random.normal(jax.random.PRNGKey(1), (d, d), jnp.bfloat16)
     x = jax.random.normal(jax.random.PRNGKey(2), (64, d), jnp.bfloat16)
 
+    n_inner = 512
+
     @jax.jit
-    def step(w, x, it):
+    def step(w, x):
         def body(carry, _):
             h = jnp.tanh(carry @ w)
             return h, None
 
-        out, _ = jax.lax.scan(body, x, None, length=it)
+        out, _ = jax.lax.scan(body, x, None, length=n_inner)
         return jnp.float32(out.sum())
 
-    n_inner = 512
-    float(step(w, x, n_inner))  # compile
+    float(step(w, x))  # compile
     t0 = time.perf_counter()
-    float(step(w, x, n_inner))
+    float(step(w, x))
     t_step = time.perf_counter() - t0
 
     state = {"m": StateDict(w=w)}
@@ -135,7 +136,7 @@ def main() -> int:
         pending = Snapshot.async_take(os.path.join(tmp, "snap"), state)
         blocked = time.perf_counter() - t0
         t0 = time.perf_counter()
-        float(step(w, x, n_inner))  # compute while staging I/O drains
+        float(step(w, x))  # compute while staging I/O drains
         t_overlap = time.perf_counter() - t0
         pending.wait()
         total = time.perf_counter() - t0 + blocked

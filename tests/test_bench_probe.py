@@ -56,7 +56,7 @@ def test_probe_retries_clean_failure_then_succeeds(monkeypatch, _fast):
     monkeypatch.setattr(bench.subprocess, "run", run)
     assert bench._probe_backend() == "tpu"
     assert len(calls) == 3
-    assert all(s == 30 for s in _fast)  # clean-failure pause
+    assert _fast == [30, 30]  # one clean-failure pause per failed attempt
 
 
 def test_probe_killed_gets_longer_cooldown(monkeypatch, _fast):

@@ -1,0 +1,195 @@
+"""SIGKILL crash-consistency: a writer killed mid-save commits nothing.
+
+The commit protocol's crash-safety claim (snapshot.py: ``.snapshot_metadata``
+is written only after every payload write completes; fs.py: every file lands
+via temp+rename, so no path ever holds a partial write) has real fault tests
+for *process-visible* failures (exceptions, peer aborts) but none for the
+failure those mechanisms exist for: the process dying with no chance to run
+``finally`` blocks. These tests SIGKILL a real writer subprocess at two
+surgically-chosen points and verify every recovery surface:
+
+- the partial directory has payloads but no ``.snapshot_metadata``;
+- ``Snapshot(path).restore`` refuses it with a clean error;
+- ``CheckpointManager`` resume discovery skips it and the previous committed
+  step restores bit-exact;
+- the ``verify`` CLI reports it as an error (exit 2) instead of crashing;
+- a kill *during the metadata write itself* (after the temp file is fully
+  written, before the rename) still leaves the snapshot uncommitted — the
+  atomic-rename commit point.
+
+The reference relies on the same metadata-last design
+(/root/reference/torchsnapshot/snapshot.py:234-252 writes metadata after the
+pending I/O work completes) but ships no kill test; this is the crash drill
+for it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import CheckpointManager, Snapshot, StateDict
+from torchsnapshot_tpu.cli import main as cli_main
+
+# The child stalls inside the fs plugin at a chosen point, touches a gate
+# file so the parent knows the stall point was reached, then sleeps until
+# SIGKILLed. Payload values are deterministic (arange) so the parent can
+# verify the surviving step without shipping arrays across processes.
+_CHILD = r"""
+import asyncio, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+root, gate, stall_at = sys.argv[1], sys.argv[2], sys.argv[3]
+
+orig_write = fs_mod.FSStoragePlugin.write
+n_payload_writes = 0
+first_payload_durable = asyncio.Event()
+
+async def gated_write(self, write_io):
+    global n_payload_writes
+    is_meta = write_io.path.endswith(".snapshot_metadata")
+    if stall_at == "payload" and not is_meta:
+        # Let the first payload land fully, then stall the second forever:
+        # the take is killed with SOME payloads durable and no metadata.
+        # The writes run concurrently, so the stalling task must WAIT for
+        # the first write's temp+rename to complete before signalling the
+        # parent — otherwise the kill can land before anything is durable.
+        n_payload_writes += 1
+        if n_payload_writes == 1:
+            await orig_write(self, write_io)
+            first_payload_durable.set()
+            return
+        await first_payload_durable.wait()
+        with open(gate, "w") as f:
+            f.write("stalled")
+        await asyncio.sleep(600)
+    if stall_at == "metadata" and is_meta:
+        # Write the metadata TEMP file completely, then stall before the
+        # rename: a kill here is a crash at the exact commit point.
+        path = os.path.join(self.root, write_io.path)
+        await self._ensure_parent(path)
+        with open(path + ".tmp.crashtest", "wb") as f:
+            f.write(bytes(write_io.buf))
+        with open(gate, "w") as f:
+            f.write("stalled")
+        await asyncio.sleep(600)
+    await orig_write(self, write_io)
+
+fs_mod.FSStoragePlugin.write = gated_write
+
+state = {
+    "model": StateDict(
+        w=np.arange(64_000, dtype=np.float32),
+        b=np.arange(8_000, dtype=np.float64),
+    )
+}
+Snapshot.take(os.path.join(root, f"step_{1:010d}"), state)
+"""
+
+
+def _take_step0(root: str) -> dict:
+    state = {
+        "model": StateDict(
+            w=np.arange(64_000, dtype=np.float32) * 2.0,
+            b=np.arange(8_000, dtype=np.float64) * 3.0,
+        )
+    }
+    Snapshot.take(os.path.join(root, f"step_{0:010d}"), state)
+    return state
+
+
+def _kill_mid_save(root: str, gate: str, stall_at: str) -> None:
+    # stderr goes to a file, not a PIPE: nobody drains a pipe while the
+    # parent polls for the gate, and a chatty child (XLA init warnings)
+    # would block on a full pipe before ever reaching the stall point.
+    err_path = gate + ".stderr"
+    with open(err_path, "wb") as err:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD, root, gate, stall_at],
+            stdout=subprocess.DEVNULL,
+            stderr=err,
+        )
+        deadline = time.monotonic() + 120
+        while not os.path.exists(gate):
+            if proc.poll() is not None:
+                with open(err_path) as f:
+                    raise AssertionError(
+                        "writer exited before reaching the stall point:\n"
+                        + f.read()
+                    )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise AssertionError("writer never reached the stall point")
+            time.sleep(0.05)
+    os.kill(proc.pid, signal.SIGKILL)  # no atexit, no finally, no cleanup
+    proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGKILL
+
+
+def _assert_uncommitted_and_recoverable(root: str, step0_state: dict) -> None:
+    partial = os.path.join(root, f"step_{1:010d}")
+    assert os.path.isdir(partial), "the kill should leave the partial dir"
+    assert not os.path.exists(
+        os.path.join(partial, ".snapshot_metadata")
+    ), "a killed writer must never leave a committed metadata file"
+
+    # Restore refuses the partial snapshot with a clean error, not garbage.
+    dst = {"model": StateDict(w=np.zeros(1, np.float32))}
+    with pytest.raises((FileNotFoundError, RuntimeError, ValueError)):
+        Snapshot(path=partial).restore(dst)
+
+    # verify CLI: clean error exit, no traceback.
+    assert cli_main(["verify", partial]) == 2
+
+    # Resume discovery skips the partial step and the prior step is intact.
+    mgr = CheckpointManager(root)
+    assert mgr.all_steps() == [0]
+    assert mgr.latest_step() == 0
+    dst = {
+        "model": StateDict(
+            w=np.zeros(64_000, np.float32), b=np.zeros(8_000, np.float64)
+        )
+    }
+    Snapshot(path=mgr.path_for(0)).restore(dst)
+    np.testing.assert_array_equal(dst["model"]["w"], step0_state["model"]["w"])
+    np.testing.assert_array_equal(dst["model"]["b"], step0_state["model"]["b"])
+
+
+def test_sigkill_mid_payload_write_commits_nothing(tmp_path) -> None:
+    root = str(tmp_path)
+    step0 = _take_step0(root)
+    _kill_mid_save(root, str(tmp_path / "gate"), "payload")
+
+    partial = os.path.join(root, f"step_{1:010d}")
+    payloads = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(partial)
+        for f in fs
+        if not f.startswith(".") and ".tmp." not in f
+    ]
+    assert payloads, "the first payload should have landed before the kill"
+    _assert_uncommitted_and_recoverable(root, step0)
+
+
+def test_sigkill_during_metadata_write_commits_nothing(tmp_path) -> None:
+    """Crash at the exact commit point: the metadata temp file is fully
+    written but never renamed — the snapshot must still read as
+    uncommitted (this is what temp+rename atomicity buys)."""
+    root = str(tmp_path)
+    step0 = _take_step0(root)
+    _kill_mid_save(root, str(tmp_path / "gate"), "metadata")
+
+    partial = os.path.join(root, f"step_{1:010d}")
+    tmp_files = [f for f in os.listdir(partial) if ".tmp." in f]
+    assert tmp_files, "the metadata temp file should exist (crash pre-rename)"
+    _assert_uncommitted_and_recoverable(root, step0)
