@@ -108,14 +108,18 @@ def _take_step0(root: str) -> dict:
     return state
 
 
-def _kill_mid_save(root: str, gate: str, stall_at: str) -> None:
-    # stderr goes to a file, not a PIPE: nobody drains a pipe while the
-    # parent polls for the gate, and a chatty child (XLA init warnings)
-    # would block on a full pipe before ever reaching the stall point.
+def _spawn_writer_until_gate(child_src: str, argv: list, gate: str):
+    """Spawn a writer child and block until it touches ``gate``.
+
+    stderr goes to a file, not a PIPE: nobody drains a pipe while the
+    parent polls for the gate, and a chatty child (XLA init warnings)
+    would block on a full pipe before ever reaching the stall point.
+    Returns (proc, err_path); the caller decides when to SIGKILL.
+    """
     err_path = gate + ".stderr"
     with open(err_path, "wb") as err:
         proc = subprocess.Popen(
-            [sys.executable, "-c", _CHILD, root, gate, stall_at],
+            [sys.executable, "-c", child_src, *argv],
             stdout=subprocess.DEVNULL,
             stderr=err,
         )
@@ -124,16 +128,37 @@ def _kill_mid_save(root: str, gate: str, stall_at: str) -> None:
             if proc.poll() is not None:
                 with open(err_path) as f:
                     raise AssertionError(
-                        "writer exited before reaching the stall point:\n"
-                        + f.read()
+                        "writer exited before reaching the gate:\n" + f.read()
                     )
             if time.monotonic() > deadline:
                 proc.kill()
-                raise AssertionError("writer never reached the stall point")
-            time.sleep(0.05)
-    os.kill(proc.pid, signal.SIGKILL)  # no atexit, no finally, no cleanup
+                raise AssertionError("writer never reached the gate")
+            time.sleep(0.01)
+    return proc, err_path
+
+
+def _sigkill(proc, err_path: str, allow_clean_exit: bool = False) -> None:
+    """SIGKILL the writer: no atexit, no finally, no cleanup. A child that
+    DIED ON ITS OWN before the kill is a real writer failure, not a crash
+    simulation — surface its stderr instead of letting it masquerade as
+    the uncommitted outcome (unless the caller expects completion)."""
+    os.kill(proc.pid, signal.SIGKILL)
     proc.wait(timeout=30)
-    assert proc.returncode == -signal.SIGKILL
+    ok = (0,) if allow_clean_exit else ()
+    if proc.returncode != -signal.SIGKILL and proc.returncode not in ok:
+        with open(err_path) as f:
+            raise AssertionError(
+                f"writer exited on its own (rc={proc.returncode}) before "
+                "the kill — a genuine failure, not a simulated crash:\n"
+                + f.read()
+            )
+
+
+def _kill_mid_save(root: str, gate: str, stall_at: str) -> None:
+    proc, err_path = _spawn_writer_until_gate(
+        _CHILD, [root, gate, stall_at], gate
+    )
+    _sigkill(proc, err_path)
 
 
 def _assert_uncommitted_and_recoverable(root: str, step0_state: dict) -> None:
@@ -193,3 +218,132 @@ def test_sigkill_during_metadata_write_commits_nothing(tmp_path) -> None:
     tmp_files = [f for f in os.listdir(partial) if ".tmp." in f]
     assert tmp_files, "the metadata temp file should exist (crash pre-rename)"
     _assert_uncommitted_and_recoverable(root, step0)
+
+
+# ----------------------------------------------------------- randomized
+
+# Unlike _CHILD, no stall point: the child takes a real ~96 MB snapshot at
+# full speed and touches the gate right before Snapshot.take so the parent
+# can sample a kill time anywhere in (or past) the take window.
+_CHILD_FREE = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict
+
+root, gate = sys.argv[1], sys.argv[2]
+state = {
+    "model": StateDict(
+        **{f"p{i}": np.full(3_000_000, i, dtype=np.float32) for i in range(8)}
+    )
+}
+with open(gate, "w") as f:
+    f.write("taking")
+Snapshot.take(os.path.join(root, f"step_{1:010d}"), state)
+"""
+
+
+@pytest.mark.slow
+def test_random_kill_points_commit_or_recover(tmp_path) -> None:
+    """Kill the writer at RANDOM points instead of surgical ones: whatever
+    the timing, the outcome must be binary — either the snapshot committed
+    (verify passes, every value restores exactly) or it did not (recovery
+    surfaces all refuse it and step_0 is intact). Any third outcome —
+    committed-but-corrupt, partially-restorable — is the bug class this
+    drill exists to catch. Seeded RNG, printed per-iteration, for replay.
+
+    Two iterations are deterministic so BOTH outcomes always occur: an
+    immediate kill (uncommitted) and a kill only after the metadata file
+    appears (committed — a crash just after the commit point must leave a
+    fully valid snapshot). The random delays between them are calibrated
+    against one unkilled take timed on this host under current load."""
+    import random
+    import shutil
+
+    rng = random.Random(0xC0FFEE)
+    root = str(tmp_path)
+    step0 = _take_step0(root)
+    partial = os.path.join(root, f"step_{1:010d}")
+    outcomes = {"committed": 0, "uncommitted": 0}
+
+    # Calibrate: one unkilled take, timed from the gate to the metadata
+    # file appearing, so random kill points span THIS host's take window.
+    gate = str(tmp_path / "gate_cal")
+    proc, err_path = _spawn_writer_until_gate(_CHILD_FREE, [root, gate], gate)
+    t0 = time.monotonic()
+    meta = os.path.join(partial, ".snapshot_metadata")
+    while not os.path.exists(meta):
+        assert time.monotonic() - t0 < 120, "calibration take never finished"
+        assert proc.poll() is None or proc.returncode == 0
+        time.sleep(0.01)
+    t_take = time.monotonic() - t0
+    proc.wait(timeout=30)
+    assert proc.returncode == 0
+    print(f"calibration: take window {t_take:.3f}s")
+
+    for it in range(6):
+        shutil.rmtree(partial, ignore_errors=True)
+        gate = str(tmp_path / f"gate_{it}")
+        if it == 0:
+            delay = 0.0  # guaranteed early kill -> uncommitted
+        elif it == 1:
+            delay = None  # kill right AFTER the commit point -> committed
+        else:
+            delay = rng.uniform(0.0, 1.2) * t_take
+        proc, err_path = _spawn_writer_until_gate(
+            _CHILD_FREE, [root, gate], gate
+        )
+        if delay is None:
+            t0 = time.monotonic()
+            while not os.path.exists(os.path.join(partial, ".snapshot_metadata")):
+                assert time.monotonic() - t0 < 120
+                time.sleep(0.005)
+        else:
+            time.sleep(delay)
+        # A take that outran a long delay exits cleanly first — that is the
+        # committed outcome, not a writer failure.
+        _sigkill(proc, err_path, allow_clean_exit=True)
+
+        committed = os.path.exists(os.path.join(partial, ".snapshot_metadata"))
+        label = "post-commit" if delay is None else f"{delay:.3f}s"
+        print(f"iter {it}: delay={label} -> "
+              f"{'committed' if committed else 'uncommitted'}")
+        if committed:
+            outcomes["committed"] += 1
+            # Fully valid: checksums verify and every leaf restores exactly.
+            assert cli_main(["verify", partial]) == 0
+            dst = {
+                "model": StateDict(
+                    **{
+                        f"p{i}": np.zeros(3_000_000, np.float32)
+                        for i in range(8)
+                    }
+                )
+            }
+            Snapshot(path=partial).restore(dst)
+            for i in range(8):
+                np.testing.assert_array_equal(
+                    dst["model"][f"p{i}"],
+                    np.full(3_000_000, i, dtype=np.float32),
+                )
+        else:
+            outcomes["uncommitted"] += 1
+            dst = {"model": StateDict(w=np.zeros(1, np.float32))}
+            with pytest.raises((FileNotFoundError, RuntimeError, ValueError)):
+                Snapshot(path=partial).restore(dst)
+            mgr = CheckpointManager(root)
+            assert mgr.all_steps() == [0]
+
+    # step_0 survived every kill, bit-exact.
+    dst = {
+        "model": StateDict(
+            w=np.zeros(64_000, np.float32), b=np.zeros(8_000, np.float64)
+        )
+    }
+    Snapshot(path=os.path.join(root, f"step_{0:010d}")).restore(dst)
+    np.testing.assert_array_equal(dst["model"]["w"], step0["model"]["w"])
+    np.testing.assert_array_equal(dst["model"]["b"], step0["model"]["b"])
+    print(f"outcomes: {outcomes}")
+    # The deterministic iterations guarantee both branches really ran.
+    assert outcomes["committed"] >= 1 and outcomes["uncommitted"] >= 1
