@@ -1,0 +1,184 @@
+"""Restore into a destination of a DIFFERENT dtype casts to the destination.
+
+The destination app state is the spec — shape, sharding, and dtype. Restoring
+a bf16 checkpoint into fp32 params (or vice versa: a precision-recipe change
+mid-training-run) must produce arrays with the DESTINATION's dtype, mirroring
+the reference's ``dst.copy_(src)`` semantics (reference io_preparer.py:426-427
+— torch's copy_ casts into the pre-built tensor), so a jitted train step keeps
+its compiled dtype. Divergence: only ``same_kind`` casts are allowed — a
+float->int restore raises instead of silently truncating.
+
+Covers every destination shape the preparers dispatch on: plain jax, numpy
+in-place, chunked entries, sharded entries into jax (same mesh, resharded,
+and dense) and into numpy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+def _take(tmp_path, **leaves) -> str:
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"m": StateDict(**leaves)})
+    return path
+
+
+def _restore(path, **leaves):
+    dst = {"m": StateDict(**leaves)}
+    Snapshot(path=path).restore(dst)
+    return dst["m"]
+
+
+def test_plain_jax_bf16_checkpoint_into_fp32_params(tmp_path):
+    src = jnp.arange(256, dtype=jnp.bfloat16)
+    path = _take(tmp_path, w=src)
+    out = _restore(path, w=jnp.zeros(256, jnp.float32))["w"]
+    assert out.dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(256, dtype=np.float32)
+    )
+
+
+def test_plain_jax_fp32_checkpoint_into_bf16_params(tmp_path):
+    # Small integers are exact in bf16, so equality is well-defined.
+    src = jnp.arange(256, dtype=jnp.float32)
+    path = _take(tmp_path, w=src)
+    out = _restore(path, w=jnp.zeros(256, jnp.bfloat16))["w"]
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(256, dtype="float32").astype("bfloat16")
+    )
+
+
+def test_numpy_inplace_cast(tmp_path):
+    src = np.arange(128, dtype="bfloat16")
+    path = _take(tmp_path, w=src)
+    dst = np.zeros(128, np.float32)
+    Snapshot(path=path).restore({"m": StateDict(w=dst)})
+    np.testing.assert_array_equal(dst, np.arange(128, dtype=np.float32))
+
+
+def test_float_to_int_restore_refused(tmp_path):
+    path = _take(tmp_path, w=jnp.arange(16, dtype=jnp.float32))
+    with pytest.raises(RuntimeError, match="cannot be cast"):
+        _restore(path, w=jnp.zeros(16, jnp.int32))
+
+
+def test_chunked_entry_cast(tmp_path):
+    from torchsnapshot_tpu.io_preparers import chunked
+
+    old = chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES
+    chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES = 1024
+    try:
+        src = jnp.arange(4 * 256, dtype=jnp.float32).reshape(4, 256)
+        path = _take(tmp_path, w=src)
+        out = _restore(path, w=jnp.zeros((4, 256), jnp.bfloat16))["w"]
+    finally:
+        chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES = old
+    assert out.dtype == jnp.bfloat16
+    # bf16 rounds large arange values; compare against the exact cast.
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.arange(4 * 256, dtype="float32").reshape(4, 256).astype("bfloat16"),
+    )
+
+
+def test_chunked_into_numpy_cast(tmp_path):
+    """Multi-chunk entry into a mismatched-dtype numpy destination (the
+    chunked assembler's fill-region cast path)."""
+    from torchsnapshot_tpu.io_preparers import chunked
+
+    old = chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES
+    chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES = 1024
+    try:
+        src = np.arange(4 * 256, dtype=np.float32).reshape(4, 256)
+        path = _take(tmp_path, w=src)
+        dst = np.zeros((4, 256), dtype="bfloat16")
+        Snapshot(path=path).restore({"m": StateDict(w=dst)})
+    finally:
+        chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES = old
+    np.testing.assert_array_equal(dst, src.astype("bfloat16"))
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("x", "y"))
+
+
+def test_sharded_cast_same_mesh(tmp_path):
+    mesh = _mesh()
+    data = np.arange(32 * 16, dtype="bfloat16").reshape(32, 16)
+    src = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("x", "y")))
+    path = _take(tmp_path, w=src)
+    dst = jax.device_put(
+        jnp.zeros((32, 16), jnp.float32), NamedSharding(mesh, P("x", "y"))
+    )
+    out = _restore(path, w=dst)["w"]
+    assert out.dtype == jnp.float32
+    assert out.sharding == dst.sharding
+    np.testing.assert_array_equal(
+        np.asarray(out), data.astype(np.float32)
+    )
+
+
+def test_sharded_cast_with_reshard(tmp_path):
+    """Dtype cast composes with a sharding-layout change on restore."""
+    mesh = _mesh()
+    data = np.arange(32 * 16, dtype="float32").reshape(32, 16)
+    src = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("x", None)))
+    path = _take(tmp_path, w=src)
+    dst = jax.device_put(
+        jnp.zeros((32, 16), jnp.bfloat16), NamedSharding(mesh, P(None, "y"))
+    )
+    out = _restore(path, w=dst)["w"]
+    assert out.dtype == jnp.bfloat16
+    assert out.sharding == dst.sharding
+    np.testing.assert_array_equal(np.asarray(out), data.astype("bfloat16"))
+
+
+def test_sharded_to_dense_cast(tmp_path):
+    mesh = _mesh()
+    data = np.arange(32 * 16, dtype="bfloat16").reshape(32, 16)
+    src = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("x", "y")))
+    path = _take(tmp_path, w=src)
+    out = _restore(path, w=jnp.zeros((32, 16), jnp.float32))["w"]
+    assert out.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(out), data.astype(np.float32))
+
+
+def test_sharded_to_numpy_cast(tmp_path):
+    mesh = _mesh()
+    data = np.arange(32 * 16, dtype="bfloat16").reshape(32, 16)
+    src = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("x", "y")))
+    path = _take(tmp_path, w=src)
+    dst = np.zeros((32, 16), np.float32)
+    Snapshot(path=path).restore({"m": StateDict(w=dst)})
+    np.testing.assert_array_equal(dst, data.astype(np.float32))
+
+
+def test_sharded_float_to_int_refused(tmp_path):
+    mesh = _mesh()
+    src = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    path = _take(tmp_path, w=src)
+    dst = jax.device_put(
+        jnp.zeros(32, jnp.int32), NamedSharding(mesh, P("x"))
+    )
+    with pytest.raises(RuntimeError, match="cannot be cast"):
+        _restore(path, w=dst)
+
+
+def test_matching_dtype_unaffected(tmp_path):
+    """The no-cast fast path stays byte-exact (no same_kind detour)."""
+    src = jnp.arange(256, dtype=jnp.bfloat16)
+    path = _take(tmp_path, w=src)
+    out = _restore(path, w=jnp.zeros(256, jnp.bfloat16))["w"]
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(src))

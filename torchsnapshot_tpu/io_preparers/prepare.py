@@ -73,6 +73,35 @@ def is_partitionable_array(obj: Any) -> bool:
     return is_jax_array(obj) and not is_sharded_jax_array(obj)
 
 
+def check_restore_cast(entry_dtype: str, dst_dtype: Any, what: str) -> bool:
+    """Restore semantics: the DESTINATION is the spec — shape, sharding, and
+    dtype. A snapshot saved in a different dtype is cast to the destination's
+    on restore, mirroring the reference's ``dst.copy_(src)`` into pre-built
+    state (reference: io_preparer.py:426-427) so a jitted train step keeps
+    its compiled dtype across a precision-recipe change. Divergence from
+    torch: ``copy_`` casts unsafely; here only ``same_kind`` casts (float<->
+    float incl. bf16/fp8, int<->int) are allowed — a float checkpoint
+    restoring into int params is almost certainly a state-mapping bug, not
+    an intended quantization (quantized flows store scales separately).
+
+    Returns True when a cast is needed; raises for forbidden casts.
+    """
+    from ..serialization import string_to_dtype
+
+    src = string_to_dtype(entry_dtype)
+    dst = np.dtype(dst_dtype)
+    if src == dst:
+        return False
+    if not np.can_cast(src, dst, casting="same_kind"):
+        raise RuntimeError(
+            f"Restoring {what}: snapshot dtype {entry_dtype} cannot be cast "
+            f"to destination dtype {dst} (only same-kind casts are "
+            "supported; restore into a matching-kind destination or convert "
+            "the checkpoint explicitly)."
+        )
+    return True
+
+
 def prepare_read(
     entry: Entry,
     obj_out: Any = None,
@@ -86,6 +115,9 @@ def prepare_read(
       device with the destination's sharding and reported via ``callback``;
     - no destination: a host value is materialized and reported via
       ``callback``.
+
+    A destination whose dtype differs from the snapshot's is cast to the
+    destination's dtype (``same_kind`` only — see ``check_restore_cast``).
 
     PrimitiveEntry requires no I/O and must be handled by the caller
     (reference: io_preparer.py:888-890).
@@ -122,6 +154,9 @@ def prepare_read(
                 f"Shape mismatch restoring {entry.location if hasattr(entry, 'location') else '<chunked>'}: "
                 f"snapshot has {list(entry.shape)}, destination has {list(obj_out.shape)}."
             )
+        # fast_copyto applies the same_kind cast element-wise during the
+        # copy into the destination; fail before any I/O if it can't.
+        check_restore_cast(entry.dtype, obj_out.dtype, "into numpy array")
         dst_view = obj_out
     elif is_jax_array(obj_out):
         jax = _jax()
@@ -131,14 +166,22 @@ def prepare_read(
                 f"{list(entry.shape)}, destination has {list(obj_out.shape)}."
             )
         sharding = obj_out.sharding
+        needs_cast = check_restore_cast(
+            entry.dtype, obj_out.dtype, "into jax.Array"
+        )
+        dst_dtype = obj_out.dtype
         # No host scratch here: with dst_view=None the preparers hand the
         # callback either a zero-copy view over the read buffer (whole-file
         # reads — saves a full memcpy pass per array) or their own assembly
         # scratch (budget-split / chunked reads, which genuinely need one).
-        # device_put copies host->device either way.
+        # device_put copies host->device either way. Dtype casts run ON
+        # DEVICE after the transfer: the wire moves the snapshot's (often
+        # narrower) bytes and the VPU does the widening, not the host.
 
         def _materialize(host: np.ndarray, _cb=callback, _sharding=sharding) -> None:
             restored = jax.device_put(host, _sharding)
+            if needs_cast:
+                restored = restored.astype(dst_dtype)
             if _cb is not None:
                 _cb(restored)
 

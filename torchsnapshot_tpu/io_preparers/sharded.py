@@ -293,7 +293,7 @@ class ShardedArrayIOPreparer:
         shape = tuple(entry.shape)
         np_dtype = string_to_dtype(entry.dtype)
 
-        from .prepare import is_jax_array
+        from .prepare import check_restore_cast, is_jax_array
 
         if is_jax_array(obj_out):
             import jax
@@ -304,6 +304,10 @@ class ShardedArrayIOPreparer:
                     f"{list(shape)}, destination has {list(obj_out.shape)}."
                 )
             sharding = obj_out.sharding
+            needs_cast = check_restore_cast(
+                entry.dtype, obj_out.dtype, "sharded array into jax.Array"
+            )
+            dst_dtype = obj_out.dtype
             # one host buffer per unique addressable destination box
             boxes: Dict[Box, np.ndarray] = {}
             for device, index in sharding.addressable_devices_indices_map(
@@ -320,6 +324,10 @@ class ShardedArrayIOPreparer:
                     return boxes[_normalize_index(index, shape)]
 
                 restored = jax.make_array_from_callback(shape, sharding, cb)
+                if needs_cast:
+                    # Cast on device after the (narrower-dtype) transfer;
+                    # astype preserves the destination sharding.
+                    restored = restored.astype(dst_dtype)
                 if callback is not None:
                     callback(restored)
 
@@ -332,6 +340,11 @@ class ShardedArrayIOPreparer:
                     f"Shape mismatch restoring sharded array into numpy "
                     f"destination: {list(shape)} vs {list(obj_out.shape)}."
                 )
+            # The scatter copies cast element-wise into the destination's
+            # dtype (fast_copyto, same_kind); fail before I/O if forbidden.
+            check_restore_cast(
+                entry.dtype, obj_out.dtype, "sharded array into numpy array"
+            )
             dst = obj_out
         else:
             dst = np.empty(shape, dtype=np_dtype)

@@ -533,7 +533,15 @@ class Snapshot:
     def restore(self, app_state: AppState) -> None:
         """Restore the app state in place. Arrays are restored into the
         shapes/dtypes/shardings of the *current* state (memory-efficient and
-        sharding-aware; reference rationale: snapshot.py:693-700)."""
+        sharding-aware; reference rationale: snapshot.py:693-700).
+
+        The destination is the spec: a checkpoint saved in a different
+        dtype is cast to the destination's on restore (``same_kind`` casts
+        only — float<->float incl. bf16/fp8, int<->int; mirroring the
+        reference's ``dst.copy_(src)``, io_preparer.py:426-427). For jax
+        destinations the cast runs on device AFTER the transfer, so the
+        host->device wire carries the checkpoint's (often narrower) bytes.
+        """
         self._validate_app_state(app_state)
         self._restore_impl(app_state, PGWrapper(self.pg))
 
