@@ -223,7 +223,7 @@ def needs_consistency_copy(arr) -> bool:
     return True
 
 
-def warmup_staging(app_state, pg=None, replicated=None) -> int:
+def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
     """Pre-fault the staging pool for ``app_state`` so the FIRST
     ``async_take`` blocks like a warm one.
 
@@ -277,6 +277,21 @@ def warmup_staging(app_state, pg=None, replicated=None) -> int:
         world, rank = 1, 0
     globs = list(replicated or [])
 
+    def _eff_dtype(logical_path: str, leaf) -> str:
+        """Dtype the WRITE PLAN will stage: ``save_dtype`` downcasts
+        matching leaves before staging, so slabs must be warmed at the
+        converted (usually half) size or the pool's exact-size free lists
+        never serve the real save. The decision is shared with the
+        take-time converter (serialization.effective_save_dtype) so the
+        two can never diverge."""
+        src = dtype_to_string(leaf.dtype)
+        if not save_dtype:
+            return src
+        from ..serialization import effective_save_dtype
+
+        target = effective_save_dtype(logical_path, leaf.dtype, save_dtype)
+        return dtype_to_string(target) if target is not None else src
+
     sizes: List[int] = []
     for key, stateful in app_state.items():
         state_dict = getattr(stateful, "state_dict", None)
@@ -286,7 +301,15 @@ def warmup_staging(app_state, pg=None, replicated=None) -> int:
         for logical_path, leaf in flattened.items():
             if is_sharded_jax_array(leaf):
                 if needs_consistency_copy(leaf):
-                    sizes.extend(ShardedArrayIOPreparer.staged_piece_sizes(leaf))
+                    # Subdivision boundaries depend on itemsize, so piece
+                    # sizes must be RECOMPUTED at the converted dtype —
+                    # scaling the original byte sizes would warm a
+                    # different piece multiset than the real save draws.
+                    sizes.extend(
+                        ShardedArrayIOPreparer.staged_piece_sizes(
+                            leaf, dtype=_eff_dtype(logical_path, leaf)
+                        )
+                    )
             elif _is_jax_array(leaf) or isinstance(leaf, np.ndarray):
                 if not needs_consistency_copy(leaf):
                     continue
@@ -296,11 +319,12 @@ def warmup_staging(app_state, pg=None, replicated=None) -> int:
                     any(fnmatch.fnmatch(logical_path, g) for g in globs)
                     or _is_process_replicated_jax_array(leaf)
                 )
-                nbytes = array_nbytes(leaf)
+                eff = _eff_dtype(logical_path, leaf)
+                nbytes = array_size_bytes(leaf.shape, eff)
                 if nbytes > chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES and leaf.shape:
                     row = nbytes // max(leaf.shape[0], 1)
                     ranges = chunked.ChunkedArrayIOPreparer.chunk_ranges(
-                        leaf.shape, dtype_to_string(leaf.dtype)
+                        leaf.shape, eff
                     )
                     if is_repl:
                         ranges = ranges[rank::world]

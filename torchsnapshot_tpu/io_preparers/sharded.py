@@ -191,19 +191,22 @@ class ShardedArrayIOPreparer:
     # ------------------------------------------------------------------ save
 
     @classmethod
-    def _owned_pieces(cls, arr):
+    def _owned_pieces(cls, arr, itemsize: Optional[int] = None):
         """Yield ``(p_off, p_sz, get_piece)`` for every piece THIS process
         writes: its owned boxes (deduped, hash-balanced election), each
         subdivided to the shard size cap. ``get_piece`` is a thunk — the
         device-array slice only dispatches when called, so size-only
         consumers (the staging warmup) never materialize data. The single
         source of the write partition: prepare_write builds entries from
-        it, warmup_staging sizes pool slabs from it."""
+        it, warmup_staging sizes pool slabs from it. ``itemsize`` lets the
+        warmup subdivide at the dtype a save_dtype-converted save will
+        actually stage (boundaries depend on itemsize)."""
         import jax
 
         sharding = arr.sharding
         shape = tuple(arr.shape)
-        itemsize = string_to_dtype(dtype_to_string(arr.dtype)).itemsize
+        if itemsize is None:
+            itemsize = string_to_dtype(dtype_to_string(arr.dtype)).itemsize
         process_index = jax.process_index()
 
         # box -> holder process indices (computed identically on every process)
@@ -241,12 +244,15 @@ class ShardedArrayIOPreparer:
                 yield p_off, p_sz, get_piece
 
     @classmethod
-    def staged_piece_sizes(cls, arr) -> List[int]:
+    def staged_piece_sizes(cls, arr, dtype: Optional[str] = None) -> List[int]:
         """Byte sizes of the staging buffers this process will draw for
-        ``arr`` (pool-warmup planning; no data is touched)."""
-        itemsize = string_to_dtype(dtype_to_string(arr.dtype)).itemsize
+        ``arr`` (pool-warmup planning; no data is touched). ``dtype``
+        overrides the array's own (save_dtype-converted saves)."""
+        itemsize = string_to_dtype(
+            dtype if dtype is not None else dtype_to_string(arr.dtype)
+        ).itemsize
         sizes = []
-        for _, p_sz, _ in cls._owned_pieces(arr):
+        for _, p_sz, _ in cls._owned_pieces(arr, itemsize=itemsize):
             n = itemsize
             for s in p_sz:
                 n *= s

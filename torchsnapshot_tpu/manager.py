@@ -87,6 +87,7 @@ class CheckpointManager:
         async_save: bool = False,
         incremental: bool = False,
         compression: Optional[str] = None,
+        save_dtype: Optional[Dict[str, str]] = None,
         replicated: Optional[List[str]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
         pg: Optional[ProcessGroup] = None,
@@ -104,6 +105,7 @@ class CheckpointManager:
         self.async_save = async_save
         self.incremental = incremental
         self.compression = compression
+        self.save_dtype = save_dtype
         self.replicated = replicated
         self.storage_options = storage_options
         self.pg = pg
@@ -174,7 +176,10 @@ class CheckpointManager:
         from .io_preparers.array import warmup_staging
 
         return warmup_staging(
-            app_state, pg=self.pg, replicated=self.replicated
+            app_state,
+            pg=self.pg,
+            replicated=self.replicated,
+            save_dtype=self.save_dtype,
         )
 
     def should_save(self, step: int) -> bool:
@@ -241,6 +246,7 @@ class CheckpointManager:
             incremental_base=base,
             record_digests=self.incremental,
             compression=self.compression,
+            save_dtype=self.save_dtype,
         )
         if self.async_save:
             self._pending = Snapshot.async_take(path, app_state, **kwargs)

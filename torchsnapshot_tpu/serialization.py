@@ -21,7 +21,7 @@ from __future__ import annotations
 import io
 import pickle
 from enum import Enum
-from typing import Any, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -106,6 +106,62 @@ def string_to_dtype(s: str) -> np.dtype:
 
 def dtype_size_bytes(s: str) -> int:
     return string_to_dtype(s).itemsize
+
+
+def _dtype_class(dt: np.dtype) -> str:
+    """"float" / "int" / "bool" / "other" — by numerical behavior, not
+    numpy kind codes: ml_dtypes customs (bfloat16, fp8s, int4) all have
+    kind 'V', so classification goes through finfo/iinfo — ml_dtypes' own,
+    which cover both its customs and the standard numpy numeric types."""
+    import ml_dtypes
+
+    if dt.kind == "b":
+        return "bool"
+    try:
+        ml_dtypes.finfo(dt)
+        return "float"
+    except ValueError:
+        pass
+    try:
+        ml_dtypes.iinfo(dt)
+        return "int"
+    except ValueError:
+        return "other"
+
+
+def effective_save_dtype(
+    logical_path: str, src_dtype: Any, save_dtype: Dict[str, str]
+) -> Optional[np.dtype]:
+    """The dtype ``save_dtype`` stores ``logical_path`` as, or None for "as
+    is". The single source of the conversion decision — the take-time
+    converter (snapshot.py) and the staging-pool warmup sizing (array.py)
+    must agree exactly or warmed slab sizes diverge from the real save's.
+
+    Rules: first matching glob decides (map a path to its own dtype to
+    shield it from a broader pattern). A cast applies only within one
+    dtype CLASS — float->float (incl. bfloat16/fp8) or int->int — and only
+    when numpy's ``same_kind`` allows it. Mixed-class casts are skipped,
+    never errors: numpy's ``same_kind`` alone would PERMIT int->float, but
+    a float-stored int leaf could then never restore into the original int
+    destination (restore forbids float->int), so an optax ``count`` under
+    a broad ``"optim/**": "bfloat16"`` glob must stay int.
+    """
+    import fnmatch
+
+    src = np.dtype(src_dtype)
+    for pattern, dt in save_dtype.items():
+        if not fnmatch.fnmatch(logical_path, pattern):
+            continue
+        target = string_to_dtype(dt)
+        if (
+            target != src
+            and _dtype_class(src) == _dtype_class(target)
+            and _dtype_class(src) in ("float", "int")
+            and np.can_cast(src, target, "same_kind")
+        ):
+            return target
+        return None  # first matching glob decides, even as a no-op
+    return None
 
 
 def array_size_bytes(shape: Sequence[int], dtype_str: str) -> int:

@@ -155,8 +155,19 @@ class Snapshot:
         incremental_base: Optional[str] = None,
         record_digests: bool = False,
         compression: Optional[str] = None,
+        save_dtype: Optional[Dict[str, str]] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` at ``path``.
+
+        ``save_dtype`` maps logical-path globs to storage dtypes (e.g.
+        ``{"model/**": "bfloat16", "optim/**": "bfloat16"}``): matching
+        float arrays are downcast ON DEVICE before staging, halving DtoH
+        and storage bytes for fp32 states; restore casts back into the
+        destination's dtype (see :meth:`restore`). Casts apply only within
+        one dtype class (float->float incl. bf16/fp8, int->int) and only
+        when ``same_kind``-safe, so int/bool/object leaves under a broad
+        float glob — optax step counts, PRNG keys — are left alone and the
+        snapshot always restores into the original state.
 
         ``incremental_base`` names a previous snapshot: payloads whose
         content is unchanged since it are not rewritten — their entries
@@ -173,6 +184,7 @@ class Snapshot:
         transparently (see compression.py for the full design rules).
         """
         cls._validate_app_state(app_state)
+        cls._validate_save_dtype(save_dtype)
         event_loop = asyncio.new_event_loop()
         pg_wrapper = PGWrapper(pg)
         path = cls._coalesce_path(path, pg_wrapper)
@@ -198,6 +210,7 @@ class Snapshot:
                     record_digests=record_digests,
                     storage_options=storage_options,
                     compression=compression,
+                    save_dtype=save_dtype,
                 )
             pending_io_work.sync_complete(event_loop)
             _drain_background_storage(storage, event_loop)
@@ -249,14 +262,16 @@ class Snapshot:
         incremental_base: Optional[str] = None,
         record_digests: bool = False,
         compression: Optional[str] = None,
+        save_dtype: Optional[Dict[str, str]] = None,
     ) -> "PendingSnapshot":
         """Non-blocking take. Returns once *staging* (DtoH copy + serialize)
         completes — after that, mutations to the app state do not affect the
         snapshot. Storage I/O and the metadata commit continue on a
         background thread; call ``.wait()`` on the returned handle
         (reference: snapshot.py:245-313). ``incremental_base`` /
-        ``record_digests`` as in :meth:`take`."""
+        ``record_digests`` / ``save_dtype`` as in :meth:`take`."""
         cls._validate_app_state(app_state)
+        cls._validate_save_dtype(save_dtype)
         event_loop = asyncio.new_event_loop()
         pg_wrapper = PGWrapper(pg)
         path = cls._coalesce_path(path, pg_wrapper)
@@ -276,6 +291,7 @@ class Snapshot:
             record_digests=record_digests,
             storage_options=storage_options,
             compression=compression,
+            save_dtype=save_dtype,
         )
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -303,6 +319,7 @@ class Snapshot:
         record_digests: bool = False,
         storage_options: Optional[Dict[str, Any]] = None,
         compression: Optional[str] = None,
+        save_dtype: Optional[Dict[str, str]] = None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         timer = timer or _PhaseTimer("Snapshot.take")  # unlogged unless the caller logs
         rank = pg_wrapper.get_rank()
@@ -413,6 +430,15 @@ class Snapshot:
                             materialize_exc = e
                 pg_wrapper.barrier()
             timer.mark("materialize")
+
+            if save_dtype and materialize_exc is None:
+                elided = cls._convert_save_dtypes(flattened, save_dtype)
+                if elided:
+                    logger.info(
+                        "save_dtype downcast elided %.1f MB before staging",
+                        elided / 1e6,
+                    )
+                timer.mark("convert")
 
             replicated_paths = cls._calculate_replicated_paths(
                 flattened, replicated, pg_wrapper
@@ -953,6 +979,62 @@ class Snapshot:
                     "does not implement state_dict()/load_state_dict(). Wrap "
                     "raw pytrees in torchsnapshot_tpu.StateDict."
                 )
+
+    @staticmethod
+    def _validate_save_dtype(save_dtype: Optional[Dict[str, str]]) -> None:
+        """Fail on malformed ``save_dtype`` BEFORE any collective work: a
+        typo like "bf16" otherwise surfaces mid-take as a metadata-version
+        error, after the cross-rank materialize barriers already ran."""
+        if not save_dtype:
+            return
+        from .serialization import string_to_dtype
+
+        for pattern, dt in save_dtype.items():
+            try:
+                string_to_dtype(dt)
+            except ValueError:
+                raise ValueError(
+                    f"save_dtype[{pattern!r}]: unknown dtype name {dt!r} "
+                    '(use numpy-style names like "bfloat16", "float32", '
+                    '"float8_e4m3fn", "int32").'
+                ) from None
+
+    @staticmethod
+    def _convert_save_dtypes(
+        flattened: Dict[str, Any], save_dtype: Dict[str, str]
+    ) -> int:
+        """Downcast matching array leaves IN the flattened state before
+        write planning, so every downstream stage — DtoH, staging,
+        checksum, storage — moves the converted (usually half-size) bytes.
+
+        The conversion decision (glob precedence, dtype-class rules) lives
+        in ``serialization.effective_save_dtype``, shared with the staging
+        warmup's slab sizing. jax arrays cast ON DEVICE (``astype``
+        preserves sharding; the wire then carries the narrow bytes); numpy
+        leaves cast on host. Returns bytes elided.
+
+        Memory note: conversion is eager — converted copies of ALL matched
+        leaves exist on device until staging drains them, so the transient
+        HBM overhead is ratio x matched bytes (+50% of matched fp32 state
+        for bf16). For states near HBM capacity, scope the globs or save
+        state groups in separate takes.
+
+        No reference analogue — torchsnapshot stores tensors byte-exact
+        only. The orbax counterpart is Save-/RestoreArgs dtype casting.
+        """
+        from .io_preparers.prepare import is_jax_array as _isjax
+        from .serialization import effective_save_dtype
+
+        saved = 0
+        for lp, obj in flattened.items():
+            if not (isinstance(obj, np.ndarray) or _isjax(obj)):
+                continue
+            target = effective_save_dtype(lp, obj.dtype, save_dtype)
+            if target is not None:
+                before = obj.nbytes
+                flattened[lp] = obj.astype(target)
+                saved += before - flattened[lp].nbytes
+        return saved
 
     @staticmethod
     def _coalesce_path(path: str, pg_wrapper: PGWrapper) -> str:
