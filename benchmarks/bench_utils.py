@@ -43,3 +43,17 @@ def force_cpu_devices(n: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def payload_bytes(root: str, include_metadata: bool = False) -> int:
+    """Total on-disk bytes under a snapshot root. By default counts only
+    payload files (dotfiles — .snapshot_metadata — excluded), so byte-
+    reduction claims measure data, not metadata."""
+    import os
+
+    total = 0
+    for r, _, files in os.walk(root):
+        for f in files:
+            if include_metadata or not f.startswith("."):
+                total += os.path.getsize(os.path.join(r, f))
+    return total

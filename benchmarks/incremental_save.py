@@ -22,15 +22,11 @@ import tempfile
 
 import numpy as np
 
-from bench_utils import report, timed_rss
+from bench_utils import payload_bytes, report, timed_rss
 
 
 def _disk_bytes(root: str) -> int:
-    total = 0
-    for r, _, files in os.walk(root):
-        for f in files:
-            total += os.path.getsize(os.path.join(r, f))
-    return total
+    return payload_bytes(root, include_metadata=True)
 
 
 def main() -> None:

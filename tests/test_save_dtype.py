@@ -212,6 +212,27 @@ def test_save_dtype_upcast_also_works(tmp_path):
     assert _entries(path)["m/w"].dtype == "float32"
 
 
+def test_fp8_quarter_size_storage(tmp_path):
+    """fp8 is in the float class: 4x smaller storage for tolerant state
+    (e.g. EMA shadows); restore widens back through the same machinery."""
+    src = np.linspace(-2, 2, 1024, dtype=np.float32)
+    path = str(tmp_path / "s")
+    Snapshot.take(
+        path,
+        {"m": StateDict(w=jnp.asarray(src))},
+        save_dtype={"m/**": "float8_e4m3fn"},
+    )
+    assert _entries(path)["m/w"].dtype == "float8_e4m3fn"
+    dst = {"m": StateDict(w=jnp.zeros(1024, jnp.float32))}
+    Snapshot(path=path).restore(dst)
+    import ml_dtypes
+
+    np.testing.assert_array_equal(
+        np.asarray(dst["m"]["w"]),
+        src.astype(ml_dtypes.float8_e4m3fn).astype(np.float32),
+    )
+
+
 def test_composes_with_incremental_and_compression(tmp_path):
     """Digests are computed on the CONVERTED bytes, so an unchanged leaf
     dedups across a save_dtype chain, and compression applies on top."""
