@@ -26,7 +26,7 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     args = ap.parse_args()
 
-    from bench_utils import force_cpu_devices, report, timed_rss
+    from bench_utils import force_cpu_devices, payload_bytes, report, timed_rss
 
     if args.cpu:
         force_cpu_devices(1)
@@ -79,8 +79,6 @@ def main() -> None:
                 {"model": StateDict(**params)},
                 save_dtype={"model/**": "bfloat16"},
             )
-        from bench_utils import payload_bytes
-
         res["written_mb"] = round(payload_bytes(f"{tmp}/snap_bf16") / 1e6, 1)
         report("replicated_save/snapshot_bf16", res, nbytes)
 

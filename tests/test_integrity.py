@@ -36,6 +36,19 @@ def test_crc32c_native_matches_python() -> None:
     assert crc32c(data) == _crc32c_py(data)
 
 
+def test_crc32c_3way_boundaries_and_chaining() -> None:
+    """The hardware path switches to 3-way interleaved lanes at 24 KB
+    (3 x kLane) with a GF(2) zero-shift recombine; pin bit-exactness right
+    around the switch, across multi-block sizes, and when the incoming crc
+    is a chained (nonzero) state entering the 3-way block loop."""
+    rng = np.random.default_rng(1)
+    for sz in (24575, 24576, 24577, 3 * 24576, 100_001):
+        data = rng.integers(0, 256, sz, np.uint8).tobytes()
+        assert crc32c(data) == _crc32c_py(data), sz
+        # arbitrary split: the second call enters 3-way with nonzero state
+        assert crc32c(data[999:], crc32c(data[:999])) == crc32c(data), sz
+
+
 def test_crc32c_python_fallback_used_when_native_disabled(monkeypatch) -> None:
     monkeypatch.setattr(native_mod, "_lib", None)
     monkeypatch.setattr(native_mod, "_load_attempted", True)
