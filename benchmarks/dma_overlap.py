@@ -14,6 +14,9 @@ cost being overlapped, not a bottleneck being hidden:
 2. ``dma_overlap/async_take``: a jitted on-chip train step timed bare,
    then with ``Snapshot.async_take`` of a small device state in flight
    — step_inflation shows how much staging+I/O steals from compute.
+3. ``dma_overlap/sync_take``: a warm-machinery ``Snapshot.take`` over
+   FRESH device arrays (uncached DtoH) with a bit-exact restore —
+   the end-to-end on-chip checkpoint number.
 
 Usage: python benchmarks/dma_overlap.py [n_arrays] [mb_per_array]
 Emits one JSON line per leg; exits 2 (no JSON) off-TPU.
@@ -22,6 +25,7 @@ Emits one JSON line per leg; exits 2 (no JSON) off-TPU.
 from __future__ import annotations
 
 import os
+import shutil
 import sys
 import tempfile
 import time
@@ -153,8 +157,52 @@ def main() -> int:
             },
         )
     finally:
-        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
 
+    # --- timed sync take over fresh (uncached) device state ----------
+    # Warm the snapshot machinery on one state, then time a take over
+    # FRESH device arrays so the DtoH is real, not an _npy_value hit.
+    def build_state(seed):
+        k = jax.random.PRNGKey(seed)
+        s = StateDict(
+            w=jax.random.normal(k, (d, 2 * d), jnp.bfloat16),
+            b=jax.random.normal(jax.random.fold_in(k, 1), (2 * d, d), jnp.bfloat16),
+        )
+        jax.block_until_ready(list(s.values()))
+        return s
+
+    tmp = tempfile.mkdtemp(prefix="tpu_take_")
+    try:
+        Snapshot.take(os.path.join(tmp, "warm"), {"m": build_state(3)})
+        st = build_state(4)
+        nbytes = sum(v.nbytes for v in st.values())
+        t0 = time.perf_counter()
+        snap = Snapshot.take(os.path.join(tmp, "timed"), {"m": st})
+        t_take = time.perf_counter() - t0
+        dst = {
+            "m": StateDict(
+                w=np.zeros((d, 2 * d), np.float32),
+                b=np.zeros((2 * d, d), np.float32),
+            )
+        }
+        t0 = time.perf_counter()
+        snap.restore(dst)
+        t_restore = time.perf_counter() - t0
+        ok = np.array_equal(
+            np.asarray(st["w"], np.float32), dst["m"]["w"]
+        ) and np.array_equal(np.asarray(st["b"], np.float32), dst["m"]["b"])
+        report(
+            "dma_overlap/sync_take",
+            {
+                "state_mb": round(nbytes / 1e6, 1),
+                "take_s": round(t_take, 2),
+                "take_mbps": round(nbytes / 1e6 / max(t_take, 1e-9), 2),
+                "restore_s": round(t_restore, 2),
+                "bit_exact": ok,
+                "platform": "tpu",
+            },
+        )
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return 0
 
