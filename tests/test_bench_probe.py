@@ -41,7 +41,7 @@ def test_probe_success_first_try(monkeypatch):
         "run",
         lambda *a, **k: FakeResult(0, "banner\ntpu 1 2.5000\n"),
     )
-    assert bench._probe_backend() == "tpu"
+    assert bench._probe_backend() == ("tpu", True)
 
 
 def test_probe_retries_clean_failure_then_succeeds(monkeypatch, _fast):
@@ -54,7 +54,7 @@ def test_probe_retries_clean_failure_then_succeeds(monkeypatch, _fast):
         return FakeResult(0, "tpu 1 1.0000\n")
 
     monkeypatch.setattr(bench.subprocess, "run", run)
-    assert bench._probe_backend() == "tpu"
+    assert bench._probe_backend() == ("tpu", True)
     assert len(calls) == 3
     assert _fast == [30, 30]  # one clean-failure pause per failed attempt
 
@@ -69,7 +69,7 @@ def test_probe_killed_gets_longer_cooldown(monkeypatch, _fast):
         return FakeResult(0, "tpu 1 1.0000\n")
 
     monkeypatch.setattr(bench.subprocess, "run", run)
-    assert bench._probe_backend() == "tpu"
+    assert bench._probe_backend() == ("tpu", True)
     assert _fast == [120]  # killed probes cool down longer
 
 
@@ -79,7 +79,9 @@ def test_probe_slow_dtoh_falls_back_to_cpu(monkeypatch):
         "run",
         lambda *a, **k: FakeResult(0, "tpu 1 0.0100\n"),  # tunnel-grade DtoH
     )
-    assert bench._probe_backend() == "cpu"
+    # A reachable-but-tunnel-bound chip still reports tpu_reachable=True
+    # so the hardware side-leg runs even though the main leg is on cpu.
+    assert bench._probe_backend() == ("cpu", True)
 
 
 def test_probe_exhausts_budget_and_falls_back(monkeypatch, _fast):
@@ -101,10 +103,45 @@ def test_probe_exhausts_budget_and_falls_back(monkeypatch, _fast):
         return FakeResult(1, "", "UNAVAILABLE")
 
     monkeypatch.setattr(bench.subprocess, "run", run)
-    assert bench._probe_backend() == "cpu"
+    assert bench._probe_backend() == ("cpu", False)
     assert 2 <= len(calls) <= 6  # bounded by the 300 s budget
 
 
 def test_force_cpu_env(monkeypatch):
     monkeypatch.setenv("BENCH_FORCE_CPU", "1")
-    assert bench._probe_backend() == "cpu"
+    assert bench._probe_backend() == ("cpu", False)
+
+def test_tpu_hw_leg_parses_output(monkeypatch):
+    out = (
+        '{"benchmark": "dma_overlap/stage", "overlap_ratio": 1.8}\n'
+        '{"benchmark": "dma_overlap/async_take", "step_inflation": 1.02}\n'
+        '{"benchmark": "dma_overlap/sync_take", "take_mbps": 12.4, '
+        '"bit_exact": true}\n'
+    )
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *a, **k: FakeResult(0, out)
+    )
+    summary, killed = bench._tpu_hw_leg()
+    assert not killed
+    assert summary == {
+        "dma_overlap_ratio": 1.8,
+        "async_step_inflation": 1.02,
+        "sync_take_mbps": 12.4,
+        "sync_take_bit_exact": True,
+    }
+
+
+def test_tpu_hw_leg_timeout_reports_killed(monkeypatch):
+    def run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    assert bench._tpu_hw_leg() == (None, True)
+
+
+def test_tpu_hw_leg_incomplete_output(monkeypatch):
+    out = '{"benchmark": "dma_overlap/stage", "overlap_ratio": 1.8}\n'
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *a, **k: FakeResult(0, out)
+    )
+    assert bench._tpu_hw_leg() == (None, False)
