@@ -1,0 +1,342 @@
+"""Device-resident fingerprints: incremental saves without the DtoH copy.
+
+The host dedup path (test_incremental.py) proves unchanged payloads skip
+the storage WRITE; these tests prove that with ``device_digests=True``
+unchanged device payloads skip the STAGING TRANSFER too — the staging
+executor is never entered for them — while every mutation still restages,
+and restores stay bit-exact. Fingerprint algorithm properties (bit/
+permutation/length sensitivity, cross-dtype support, determinism) are
+covered directly against device_digest.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.device_digest import PREFIX, device_fingerprint
+from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+
+
+@pytest.fixture
+def staging_spy(monkeypatch):
+    """Records the entry location of every payload that reaches the full
+    staging path (DtoH + serialize + hash)."""
+    staged = []
+    orig = ArrayBufferStager._stage_and_sum
+
+    def spy(self, arr):
+        staged.append(self.entry.location if self.entry else "?")
+        return orig(self, arr)
+
+    monkeypatch.setattr(ArrayBufferStager, "_stage_and_sum", spy)
+    return staged
+
+
+# --------------------------------------------------------- fingerprint unit
+
+
+def test_fingerprint_format_and_determinism():
+    x = jnp.arange(1000, dtype=jnp.float32)
+    fp1 = device_fingerprint(x)
+    fp2 = device_fingerprint(jnp.arange(1000, dtype=jnp.float32))
+    assert fp1 == fp2
+    algo, hexpart = fp1.split(":")
+    assert algo == PREFIX
+    assert len(hexpart) == 32
+    int(hexpart, 16)
+
+
+def test_fingerprint_single_bit_sensitivity():
+    x = jnp.zeros(4096, jnp.uint32)
+    base = device_fingerprint(x)
+    for pos in (0, 1, 2048, 4095):
+        assert device_fingerprint(x.at[pos].set(1)) != base, pos
+
+
+def test_fingerprint_permutation_sensitivity():
+    x = jnp.arange(512, dtype=jnp.int32)
+    y = x[::-1]
+    assert device_fingerprint(x) != device_fingerprint(y)
+
+
+def test_fingerprint_length_sensitivity():
+    # Same word stream prefix, different lengths.
+    a = jnp.zeros(16, jnp.uint32)
+    b = jnp.zeros(32, jnp.uint32)
+    assert device_fingerprint(a) != device_fingerprint(b)
+
+
+def test_fingerprint_dtype_distinguished():
+    # Identical byte count + identical zero bytes, different dtypes
+    # produce different word streams only via widening; the length term
+    # keeps streams of equal widened shape distinct per byte size, and
+    # equal byte content with equal dtype width hashes equal.
+    a = jnp.zeros(64, jnp.uint16)  # 128 bytes, words widened from u16
+    b = jnp.zeros(32, jnp.uint32)  # 128 bytes, native words
+    fa, fb = device_fingerprint(a), device_fingerprint(b)
+    assert fa is not None and fb is not None
+    # Not required to differ (both all-zero streams of equal byte length
+    # could legitimately collide per construction) — but matching is
+    # always additionally guarded by entry dtype/shape via the location
+    # and nbytes. Just assert both computed.
+
+
+@pytest.mark.parametrize(
+    "dtype",
+    [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.uint8, jnp.int32, jnp.bool_],
+)
+def test_fingerprint_dtype_support(dtype):
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 2, size=257), dtype=dtype)
+    fp = device_fingerprint(x)
+    assert fp is not None and fp.startswith(PREFIX + ":")
+
+
+def test_fingerprint_empty_and_scalar():
+    assert device_fingerprint(jnp.zeros((0,), jnp.float32)) is not None
+    assert device_fingerprint(jnp.asarray(1.5)) is not None
+
+
+def test_fingerprint_non_jax_returns_none():
+    assert device_fingerprint(np.zeros(4)) is None
+    assert device_fingerprint("nope") is None
+
+
+def test_fingerprint_matches_across_reshape_of_same_bytes():
+    # Fingerprint is over the raveled content: same bytes, same result —
+    # shape is carried by the manifest entry, mirroring how the sha256
+    # content digest behaves.
+    x = jnp.arange(64, dtype=jnp.float32)
+    assert device_fingerprint(x) == device_fingerprint(x.reshape(8, 8))
+
+
+# ------------------------------------------------------------- end to end
+
+
+def test_unchanged_payloads_skip_staging(tmp_path, staging_spy):
+    w = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+    b = jnp.ones((128,), jnp.bfloat16)
+    state = {"m": StateDict(w=w, b=b)}
+    Snapshot.take(str(tmp_path / "base"), state, device_digests=True)
+    assert len(staging_spy) > 0  # base pays staging
+    staging_spy.clear()
+
+    # Fresh device buffers, same values: nothing stages.
+    state2 = {"m": StateDict(w=w + 0, b=b + 0)}
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        state2,
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    assert staging_spy == []
+
+    dst = {"m": StateDict(w=jnp.zeros_like(w), b=jnp.zeros_like(b))}
+    snap.restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(dst["m"]["b"]), np.asarray(b))
+
+
+def test_changed_payload_restages(tmp_path, staging_spy):
+    w = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+    b = jnp.ones((128,), jnp.bfloat16)
+    state = {"m": StateDict(w=w, b=b)}
+    Snapshot.take(str(tmp_path / "base"), state, device_digests=True)
+    staging_spy.clear()
+
+    state2 = {"m": StateDict(w=w.at[3, 3].add(1.0), b=b)}
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        state2,
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    assert len(staging_spy) == 1 and "m/w" in staging_spy[0]
+
+    dst = {"m": StateDict(w=jnp.zeros_like(w), b=jnp.zeros_like(b))}
+    snap.restore(dst)
+    np.testing.assert_array_equal(
+        np.asarray(dst["m"]["w"]), np.asarray(state2["m"]["w"])
+    )
+
+
+def test_base_without_device_digests_falls_back_to_host_dedup(tmp_path, staging_spy):
+    """A base taken with only record_digests still deduplicates — via the
+    staged-bytes sha256 — it just pays the DtoH."""
+    w = jnp.arange(256, dtype=jnp.float32)
+    state = {"m": StateDict(w=w)}
+    Snapshot.take(str(tmp_path / "base"), state, record_digests=True)
+    staging_spy.clear()
+
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    # Staging DID run (no device fingerprint in the base to match) ...
+    assert len(staging_spy) == 1
+    # ... but the write was still deduplicated via sha256.
+    meta = snap.metadata
+    from torchsnapshot_tpu.dedup import _iter_payload_entries
+
+    payloads = [
+        p
+        for e in meta.manifest.values()
+        for p in _iter_payload_entries(e)
+    ]
+    assert payloads and all(p.origin for p in payloads)
+    # And THIS take recorded fingerprints, so the next one can skip DtoH.
+    assert all(p.device_digest for p in payloads)
+
+
+def test_env_var_enables(tmp_path, staging_spy, monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEVICE_DIGESTS", "1")
+    w = jnp.arange(256, dtype=jnp.float32)
+    Snapshot.take(str(tmp_path / "base"), {"m": StateDict(w=w)})
+    staging_spy.clear()
+    Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+    )
+    assert staging_spy == []
+
+
+def test_sharded_array_skips_staging(tmp_path, staging_spy):
+    """GSPMD-sharded arrays (the frozen-backbone case): every owned piece
+    fingerprints on its device and skips staging when unchanged."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+    sharding = NamedSharding(mesh, PartitionSpec("x", "y"))
+    w = jax.device_put(
+        jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64), sharding
+    )
+    state = {"m": StateDict(w=w)}
+    Snapshot.take(str(tmp_path / "base"), state, device_digests=True)
+    assert len(staging_spy) > 0
+    staging_spy.clear()
+
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    assert staging_spy == []
+
+    # Restore onto a DIFFERENT sharding: origin reads + scatter still work.
+    sharding2 = NamedSharding(mesh, PartitionSpec("y", "x"))
+    dst = {"m": StateDict(w=jax.device_put(jnp.zeros_like(w), sharding2))}
+    snap.restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+def test_save_dtype_composes(tmp_path, staging_spy):
+    """save_dtype downcasts on device BEFORE fingerprinting, so the
+    fingerprint covers the bytes actually stored and unchanged downcast
+    payloads skip staging across saves."""
+    w = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+    sd = {"m/**": "bfloat16"}
+    state = {"m": StateDict(w=w)}
+    Snapshot.take(
+        str(tmp_path / "base"), state, device_digests=True, save_dtype=sd
+    )
+    staging_spy.clear()
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+        save_dtype=sd,
+    )
+    assert staging_spy == []
+    dst = {"m": StateDict(w=jnp.zeros_like(w))}
+    snap.restore(dst)
+    np.testing.assert_array_equal(
+        np.asarray(dst["m"]["w"]), np.asarray(w.astype(jnp.bfloat16).astype(jnp.float32))
+    )
+
+
+def test_async_take_device_dedup(tmp_path, staging_spy):
+    w = jnp.arange(4096, dtype=jnp.float32)
+    state = {"m": StateDict(w=w)}
+    Snapshot.take(str(tmp_path / "base"), state, device_digests=True)
+    staging_spy.clear()
+    pending = Snapshot.async_take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    snap = pending.wait()
+    assert staging_spy == []
+    dst = {"m": StateDict(w=jnp.zeros_like(w))}
+    snap.restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+def test_consolidate_materializes_device_deduped(tmp_path):
+    """CLI consolidate resolves origin payloads of a device-deduped
+    snapshot into a self-contained one."""
+    from torchsnapshot_tpu.dedup import consolidate
+
+    w = jnp.arange(1024, dtype=jnp.float32)
+    Snapshot.take(str(tmp_path / "base"), {"m": StateDict(w=w)}, device_digests=True)
+    Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    consolidate(str(tmp_path / "incr"), str(tmp_path / "solid"))
+    dst = {"m": StateDict(w=jnp.zeros_like(w))}
+    Snapshot(str(tmp_path / "solid")).restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+def test_int4_payload_falls_back_without_crashing(tmp_path, staging_spy):
+    """Sub-byte packings (int4) have no elementwise uint8 bitcast — jax
+    rejects them with ValueError; the take must fall back to host staging
+    rather than fail."""
+    try:
+        x = jnp.arange(-8, 8, dtype=jnp.int4)
+    except (TypeError, AttributeError):
+        pytest.skip("int4 unsupported in this jax build")
+    assert device_fingerprint(x) is None
+    state = {"m": StateDict(q=x)}
+    Snapshot.take(str(tmp_path / "base"), state, device_digests=True)
+    staging_spy.clear()
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(q=x + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+    )
+    # Host path ran (staged), and sha-dedup still elided the write.
+    assert len(staging_spy) == 1
+    dst = {"m": StateDict(q=jnp.zeros_like(x))}
+    snap.restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["q"]), np.asarray(x))
+
+
+def test_checkpoint_manager_plumbs_device_digests(tmp_path, staging_spy):
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    w = jnp.arange(512, dtype=jnp.float32)
+    mgr = CheckpointManager(
+        str(tmp_path / "ckpts"), incremental=True, device_digests=True
+    )
+    mgr.save(0, {"m": StateDict(w=w)})
+    staging_spy.clear()
+    mgr.save(1, {"m": StateDict(w=w + 0)})  # chains against step 0
+    assert staging_spy == []
+    dst = {"m": StateDict(w=jnp.zeros_like(w))}
+    Snapshot(mgr.path_for(1)).restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))

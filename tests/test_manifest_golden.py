@@ -167,6 +167,7 @@ def test_asdict_field_order_is_stable(metadata) -> None:
         "digest",
         "origin",
         "codec",
+        "device_digest",
     ]
     d = asdict(metadata.manifest["0/extra/blob"])
     assert list(d.keys()) == [

@@ -89,6 +89,9 @@ class PayloadRef:
     nbytes: Optional[int]
     checksum: Optional[str] = None
     codec: Optional[str] = None
+    # Device-resident fingerprint the base recorded (device_digest.py):
+    # matching it skips the DtoH transfer, not just the storage write.
+    device_digest: Optional[str] = None
 
 
 def _iter_payload_entries(entry: Entry) -> Iterator[ArrayEntry]:
@@ -109,16 +112,30 @@ class DedupContext:
     capture it at construction and consult it at stage time.
     """
 
-    def __init__(self, base_path: Optional[str], refs: Dict[str, PayloadRef]):
+    def __init__(
+        self,
+        base_path: Optional[str],
+        refs: Dict[str, PayloadRef],
+        device_digests: bool = False,
+    ):
         self.base_path = base_path
         self.refs = refs
+        # When True, stagers fingerprint device arrays on device
+        # (device_digest.py) and skip the DtoH copy on a base match; the
+        # fingerprint is also recorded so FUTURE takes can match.
+        self.device_digests = device_digests
 
     @classmethod
-    def recording_only(cls) -> "DedupContext":
-        return cls(base_path=None, refs={})
+    def recording_only(cls, device_digests: bool = False) -> "DedupContext":
+        return cls(base_path=None, refs={}, device_digests=device_digests)
 
     @classmethod
-    def from_base(cls, base_path: str, metadata: SnapshotMetadata) -> "DedupContext":
+    def from_base(
+        cls,
+        base_path: str,
+        metadata: SnapshotMetadata,
+        device_digests: bool = False,
+    ) -> "DedupContext":
         """Index every digest-carrying payload of ``metadata`` by location.
 
         ``origin`` resolves transitively: if the base itself borrowed the
@@ -147,6 +164,7 @@ class DedupContext:
                         nbytes=nbytes,
                         checksum=p.checksum,
                         codec=p.codec,
+                        device_digest=p.device_digest,
                     ),
                 )
             if isinstance(entry, ObjectEntry) and entry.digest is not None:
@@ -160,7 +178,7 @@ class DedupContext:
                         codec=entry.codec,
                     ),
                 )
-        return cls(base_path=base_path, refs=refs)
+        return cls(base_path=base_path, refs=refs, device_digests=device_digests)
 
     def match(self, location: str, digest: str, nbytes: int) -> Optional[PayloadRef]:
         ref = self.refs.get(location)

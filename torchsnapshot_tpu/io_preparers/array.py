@@ -375,6 +375,54 @@ class ArrayBufferStager(BufferStager):
             host = np.array(host, copy=True)
         return host
 
+    def _device_dedup_candidate(self, arr) -> bool:
+        return (
+            self.dedup is not None
+            and self.dedup.device_digests
+            and self.entry is not None
+            and self.entry.byte_range is None
+            and _is_jax_array(arr)
+        )
+
+    def _record_device_fingerprint(self, arr) -> Optional[str]:
+        """Fingerprint ``arr`` on device and record it on the entry so
+        the NEXT incremental take can match against this snapshot.
+        Returns the fingerprint, or None when the array cannot be
+        fingerprinted on device (host SHA-256 path takes over)."""
+        from ..device_digest import device_fingerprint
+
+        fp = device_fingerprint(arr)
+        if fp is not None:
+            self.entry.device_digest = fp
+        return fp
+
+    def _try_device_dedup(self, arr) -> bool:
+        """Fingerprint ``arr`` on device (device_digest.py) and, when the
+        base snapshot recorded the same fingerprint for this location,
+        skip staging entirely — the DtoH copy never happens, only the
+        16-byte fingerprint crosses to the host.
+
+        On a match the entry's digest/checksum/codec are taken from the
+        base's ref — fingerprint equality implies content equality under
+        the (opt-in, non-cryptographic) trust model documented in
+        device_digest.py. Unlike the host path there is no staged buffer
+        here, so a base saved without checksums leaves the entry's
+        checksum unset rather than recomputing one."""
+        fp = self._record_device_fingerprint(arr)
+        if fp is None:
+            return False
+        ref = self.dedup.refs.get(self.entry.location)
+        if ref is None or ref.device_digest != fp:
+            return False
+        nbytes = array_nbytes(arr)
+        if ref.nbytes is not None and ref.nbytes != nbytes:
+            return False  # same fingerprint, different size: never trust
+        self.entry.digest = ref.digest
+        self.entry.origin = ref.origin
+        self.entry.codec = ref.codec
+        self.entry.checksum = ref.checksum
+        return True
+
     def _stage_fused(self, arr) -> Optional[BufferType]:
         """Consistency copy + CRC32C fused into ONE pass over the source
         (native ts_copy_crc32c). Staging must both copy (the caller may
@@ -473,12 +521,32 @@ class ArrayBufferStager(BufferStager):
 
     async def stage_buffer(self, executor=None) -> BufferType:
         arr = self.arr
+        loop = asyncio.get_running_loop()
+        record_fp = False
+        if self._device_dedup_candidate(arr):
+            ref = self.dedup.refs.get(self.entry.location)
+            if ref is not None and ref.device_digest is not None:
+                # A skip is possible: fingerprint BEFORE kicking the DtoH
+                # DMA — a match makes the transfer unnecessary, which is
+                # the entire point.
+                if await loop.run_in_executor(
+                    executor, self._try_device_dedup, arr
+                ):
+                    self.io_skipped = True
+                    return memoryview(b"")
+            else:
+                # No base fingerprint to match (first save, or a base
+                # taken without device digests): the DMA must happen, so
+                # kick it first and let the recording fingerprint — pure
+                # on-device compute — overlap the transfer.
+                record_fp = True
         if _is_jax_array(arr):
             try:
                 arr.copy_to_host_async()  # kick off the DMA before blocking
             except Exception:
                 pass
-        loop = asyncio.get_running_loop()
+        if record_fp:
+            await loop.run_in_executor(executor, self._record_device_fingerprint, arr)
         return await loop.run_in_executor(executor, self._stage_and_sum, arr)
 
     def get_staging_cost_bytes(self) -> int:

@@ -42,6 +42,9 @@ Semantics worth knowing:
   deleted. Retention — and ``latest_step`` discovery — need a local
   filesystem root; on remote roots retention is skipped and resume
   needs an explicit ``step=``.
+- ``device_digests=True`` (with ``incremental``) detects unchanged
+  payloads ON DEVICE — the DtoH transfer is skipped too, not just the
+  storage write (device_digest.py; opt-in trust model).
 - ``incremental=True`` records digests on every save and chains each
   snapshot to the previous COMMITTED one; retention's base-closure
   keeps chains restorable (consolidate before archiving elsewhere).
@@ -86,6 +89,7 @@ class CheckpointManager:
         keep_every: Optional[int] = None,
         async_save: bool = False,
         incremental: bool = False,
+        device_digests: Optional[bool] = None,
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
         replicated: Optional[List[str]] = None,
@@ -104,6 +108,7 @@ class CheckpointManager:
         self.keep_every = keep_every
         self.async_save = async_save
         self.incremental = incremental
+        self.device_digests = device_digests
         self.compression = compression
         self.save_dtype = save_dtype
         self.replicated = replicated
@@ -245,6 +250,7 @@ class CheckpointManager:
             storage_options=self._options_for(step),
             incremental_base=base,
             record_digests=self.incremental,
+            device_digests=self.device_digests,
             compression=self.compression,
             save_dtype=self.save_dtype,
         )

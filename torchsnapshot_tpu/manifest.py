@@ -63,6 +63,10 @@ class ArrayEntry(Entry):
     # STORED bytes ("zstd:3"); checksum covers the stored bytes, digest
     # the uncompressed ones. Omitted from YAML when unset.
     codec: Optional[str] = None
+    # Device-resident fingerprint (device_digest.py, "xxh4x32:<hex>"):
+    # lets a future incremental take detect the payload unchanged WITHOUT
+    # a DtoH transfer. Omitted when unset.
+    device_digest: Optional[str] = None
 
     def __init__(
         self,
@@ -76,6 +80,7 @@ class ArrayEntry(Entry):
         digest: Optional[str] = None,
         origin: Optional[str] = None,
         codec: Optional[str] = None,
+        device_digest: Optional[str] = None,
     ) -> None:
         super().__init__(type="array")
         self.location = location
@@ -88,6 +93,7 @@ class ArrayEntry(Entry):
         self.digest = digest
         self.origin = origin
         self.codec = codec
+        self.device_digest = device_digest
 
 
 @dataclass
@@ -305,6 +311,7 @@ def _array_entry_from_dict(d: Dict[str, Any]) -> ArrayEntry:
     e.digest = d.get("digest")
     e.origin = d.get("origin")
     e.codec = d.get("codec")
+    e.device_digest = d.get("device_digest")
     return e
 
 
@@ -352,7 +359,7 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
     raise ValueError(f"Unknown manifest entry type: {type_name!r}")
 
 
-_STRIPPED_WHEN_NONE = ("digest", "origin", "codec")
+_STRIPPED_WHEN_NONE = ("digest", "origin", "codec", "device_digest")
 _FIELD_NAME_CACHE: Dict[type, List[str]] = {}
 
 
@@ -374,6 +381,8 @@ def _array_entry_to_dict(e: "ArrayEntry") -> Dict[str, Any]:
         out["origin"] = e.origin
     if e.codec is not None:
         out["codec"] = e.codec
+    if e.device_digest is not None:
+        out["device_digest"] = e.device_digest
     return out
 
 

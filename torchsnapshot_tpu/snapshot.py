@@ -156,6 +156,7 @@ class Snapshot:
         record_digests: bool = False,
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
+        device_digests: Optional[bool] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` at ``path``.
 
@@ -176,6 +177,14 @@ class Snapshot:
         itself). ``record_digests`` records content digests so a FUTURE
         take can use this snapshot as its base; implied by
         ``incremental_base``.
+
+        ``device_digests`` (default: the
+        ``TORCHSNAPSHOT_TPU_DEVICE_DIGESTS`` env var) additionally
+        fingerprints device arrays ON DEVICE (device_digest.py): an
+        incremental take whose base recorded matching fingerprints skips
+        the DtoH transfer for unchanged payloads entirely — on TPU the
+        dominant cost — instead of staging them to hash. Opt-in because
+        the fingerprint is strong but not cryptographic.
 
         ``compression`` enables payload compression ("zstd", "zstd:<lvl>",
         "zlib", "zlib:<lvl>"); default is the
@@ -211,6 +220,7 @@ class Snapshot:
                     storage_options=storage_options,
                     compression=compression,
                     save_dtype=save_dtype,
+                    device_digests=device_digests,
                 )
             pending_io_work.sync_complete(event_loop)
             _drain_background_storage(storage, event_loop)
@@ -263,13 +273,15 @@ class Snapshot:
         record_digests: bool = False,
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
+        device_digests: Optional[bool] = None,
     ) -> "PendingSnapshot":
         """Non-blocking take. Returns once *staging* (DtoH copy + serialize)
         completes — after that, mutations to the app state do not affect the
         snapshot. Storage I/O and the metadata commit continue on a
         background thread; call ``.wait()`` on the returned handle
         (reference: snapshot.py:245-313). ``incremental_base`` /
-        ``record_digests`` / ``save_dtype`` as in :meth:`take`."""
+        ``record_digests`` / ``save_dtype`` / ``device_digests`` as in
+        :meth:`take`."""
         cls._validate_app_state(app_state)
         cls._validate_save_dtype(save_dtype)
         event_loop = asyncio.new_event_loop()
@@ -292,6 +304,7 @@ class Snapshot:
             storage_options=storage_options,
             compression=compression,
             save_dtype=save_dtype,
+            device_digests=device_digests,
         )
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -320,6 +333,7 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
+        device_digests: Optional[bool] = None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         timer = timer or _PhaseTimer("Snapshot.take")  # unlogged unless the caller logs
         rank = pg_wrapper.get_rank()
@@ -328,6 +342,10 @@ class Snapshot:
 
         from .compression import compression_staging, env_codec, resolve_codec
         from .dedup import DedupContext, canonical_base_url, dedup_staging
+        from .device_digest import enabled_by_env as device_digests_env
+
+        if device_digests is None:
+            device_digests = device_digests_env()
 
         # Validate the codec spec before any I/O happens; the explicit
         # argument wins over TORCHSNAPSHOT_TPU_COMPRESSION.
@@ -367,7 +385,9 @@ class Snapshot:
                 incremental_base,
                 storage_options=strip_mirror_options(storage_options),
             ).metadata
-            dedup_ctx = DedupContext.from_base(incremental_base, base_meta)
+            dedup_ctx = DedupContext.from_base(
+                incremental_base, base_meta, device_digests=device_digests
+            )
             if not dedup_ctx.refs:
                 logger.warning(
                     "incremental_base %s has no content digests (take it with "
@@ -385,8 +405,11 @@ class Snapshot:
                 # (the natural rebase after losing a primary), wrapping it
                 # with itself as fallback would be a pointless double open.
                 origin_mirrors[incremental_base] = base_meta.mirror_url
-        elif record_digests:
-            dedup_ctx = DedupContext.recording_only()
+        elif record_digests or device_digests:
+            # device_digests alone still needs a recording context: the
+            # fingerprints must land in THIS snapshot's manifest for the
+            # next take to match against.
+            dedup_ctx = DedupContext.recording_only(device_digests=device_digests)
 
         # RNG invariant (reference: snapshot.py:329-373): RNG state is
         # captured at entry and re-applied after take, so the snapshot
