@@ -23,8 +23,9 @@ When the probe sees a live TPU — even one whose tunneled DtoH bandwidth
 is below the floor that moves the main leg onto the cpu backend — a
 bounded hardware side-leg (benchmarks/dma_overlap.py) runs first and its
 summary is embedded under the JSON's "tpu_hw" key: DMA overlap ratio,
-train-step inflation under an in-flight async_take, and an on-chip
-sync-take with bit-exact restore.
+train-step inflation under an in-flight async_take, an on-chip sync-take
+with bit-exact restore, and (when benchmarks/device_dedup.py also lands)
+the device-resident change-detection resave speedup.
 """
 
 from __future__ import annotations
@@ -144,17 +145,36 @@ def _probe_backend() -> "tuple[str, bool]":
     return "cpu", False
 
 
+def _json_records(stdout: str) -> "dict[str, dict]":
+    """Parse a subprocess's stdout into {benchmark_name: record} from its
+    one-JSON-object-per-line output, skipping banners/noise."""
+    legs = {}
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            legs[rec.get("benchmark", "?")] = rec
+    return legs
+
+
 def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     """Run benchmarks/dma_overlap.py against the reachable chip.
 
     Returns ``(summary, killed)``: a compact summary of the hardware legs
     (DMA overlap ratio, train-step inflation under an in-flight
-    async_take, on-chip sync-take throughput + bit-exactness) for
-    embedding in the main JSON line, or None if the side-leg
-    fails/times out. ``killed`` is True when the subprocess was killed at
-    the timeout — killing a TPU client mid-operation can wedge the device
-    relay, so the caller must NOT then initialize the TPU backend
-    in-process (no timeout there); it falls back to cpu instead. The
+    async_take, on-chip sync-take throughput + bit-exactness, and — when
+    the optional device-dedup leg lands — its resave speedup) for
+    embedding in the main JSON line, or None if the PRIMARY
+    (dma_overlap) leg fails/times out; the optional second leg failing
+    leaves the primary summary intact, so ``killed=True`` can coexist
+    with a populated summary. ``killed`` is True when either subprocess
+    was killed at its timeout — killing a TPU client mid-operation can
+    wedge the device relay, so the caller must NOT then initialize the
+    TPU backend in-process (no timeout there); it falls back to cpu
+    instead. The
     relay-bound absolute MB/s measures the tunnel, but the RATIOS are the
     design claims (see BENCHMARKS.md "DMA-staging overlap").
     """
@@ -176,15 +196,7 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     if r.returncode != 0:
         _log(f"TPU side-leg rc={r.returncode} stderr={r.stderr.strip()[-300:]!r}")
         return None, False
-    legs = {}
-    for line in r.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            legs[rec.get("benchmark", "?")] = rec
+    legs = _json_records(r.stdout)
     stage = legs.get("dma_overlap/stage")
     take = legs.get("dma_overlap/async_take")
     sync = legs.get("dma_overlap/sync_take")
@@ -197,6 +209,28 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
         "sync_take_mbps": sync["take_mbps"],
         "sync_take_bit_exact": sync["bit_exact"],
     }
+    # Second side-leg: device-resident change detection (benchmarks/
+    # device_dedup.py) — unchanged-resave speedup from skipping DtoH.
+    # Optional: its absence never discards the DMA numbers above.
+    script2 = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "device_dedup.py"
+    )
+    try:
+        r2 = subprocess.run(
+            [sys.executable, script2],
+            timeout=deadline,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        _log("device-dedup side-leg timed out (killed)")
+        return out, True
+    if r2.returncode == 0:
+        rec = _json_records(r2.stdout).get("device_dedup/unchanged_resave")
+        if rec is not None:
+            out["device_dedup_speedup"] = rec["speedup"]
+    else:
+        _log(f"device-dedup side-leg rc={r2.returncode}")
     _log(f"TPU hardware side-leg ok: {out}")
     return out, False
 

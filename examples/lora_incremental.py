@@ -91,11 +91,15 @@ def main() -> None:
         if (step + 1) % 10 == 0:
             path = os.path.join(work, f"step_{step + 1}")
             base = ckpts[-1] if ckpts else None
+            # device_digests: the frozen backbone is detected unchanged ON
+            # DEVICE, so on TPU it never even crosses to the host — the
+            # dominant save cost for this workload (see device_digest.py).
             Snapshot.take(
                 path,
                 app_state(step + 1),
                 incremental_base=base,
                 record_digests=True,
+                device_digests=True,
             )
             ckpts.append(path)
             kind = f"incremental on {os.path.basename(base)}" if base else "full"
