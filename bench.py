@@ -183,6 +183,7 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     )
     deadline = int(os.environ.get("BENCH_TPU_LEG_TIMEOUT_S", "420"))
     _log(f"running TPU hardware side-leg ({deadline}s budget) ...")
+    t_begin = time.monotonic()
     try:
         r = subprocess.run(
             [sys.executable, script],
@@ -215,10 +216,13 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     script2 = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks", "device_dedup.py"
     )
+    # Both side-legs share the announced budget: the second gets what the
+    # first left over (min 60 s), never a fresh full deadline.
+    remaining = max(60, int(deadline - (time.monotonic() - t_begin)))
     try:
         r2 = subprocess.run(
             [sys.executable, script2],
-            timeout=deadline,
+            timeout=remaining,
             capture_output=True,
             text=True,
         )

@@ -12,9 +12,16 @@ steady state of a training loop saving every N steps):
   detection, best of ``trials``. The speedup scales with state size:
   the host path is DtoH-bandwidth-bound, the device path is one pass at
   HBM bandwidth plus fixed relay roundtrips.
+- ``device_dedup/chain_reload_restore``: the serving-reload story — a
+  process holding step N's state restores step N+1 (incremental on N,
+  one small payload changed). Plain restore re-reads + re-transfers
+  everything; ``restore(..., device_digests=True)`` fingerprints the
+  destination and reads only the changed payload. Timed through
+  ``block_until_ready`` on the destination (device_put is async; an
+  un-drained plain restore looks artificially instant).
 
 Usage: python benchmarks/device_dedup.py [state_mb] [trials]
-Emits one JSON line; exits 2 (no JSON) off-TPU.
+Emits one JSON line per leg; exits 2 (no JSON) off-TPU.
 """
 
 from __future__ import annotations
@@ -94,6 +101,68 @@ def main() -> int:
                 "host_dedup_s": round(t_host, 3),
                 "device_dedup_s": round(t_dev, 3),
                 "speedup": round(t_host / max(t_dev, 1e-9), 1),
+                "platform": "tpu",
+            },
+        )
+
+        # ---- restore side: reload step N+1 while holding step N -------
+        # The skip trades ~one relay roundtrip per array (fingerprint
+        # dispatch + 16-byte fetch) against the payload's read + HtoD.
+        # Through this tunnel the roundtrip is ~70 ms, so the leg uses a
+        # 3x state to sit clearly past breakeven; on non-tunneled links
+        # (RTT ~0.1 ms, HtoD GB/s) breakeven is ~1 MB per array.
+        def fresh_big(seed):
+            k = jax.random.PRNGKey(seed)
+            s = StateDict(
+                w=jax.random.normal(k, (3 * n,), jnp.bfloat16),
+                b=jax.random.normal(jax.random.fold_in(k, 1), (3 * n,), jnp.bfloat16),
+            )
+            jax.block_until_ready(list(s.values()))
+            return s
+
+        st = fresh_big(0)
+        restore_nbytes = sum(v.nbytes for v in st.values())
+        adapter = jax.random.normal(jax.random.PRNGKey(7), (64, 64), jnp.float32)
+        s0, s1 = os.path.join(tmp, "r0"), os.path.join(tmp, "r1")
+        Snapshot.take(
+            s0, {"m": StateDict(**st, a=adapter)}, device_digests=True
+        )
+        Snapshot.take(
+            s1,
+            {"m": StateDict(**{k: v + 0 for k, v in st.items()}, a=adapter * 2)},
+            incremental_base=s0,
+            device_digests=True,
+        )
+        restore_legs = {}
+        # plain leg pins device_digests=False for the same reason as the
+        # take-side host leg: the env opt-in must not contaminate the
+        # control.
+        for name, kw in (
+            ("plain", {"device_digests": False}),
+            ("digest", {"device_digests": True}),
+        ):
+            times = []
+            for trial in range(trials + 1):
+                dst = {
+                    "m": StateDict(
+                        **{k: v + 0 for k, v in st.items()}, a=adapter + 0
+                    )
+                }
+                jax.block_until_ready(list(dst["m"].values()))
+                t0 = time.perf_counter()
+                Snapshot(s1).restore(dst, **kw)
+                jax.block_until_ready(list(dst["m"].values()))
+                times.append(time.perf_counter() - t0)
+            restore_legs[name] = min(times[1:])
+        report(
+            "device_dedup/chain_reload_restore",
+            {
+                "state_mb": round(restore_nbytes / 1e6, 1),
+                "plain_restore_s": round(restore_legs["plain"], 3),
+                "digest_restore_s": round(restore_legs["digest"], 3),
+                "speedup": round(
+                    restore_legs["plain"] / max(restore_legs["digest"], 1e-9), 1
+                ),
                 "platform": "tpu",
             },
         )

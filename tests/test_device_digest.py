@@ -340,3 +340,135 @@ def test_checkpoint_manager_plumbs_device_digests(tmp_path, staging_spy):
     dst = {"m": StateDict(w=jnp.zeros_like(w))}
     Snapshot(mgr.path_for(1)).restore(dst)
     np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+# ------------------------------------------------- restore-side skip
+
+
+@pytest.fixture
+def consume_spy(monkeypatch):
+    """Records every payload consume on the restore path (dense + sharded):
+    a fingerprint-skipped restore consumes nothing."""
+    consumed = []
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+    from torchsnapshot_tpu.io_preparers.sharded import _ShardScatterConsumer
+
+    for klass in (ArrayBufferConsumer, _ShardScatterConsumer):
+        orig = klass._consume_sync
+
+        def spy(self, buf, _orig=orig):
+            consumed.append(type(self).__name__)
+            return _orig(self, buf)
+
+        monkeypatch.setattr(klass, "_consume_sync", spy)
+    return consumed
+
+
+def test_restore_skips_matching_destination(tmp_path, consume_spy):
+    w = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+    b = jnp.ones((128,), jnp.bfloat16)
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(w=w, b=b)}, device_digests=True)
+
+    # Destination already holds the content (fresh buffers, same values).
+    dst = {"m": StateDict(w=w + 0, b=b + 0)}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "snap")).restore(dst, device_digests=True)
+    assert consume_spy == []
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+    # A stale destination still gets corrected.
+    dst2 = {"m": StateDict(w=w.at[0, 0].add(7.0), b=b + 0)}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "snap")).restore(dst2, device_digests=True)
+    assert len(consume_spy) == 1  # only w re-read
+    np.testing.assert_array_equal(np.asarray(dst2["m"]["w"]), np.asarray(w))
+
+
+def test_restore_skip_requires_dtype_match(tmp_path, consume_spy):
+    """A dtype-differing destination must NOT skip: restore casts, so the
+    destination's bytes are not the snapshot's bytes."""
+    w = jnp.arange(256, dtype=jnp.bfloat16)
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(w=w)}, device_digests=True)
+    dst = {"m": StateDict(w=jnp.zeros(256, jnp.float32))}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "snap")).restore(dst, device_digests=True)
+    assert len(consume_spy) == 1
+    np.testing.assert_array_equal(
+        np.asarray(dst["m"]["w"]), np.asarray(w.astype(jnp.float32))
+    )
+
+
+def test_restore_skip_off_by_default(tmp_path, consume_spy):
+    w = jnp.arange(256, dtype=jnp.float32)
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(w=w)}, device_digests=True)
+    dst = {"m": StateDict(w=w + 0)}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "snap")).restore(dst)
+    assert len(consume_spy) == 1  # no skip without the opt-in
+
+
+def test_restore_skip_sharded(tmp_path, consume_spy):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+    sharding = NamedSharding(mesh, PartitionSpec("x", "y"))
+    w = jax.device_put(
+        jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64), sharding
+    )
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(w=w)}, device_digests=True)
+
+    # Same values on a DIFFERENT sharding: global-slice fingerprints still
+    # verify, so the restore keeps the destination (and its sharding).
+    sharding2 = NamedSharding(mesh, PartitionSpec("y", "x"))
+    dst = {"m": StateDict(w=jax.device_put(w + 0, sharding2))}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "snap")).restore(dst, device_digests=True)
+    assert consume_spy == []
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+    assert dst["m"]["w"].sharding.is_equivalent_to(sharding2, 2)
+
+    # One stale element anywhere forces a normal sharded read.
+    dst2 = {"m": StateDict(w=jax.device_put(w.at[10, 10].add(1.0), sharding))}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "snap")).restore(dst2, device_digests=True)
+    assert len(consume_spy) > 0
+    np.testing.assert_array_equal(np.asarray(dst2["m"]["w"]), np.asarray(w))
+
+
+def test_restore_skip_incremental_chain_reload(tmp_path, consume_spy):
+    """The serving-reload story: a process holding step N's state restores
+    step N+1 (incremental on N) — only the changed payload is read."""
+    w = jnp.arange(2048, dtype=jnp.float32)  # frozen
+    a = jnp.ones(64, jnp.float32)  # trainable
+    Snapshot.take(
+        str(tmp_path / "s0"), {"m": StateDict(w=w, a=a)}, device_digests=True
+    )
+    a1 = a * 2.0
+    Snapshot.take(
+        str(tmp_path / "s1"),
+        {"m": StateDict(w=w + 0, a=a1)},
+        incremental_base=str(tmp_path / "s0"),
+        device_digests=True,
+    )
+    # A process still holding step 0's state reloads step 1.
+    dst = {"m": StateDict(w=w + 0, a=a + 0)}
+    consume_spy.clear()
+    Snapshot(str(tmp_path / "s1")).restore(dst, device_digests=True)
+    assert len(consume_spy) == 1  # only the adapter
+    np.testing.assert_array_equal(np.asarray(dst["m"]["a"]), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+def test_async_restore_device_digests(tmp_path, consume_spy):
+    w = jnp.arange(512, dtype=jnp.float32)
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(w=w)}, device_digests=True)
+    dst = {"m": StateDict(w=w + 0)}
+    consume_spy.clear()
+    pending = Snapshot(str(tmp_path / "snap")).async_restore(
+        dst, device_digests=True
+    )
+    pending.wait()
+    assert consume_spy == []
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))

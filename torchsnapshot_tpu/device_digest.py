@@ -122,14 +122,10 @@ def _as_uint32_words(arr):
     raise TypeError(f"no uint32 bitcast for dtype {arr.dtype}")
 
 
-def device_fingerprint(arr) -> Optional[str]:
-    """128-bit fingerprint of a (fully addressable) jax array's content,
-    computed on device; only 16 bytes cross to the host.
-
-    Returns ``"xxh4x32:<32 hex>"``, or None when the array cannot be
-    fingerprinted on device (unsupported dtype, non-addressable shards) —
-    callers fall back to the host SHA-256 path.
-    """
+def _dispatch(arr):
+    """Kick the fingerprint computation for ``arr`` without blocking.
+    Returns the in-flight device lanes array, or None if ``arr`` cannot
+    be fingerprinted on device."""
     import jax
 
     if not isinstance(arr, jax.Array):
@@ -137,13 +133,19 @@ def device_fingerprint(arr) -> Optional[str]:
     if not getattr(arr, "is_fully_addressable", False):
         return None
     try:
-        words = _as_uint32_words(arr)
-        lanes = np.asarray(jax.device_get(_get_jitted()(words)), dtype=np.uint32)
+        return _get_jitted()(_as_uint32_words(arr))
     except (TypeError, ValueError):
         # TypeError: our own rejection (no clean bitcast). ValueError: jax's
         # bitcast shape rule rejecting sub-byte packings (int4/uint4 report
         # itemsize 1 but cannot widen elementwise to uint8).
         return None
+
+
+def _finalize(arr, pending) -> str:
+    """Fetch a dispatched computation's 16 bytes and fold in the length."""
+    import jax
+
+    lanes = np.asarray(jax.device_get(pending), dtype=np.uint32)
     # Fold the byte length in on the host (it is static per shape): equal
     # word streams of different underlying sizes stay distinct.
     nbytes = int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape, dtype=np.int64))
@@ -153,3 +155,29 @@ def device_fingerprint(arr) -> Optional[str]:
             for lane, seed in zip(lanes, _SEEDS)
         ]
     return PREFIX + ":" + "".join(f"{int(v):08x}" for v in final)
+
+
+def device_fingerprint(arr) -> Optional[str]:
+    """128-bit fingerprint of a (fully addressable) jax array's content,
+    computed on device; only 16 bytes cross to the host.
+
+    Returns ``"xxh4x32:<32 hex>"``, or None when the array cannot be
+    fingerprinted on device (unsupported dtype, non-addressable shards) —
+    callers fall back to the host SHA-256 path.
+    """
+    pending = _dispatch(arr)
+    if pending is None:
+        return None
+    return _finalize(arr, pending)
+
+
+def device_fingerprints(arrs) -> "list[Optional[str]]":
+    """Fingerprint many arrays with overlapped dispatch: all jit calls are
+    kicked before the first result is fetched, so N fingerprints cost ~one
+    host<->device roundtrip instead of N serial ones (the roundtrip, not
+    the hash, dominates for small/medium arrays on tunneled links)."""
+    pendings = [_dispatch(a) for a in arrs]
+    return [
+        _finalize(a, p) if p is not None else None
+        for a, p in zip(arrs, pendings)
+    ]

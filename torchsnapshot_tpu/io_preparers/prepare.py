@@ -102,11 +102,54 @@ def check_restore_cast(entry_dtype: str, dst_dtype: Any, what: str) -> bool:
     return True
 
 
+def _dst_already_matches(entry: Entry, obj_out: Any) -> bool:
+    """True when a jax destination already holds exactly the content the
+    entry describes, proven by on-device fingerprints (device_digest.py):
+    the read and the HtoD transfer can be skipped and the destination
+    kept. Conservative on every edge: any missing fingerprint, dtype or
+    shape difference, or unfingerprintable destination means False.
+    """
+    from ..device_digest import device_fingerprint, device_fingerprints
+    from .array import dtype_to_string
+
+    if isinstance(entry, ArrayEntry):
+        if entry.device_digest is None or entry.byte_range is not None:
+            return False
+        if list(obj_out.shape) != list(entry.shape):
+            return False
+        if dtype_to_string(obj_out.dtype) != entry.dtype:
+            return False
+        return device_fingerprint(obj_out) == entry.device_digest
+    if isinstance(entry, ChunkedArrayEntry):
+        # All chunks must match: the jax read path materializes the whole
+        # host array before one device_put, so a partial skip has nothing
+        # to splice into. (Per-piece skips exist on the sharded path,
+        # where reads scatter independently.)
+        if list(obj_out.shape) != list(entry.shape):
+            return False
+        if dtype_to_string(obj_out.dtype) != entry.dtype:
+            return False
+        if any(c.array.device_digest is None for c in entry.chunks):
+            return False
+        # Batched: all chunk fingerprints dispatch before the first fetch
+        # — one roundtrip of latency, not one per chunk.
+        slices = [
+            obj_out[tuple(slice(o, o + s) for o, s in zip(c.offsets, c.sizes))]
+            for c in entry.chunks
+        ]
+        fps = device_fingerprints(slices)
+        return all(
+            fp == c.array.device_digest for fp, c in zip(fps, entry.chunks)
+        )
+    return False
+
+
 def prepare_read(
     entry: Entry,
     obj_out: Any = None,
     callback: Optional[Callable[[Any], None]] = None,
     buffer_size_limit_bytes: Optional[int] = None,
+    device_digests: bool = False,
 ) -> List[ReadReq]:
     """Plan reads for ``entry`` into/for ``obj_out``.
 
@@ -119,10 +162,23 @@ def prepare_read(
     A destination whose dtype differs from the snapshot's is cast to the
     destination's dtype (``same_kind`` only — see ``check_restore_cast``).
 
+    ``device_digests``: jax destinations already holding an entry's exact
+    content (fingerprinted on device against the entry's recorded
+    fingerprint) plan NO reads and keep their current array — the
+    restore-side mirror of the take-side DtoH skip.
+
     PrimitiveEntry requires no I/O and must be handled by the caller
     (reference: io_preparer.py:888-890).
     """
     if isinstance(entry, PrimitiveEntry):
+        return []
+
+    if (
+        device_digests
+        and is_jax_array(obj_out)
+        and getattr(obj_out, "is_fully_addressable", False)
+        and _dst_already_matches(entry, obj_out)
+    ):
         return []
 
     if isinstance(entry, ObjectEntry):
@@ -135,7 +191,7 @@ def prepare_read(
         from .sharded import ShardedArrayIOPreparer
 
         return ShardedArrayIOPreparer.prepare_read(
-            entry, obj_out, callback=callback
+            entry, obj_out, callback=callback, device_digests=device_digests
         )
 
     if not isinstance(entry, (ArrayEntry, ChunkedArrayEntry)):

@@ -579,7 +579,9 @@ class Snapshot:
 
     # --------------------------------------------------------------- restore
 
-    def restore(self, app_state: AppState) -> None:
+    def restore(
+        self, app_state: AppState, device_digests: Optional[bool] = None
+    ) -> None:
         """Restore the app state in place. Arrays are restored into the
         shapes/dtypes/shardings of the *current* state (memory-efficient and
         sharding-aware; reference rationale: snapshot.py:693-700).
@@ -590,11 +592,23 @@ class Snapshot:
         reference's ``dst.copy_(src)``, io_preparer.py:426-427). For jax
         destinations the cast runs on device AFTER the transfer, so the
         host->device wire carries the checkpoint's (often narrower) bytes.
+
+        ``device_digests`` (default: the ``TORCHSNAPSHOT_TPU_DEVICE_DIGESTS``
+        env var): device destinations that ALREADY hold a payload's content
+        — fingerprinted on device against the snapshot's recorded
+        fingerprint (device_digest.py) — skip the storage read and the
+        HtoD transfer and keep their current array. Wins whenever a
+        process re-restores mostly-unchanged state: reloading the next
+        snapshot of an incremental chain, retrying a partial restore.
         """
         self._validate_app_state(app_state)
-        self._restore_impl(app_state, PGWrapper(self.pg))
+        self._restore_impl(
+            app_state, PGWrapper(self.pg), device_digests=device_digests
+        )
 
-    def async_restore(self, app_state: AppState) -> "PendingRestore":
+    def async_restore(
+        self, app_state: AppState, device_digests: Optional[bool] = None
+    ) -> "PendingRestore":
         """Restore on a background thread; returns a handle immediately.
 
         Lets a resuming program overlap the restore (storage reads, HtoD
@@ -615,9 +629,20 @@ class Snapshot:
         # thread's collectives can never desynchronize against other
         # wrappers created later on the main thread.
         pg_wrapper.barrier()
-        return PendingRestore(self, app_state, pg_wrapper)
+        return PendingRestore(
+            self, app_state, pg_wrapper, device_digests=device_digests
+        )
 
-    def _restore_impl(self, app_state: AppState, pg_wrapper: PGWrapper) -> None:
+    def _restore_impl(
+        self,
+        app_state: AppState,
+        pg_wrapper: PGWrapper,
+        device_digests: Optional[bool] = None,
+    ) -> None:
+        if device_digests is None:
+            from .device_digest import enabled_by_env
+
+            device_digests = enabled_by_env()
         event_loop = asyncio.new_event_loop()
         rank = pg_wrapper.get_rank()
         storage = url_to_storage_plugin_in_event_loop(
@@ -667,6 +692,7 @@ class Snapshot:
                             storage=storage,
                             event_loop=event_loop,
                             memory_budget=memory_budget,
+                            device_digests=device_digests,
                         )
                     except BaseException as e:  # noqa: B036
                         if exc is None:
@@ -694,6 +720,7 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         memory_budget: int,
+        device_digests: bool = False,
     ) -> None:
         state_dict = stateful.state_dict()
         _, flattened = flatten(state_dict, prefix=key)
@@ -725,7 +752,11 @@ class Snapshot:
             def _cb(value: Any, lp: str = logical_path) -> None:
                 flattened[lp] = value
 
-            read_reqs.extend(prepare_read(entry, obj_out=obj, callback=_cb))
+            read_reqs.extend(
+                prepare_read(
+                    entry, obj_out=obj, callback=_cb, device_digests=device_digests
+                )
+            )
 
         self._execute_read_reqs_grouped(
             read_reqs, storage, memory_budget, rank, event_loop,
@@ -1439,14 +1470,20 @@ class PendingRestore:
     """
 
     def __init__(
-        self, snapshot: Snapshot, app_state: AppState, pg_wrapper: PGWrapper
+        self,
+        snapshot: Snapshot,
+        app_state: AppState,
+        pg_wrapper: PGWrapper,
+        device_digests: Optional[bool] = None,
     ) -> None:
         self._exc: Optional[BaseException] = None
         self._done_event = threading.Event()
 
         def run() -> None:
             try:
-                snapshot._restore_impl(app_state, pg_wrapper)
+                snapshot._restore_impl(
+                    app_state, pg_wrapper, device_digests=device_digests
+                )
             except BaseException as e:  # noqa: B036
                 self._exc = e
             finally:
