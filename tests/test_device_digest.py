@@ -472,3 +472,41 @@ def test_async_restore_device_digests(tmp_path, consume_spy):
     pending.wait()
     assert consume_spy == []
     np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+def test_checkpoint_manager_restore_device_digests(tmp_path, consume_spy):
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    w = jnp.arange(512, dtype=jnp.float32)
+    mgr = CheckpointManager(
+        str(tmp_path / "ckpts"), incremental=True, device_digests=True
+    )
+    mgr.save(0, {"m": StateDict(w=w)})
+    dst = {"m": StateDict(w=w + 0)}
+    consume_spy.clear()
+    mgr.restore(dst)
+    assert consume_spy == []
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+def test_manager_warmup_compiles_fingerprints(tmp_path, monkeypatch):
+    """warmup() with device_digests pre-dispatches the fingerprint jit for
+    every array shape, so the first save pays no fingerprint compiles."""
+    from torchsnapshot_tpu import device_digest
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    dispatched = []
+    orig = device_digest._dispatch
+
+    def spy(arr):
+        dispatched.append(tuple(arr.shape))
+        return orig(arr)
+
+    monkeypatch.setattr(device_digest, "_dispatch", spy)
+    w = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+    b = jnp.ones((128,), jnp.bfloat16)
+    mgr = CheckpointManager(
+        str(tmp_path / "ckpts"), incremental=True, device_digests=True
+    )
+    mgr.warmup({"m": StateDict(w=w, b=b)})
+    assert (64, 64) in dispatched and (128,) in dispatched

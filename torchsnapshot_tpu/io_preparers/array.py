@@ -34,6 +34,7 @@ from ..serialization import (
     array_from_buffer,
     array_size_bytes,
     dtype_to_string,
+    string_to_dtype,
 )
 
 
@@ -223,6 +224,88 @@ def needs_consistency_copy(arr) -> bool:
     return True
 
 
+def iter_staged_pieces(app_state, pg=None, replicated=None, save_dtype=None):
+    """Yield ``(shape, dtype_str, needs_copy)`` for every piece THIS
+    process will stage for ``app_state`` — the single source of the
+    write-partition geometry, shared by the staging-pool warmup (byte
+    sizes, pieces with ``needs_copy`` only) and CheckpointManager's
+    fingerprint warmup (shapes + dtypes, all pieces).
+
+    ``save_dtype`` is applied: pieces are reported at the CONVERTED
+    dtype, and chunk/subdivision boundaries are recomputed at its
+    itemsize, so consumers warm exactly what the real save stages. Under
+    a multi-rank ``pg``, replicated dense chunks stripe ``[rank::world]``
+    like the write partition; everything else is fully local.
+    """
+    import fnmatch
+
+    from ..flatten import flatten
+    from ..snapshot import _is_process_replicated_jax_array
+    from . import chunked
+    from .prepare import is_sharded_jax_array
+    from .sharded import ShardedArrayIOPreparer
+
+    if pg is not None:
+        from ..pg_wrapper import PGWrapper
+
+        wrapper = PGWrapper(pg)
+        world, rank = wrapper.get_world_size(), wrapper.get_rank()
+    else:
+        world, rank = 1, 0
+    globs = list(replicated or [])
+
+    def _eff_dtype(logical_path: str, leaf) -> str:
+        """Dtype the WRITE PLAN will stage: ``save_dtype`` downcasts
+        matching leaves before staging. The decision is shared with the
+        take-time converter (serialization.effective_save_dtype) so the
+        two can never diverge."""
+        src = dtype_to_string(leaf.dtype)
+        if not save_dtype:
+            return src
+        from ..serialization import effective_save_dtype
+
+        target = effective_save_dtype(logical_path, leaf.dtype, save_dtype)
+        return dtype_to_string(target) if target is not None else src
+
+    for key, stateful in app_state.items():
+        state_dict = getattr(stateful, "state_dict", None)
+        if state_dict is None:
+            continue
+        _, flattened = flatten(state_dict(), prefix=key)
+        for logical_path, leaf in flattened.items():
+            if is_sharded_jax_array(leaf):
+                eff = _eff_dtype(logical_path, leaf)
+                # Subdivision boundaries depend on itemsize, so piece
+                # sizes are computed at the converted dtype.
+                itemsize = string_to_dtype(eff).itemsize
+                needs = needs_consistency_copy(leaf)
+                for p_off, p_sz, _ in ShardedArrayIOPreparer._owned_pieces(
+                    leaf, itemsize=itemsize
+                ):
+                    yield tuple(p_sz), eff, needs
+            elif _is_jax_array(leaf) or isinstance(leaf, np.ndarray):
+                needs = needs_consistency_copy(leaf)
+                # Only REPLICATED paths stripe across ranks in the write
+                # partition; per-rank arrays are fully staged locally.
+                is_repl = world > 1 and (
+                    any(fnmatch.fnmatch(logical_path, g) for g in globs)
+                    or _is_process_replicated_jax_array(leaf)
+                )
+                eff = _eff_dtype(logical_path, leaf)
+                nbytes = array_size_bytes(leaf.shape, eff)
+                if nbytes > chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES and leaf.shape:
+                    ranges = chunked.ChunkedArrayIOPreparer.chunk_ranges(
+                        leaf.shape, eff
+                    )
+                    if is_repl:
+                        ranges = ranges[rank::world]
+                    rest = tuple(leaf.shape[1:])
+                    for lo, hi in ranges:
+                        yield (hi - lo, *rest), eff, needs
+                else:
+                    yield tuple(leaf.shape), eff, needs
+
+
 def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
     """Pre-fault the staging pool for ``app_state`` so the FIRST
     ``async_take`` blocks like a warm one.
@@ -254,84 +337,24 @@ def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
     approximation of the deterministic partition; under-warming just
     faults the difference on first use). Device arrays whose staging
     needs no consistency copy (TPU-backed: DtoH already produces
-    host-owned memory) are skipped."""
-    import fnmatch
+    host-owned memory) are skipped.
 
+    Geometry comes from ``iter_staged_pieces`` — the shared write-
+    partition walk — so warmed sizes can never drift from what the real
+    save stages."""
     from .._native import native_available
-    from ..flatten import flatten
     from ..integrity import checksums_enabled
-    from ..snapshot import _is_process_replicated_jax_array
-    from . import chunked
-    from .prepare import is_sharded_jax_array
-    from .sharded import ShardedArrayIOPreparer
 
     if not _BUFFER_PROTOCOL_OK or not native_available() or not checksums_enabled():
         return 0
 
-    if pg is not None:
-        from ..pg_wrapper import PGWrapper
-
-        wrapper = PGWrapper(pg)
-        world, rank = wrapper.get_world_size(), wrapper.get_rank()
-    else:
-        world, rank = 1, 0
-    globs = list(replicated or [])
-
-    def _eff_dtype(logical_path: str, leaf) -> str:
-        """Dtype the WRITE PLAN will stage: ``save_dtype`` downcasts
-        matching leaves before staging, so slabs must be warmed at the
-        converted (usually half) size or the pool's exact-size free lists
-        never serve the real save. The decision is shared with the
-        take-time converter (serialization.effective_save_dtype) so the
-        two can never diverge."""
-        src = dtype_to_string(leaf.dtype)
-        if not save_dtype:
-            return src
-        from ..serialization import effective_save_dtype
-
-        target = effective_save_dtype(logical_path, leaf.dtype, save_dtype)
-        return dtype_to_string(target) if target is not None else src
-
-    sizes: List[int] = []
-    for key, stateful in app_state.items():
-        state_dict = getattr(stateful, "state_dict", None)
-        if state_dict is None:
-            continue
-        _, flattened = flatten(state_dict(), prefix=key)
-        for logical_path, leaf in flattened.items():
-            if is_sharded_jax_array(leaf):
-                if needs_consistency_copy(leaf):
-                    # Subdivision boundaries depend on itemsize, so piece
-                    # sizes must be RECOMPUTED at the converted dtype —
-                    # scaling the original byte sizes would warm a
-                    # different piece multiset than the real save draws.
-                    sizes.extend(
-                        ShardedArrayIOPreparer.staged_piece_sizes(
-                            leaf, dtype=_eff_dtype(logical_path, leaf)
-                        )
-                    )
-            elif _is_jax_array(leaf) or isinstance(leaf, np.ndarray):
-                if not needs_consistency_copy(leaf):
-                    continue
-                # Only REPLICATED paths stripe across ranks in the write
-                # partition; per-rank arrays are fully staged locally.
-                is_repl = world > 1 and (
-                    any(fnmatch.fnmatch(logical_path, g) for g in globs)
-                    or _is_process_replicated_jax_array(leaf)
-                )
-                eff = _eff_dtype(logical_path, leaf)
-                nbytes = array_size_bytes(leaf.shape, eff)
-                if nbytes > chunked.DEFAULT_MAX_CHUNK_SIZE_BYTES and leaf.shape:
-                    row = nbytes // max(leaf.shape[0], 1)
-                    ranges = chunked.ChunkedArrayIOPreparer.chunk_ranges(
-                        leaf.shape, eff
-                    )
-                    if is_repl:
-                        ranges = ranges[rank::world]
-                    for lo, hi in ranges:
-                        sizes.append((hi - lo) * row)
-                else:
-                    sizes.append(nbytes)
+    sizes: List[int] = [
+        array_size_bytes(shape, dt)
+        for shape, dt, needs_copy in iter_staged_pieces(
+            app_state, pg=pg, replicated=replicated, save_dtype=save_dtype
+        )
+        if needs_copy
+    ]
     return _staging_pool.prewarm(sizes)
 
 

@@ -173,10 +173,19 @@ class CheckpointManager:
         state; cheap to call again after shapes change. Returns bytes
         newly faulted.
 
-        No-op under ``incremental`` or ``compression``: those staging
-        paths (dedup digesting, codec compression) never draw from the
-        pool, so warming it would pin memory no save uses."""
-        if self.incremental or self.compression:
+        Under ``device_digests``, also pre-compiles the on-device
+        fingerprint jits for every array shape in the state — the first
+        digest-enabled save otherwise pays one XLA compile per distinct
+        shape inside its blocking window.
+
+        Pool pre-faulting is a no-op under ``incremental``,
+        ``compression``, or ``device_digests``: those staging paths
+        (dedup digesting, codec compression, fingerprint recording) never
+        draw from the pool, so warming it would pin memory no save
+        uses."""
+        if self._device_digests_effective():
+            self._warmup_fingerprints(app_state)
+        if self.incremental or self.compression or self._device_digests_effective():
             return 0
         from .io_preparers.array import warmup_staging
 
@@ -186,6 +195,42 @@ class CheckpointManager:
             replicated=self.replicated,
             save_dtype=self.save_dtype,
         )
+
+    def _device_digests_effective(self) -> bool:
+        """The flag the SAVE path will resolve: the explicit option, else
+        the TORCHSNAPSHOT_TPU_DEVICE_DIGESTS env fallback (matching
+        Snapshot._take_impl)."""
+        if self.device_digests is not None:
+            return bool(self.device_digests)
+        from .device_digest import enabled_by_env
+
+        return enabled_by_env()
+
+    def _warmup_fingerprints(self, app_state: AppState) -> None:
+        """Compile fingerprint jits for every piece shape/dtype the save
+        will hash (dispatch on zero dummies; results discarded) — the
+        first digest-enabled save otherwise pays one XLA compile per
+        distinct shape inside its blocking window. Geometry comes from
+        ``iter_staged_pieces`` (the shared write-partition walk), so
+        save_dtype conversion, chunk boundaries, sharded owned-piece
+        subdivision, and replicated striping all match the real save."""
+        import jax.numpy as jnp
+
+        from .device_digest import _dispatch
+        from .io_preparers.array import iter_staged_pieces
+        from .serialization import string_to_dtype
+
+        seen = set()
+        for shape, dtype_str, _ in iter_staged_pieces(
+            app_state,
+            pg=self.pg,
+            replicated=self.replicated,
+            save_dtype=self.save_dtype,
+        ):
+            if (shape, dtype_str) in seen:
+                continue
+            seen.add((shape, dtype_str))
+            _dispatch(jnp.zeros(shape, string_to_dtype(dtype_str)))
 
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0
@@ -330,7 +375,9 @@ class CheckpointManager:
 
     def restore(self, app_state: AppState, step: Optional[int] = None) -> int:
         """Restore ``app_state`` from ``step`` (default: latest). Returns
-        the step restored from."""
+        the step restored from. The manager's ``device_digests`` option
+        applies here too: destinations already holding a payload's
+        content skip the read (see Snapshot.restore)."""
         self.wait()
         if step is None:
             step = self.latest_step()
@@ -342,7 +389,7 @@ class CheckpointManager:
         Snapshot(
             self.path_for(step), pg=self.pg,
             storage_options=self._options_for(step),
-        ).restore(app_state)
+        ).restore(app_state, device_digests=self.device_digests)
         # Seed the re-save guard: a resumed loop re-runs this step and
         # calls save(step) again; on remote roots this in-memory mark is
         # the ONLY thing preventing a non-atomic in-place overwrite of
