@@ -256,3 +256,71 @@ def test_multiprocess_4proc_staging_failure_aborts_all_ranks(tmp_path) -> None:
     )
     assert all(v == "aborted" for v in results.values()), results
     assert not os.path.exists(os.path.join(snap, ".snapshot_metadata"))
+
+
+def _device_digest_worker(rank, world_size, base_path, inc_path, port):
+    """Device digests across a REAL 2-process world: the take-side DtoH
+    skip and the restore-side read skip both exercise the
+    NON-fully-addressable code paths (per-shard containment in
+    ShardedArrayIOPreparer._dst_already_matches)."""
+    from jax.sharding import PartitionSpec as P
+
+    jax = _init_jax_dist(rank, world_size, port)
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+    from torchsnapshot_tpu.io_preparers.sharded import _ShardScatterConsumer
+
+    arr = _make_global_array(jax, P("x", None))
+    assert not arr.is_fully_addressable
+    Snapshot.take(base_path, {"m": StateDict(emb=arr)}, device_digests=True)
+
+    # Unchanged resave: nothing stages on either process.
+    staged = []
+    orig = ArrayBufferStager._stage_and_sum
+    ArrayBufferStager._stage_and_sum = lambda self, a: staged.append(1) or orig(
+        self, a
+    )
+    try:
+        arr2 = _make_global_array(jax, P("x", None))  # fresh buffers
+        Snapshot.take(
+            inc_path,
+            {"m": StateDict(emb=arr2)},
+            incremental_base=base_path,
+            device_digests=True,
+        )
+    finally:
+        ArrayBufferStager._stage_and_sum = orig
+    assert staged == [], f"rank {rank} staged {staged}"
+
+    # Restore into a destination already holding the content: the
+    # multi-process containment path verifies each locally-owned piece
+    # and consumes nothing.
+    consumed = []
+    orig_c = _ShardScatterConsumer._consume_sync
+    _ShardScatterConsumer._consume_sync = (
+        lambda self, buf: consumed.append(1) or orig_c(self, buf)
+    )
+    try:
+        dst = StateDict(emb=_make_global_array(jax, P("x", None)))
+        Snapshot(base_path).restore({"m": dst}, device_digests=True)
+    finally:
+        _ShardScatterConsumer._consume_sync = orig_c
+    assert consumed == [], f"rank {rank} consumed {consumed}"
+    for shard in dst["emb"].addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), _global_data()[shard.index]
+        )
+    return rank
+
+
+def test_multiprocess_device_digests(tmp_path) -> None:
+    port = _find_free_port()
+    results = run_with_subprocesses(
+        _device_digest_worker,
+        2,
+        str(tmp_path / "base"),
+        str(tmp_path / "inc"),
+        port,
+        timeout=180.0,
+    )
+    assert sorted(results.values()) == [0, 1]

@@ -225,17 +225,22 @@ def needs_consistency_copy(arr) -> bool:
 
 
 def iter_staged_pieces(app_state, pg=None, replicated=None, save_dtype=None):
-    """Yield ``(shape, dtype_str, needs_copy)`` for every piece THIS
-    process will stage for ``app_state`` — the single source of the
-    write-partition geometry, shared by the staging-pool warmup (byte
-    sizes, pieces with ``needs_copy`` only) and CheckpointManager's
-    fingerprint warmup (shapes + dtypes, all pieces).
+    """Yield ``(shape, dtype_str, needs_copy, get_piece)`` for every
+    piece THIS process will stage for ``app_state`` — the single source
+    of the write-partition geometry, shared by the staging-pool warmup
+    (byte sizes, pieces with ``needs_copy`` only) and CheckpointManager's
+    fingerprint warmup (real device pieces via ``get_piece``).
 
     ``save_dtype`` is applied: pieces are reported at the CONVERTED
     dtype, and chunk/subdivision boundaries are recomputed at its
-    itemsize, so consumers warm exactly what the real save stages. Under
-    a multi-rank ``pg``, replicated dense chunks stripe ``[rank::world]``
-    like the write partition; everything else is fully local.
+    itemsize, so consumers warm exactly what the real save stages.
+    ``get_piece`` is a thunk returning the UNCONVERTED piece (device
+    slice for jax leaves, view for numpy) — it materializes placement-
+    accurate data only when called, so size-only consumers never touch
+    devices; ``None`` when the piece cannot be cheaply materialized.
+    Under a multi-rank ``pg``, replicated dense chunks stripe
+    ``[rank::world]`` like the write partition; everything else is fully
+    local.
     """
     import fnmatch
 
@@ -279,10 +284,10 @@ def iter_staged_pieces(app_state, pg=None, replicated=None, save_dtype=None):
                 # sizes are computed at the converted dtype.
                 itemsize = string_to_dtype(eff).itemsize
                 needs = needs_consistency_copy(leaf)
-                for p_off, p_sz, _ in ShardedArrayIOPreparer._owned_pieces(
+                for p_off, p_sz, get_piece in ShardedArrayIOPreparer._owned_pieces(
                     leaf, itemsize=itemsize
                 ):
-                    yield tuple(p_sz), eff, needs
+                    yield tuple(p_sz), eff, needs, get_piece
             elif _is_jax_array(leaf) or isinstance(leaf, np.ndarray):
                 needs = needs_consistency_copy(leaf)
                 # Only REPLICATED paths stripe across ranks in the write
@@ -301,9 +306,14 @@ def iter_staged_pieces(app_state, pg=None, replicated=None, save_dtype=None):
                         ranges = ranges[rank::world]
                     rest = tuple(leaf.shape[1:])
                     for lo, hi in ranges:
-                        yield (hi - lo, *rest), eff, needs
+                        yield (
+                            (hi - lo, *rest),
+                            eff,
+                            needs,
+                            lambda leaf=leaf, lo=lo, hi=hi: leaf[lo:hi],
+                        )
                 else:
-                    yield tuple(leaf.shape), eff, needs
+                    yield tuple(leaf.shape), eff, needs, lambda leaf=leaf: leaf
 
 
 def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
@@ -327,8 +337,7 @@ def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
     they are its configuration rather than process state.
 
     Sizes mirror the write partition: for GSPMD-sharded jax arrays the
-    exact owned-piece sizes this process stages
-    (``ShardedArrayIOPreparer.staged_piece_sizes``); large dense arrays
+    exact owned-piece sizes this process stages; large dense arrays
     at the chunk-preparer's ranges. Under a multi-rank ``pg``, ONLY
     replicated paths stripe across ranks — ``replicated`` takes the same
     globs as ``Snapshot.take`` and process-replicated jax arrays are
@@ -350,7 +359,7 @@ def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
 
     sizes: List[int] = [
         array_size_bytes(shape, dt)
-        for shape, dt, needs_copy in iter_staged_pieces(
+        for shape, dt, needs_copy, _ in iter_staged_pieces(
             app_state, pg=pg, replicated=replicated, save_dtype=save_dtype
         )
         if needs_copy

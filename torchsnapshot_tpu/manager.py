@@ -207,30 +207,41 @@ class CheckpointManager:
         return enabled_by_env()
 
     def _warmup_fingerprints(self, app_state: AppState) -> None:
-        """Compile fingerprint jits for every piece shape/dtype the save
-        will hash (dispatch on zero dummies; results discarded) — the
+        """Compile fingerprint jits for every piece the save will hash
+        (dispatch on the REAL device pieces; results discarded) — the
         first digest-enabled save otherwise pays one XLA compile per
         distinct shape inside its blocking window. Geometry comes from
         ``iter_staged_pieces`` (the shared write-partition walk), so
         save_dtype conversion, chunk boundaries, sharded owned-piece
-        subdivision, and replicated striping all match the real save."""
-        import jax.numpy as jnp
-
+        subdivision, and replicated striping all match the real save —
+        and dispatching on the real pieces keys the jit cache with the
+        exact device placements save-time fingerprinting will use (zeros
+        on the default device would miss per-device entries on
+        multi-device processes). Host numpy leaves are skipped: the save
+        never fingerprints them (``_device_dedup_candidate`` requires a
+        jax array)."""
         from .device_digest import _dispatch
-        from .io_preparers.array import iter_staged_pieces
+        from .io_preparers.array import _is_jax_array, iter_staged_pieces
         from .serialization import string_to_dtype
 
-        seen = set()
-        for shape, dtype_str, _ in iter_staged_pieces(
+        for _, dtype_str, _, get_piece in iter_staged_pieces(
             app_state,
             pg=self.pg,
             replicated=self.replicated,
             save_dtype=self.save_dtype,
         ):
-            if (shape, dtype_str) in seen:
+            if get_piece is None:
                 continue
-            seen.add((shape, dtype_str))
-            _dispatch(jnp.zeros(shape, string_to_dtype(dtype_str)))
+            piece = get_piece()
+            if not _is_jax_array(piece):
+                continue
+            from .io_preparers.array import dtype_to_string
+
+            if dtype_to_string(piece.dtype) != dtype_str:
+                # save_dtype conversion happens on device before staging;
+                # compile for the converted aval (transient cast copy).
+                piece = piece.astype(string_to_dtype(dtype_str))
+            _dispatch(piece)
 
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0
