@@ -1,0 +1,179 @@
+"""Preemption-aware emergency checkpointing (preemption.py).
+
+The hard property is collective consistency: cloud preemption SIGTERMs a
+SUBSET of hosts, yet every rank must make the same save-now decision or
+the collective take hangs. Single-process tests use SIGUSR1 (so pytest
+itself never sees a SIGTERM); the multiprocess drill sends a real
+SIGTERM to ONE rank of a 2-process ``jax.distributed`` world and both
+ranks must commit the same emergency snapshot.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchsnapshot_tpu import PreemptionWatcher, Snapshot, StateDict
+from torchsnapshot_tpu.manager import CheckpointManager
+
+
+@pytest.fixture
+def watcher():
+    w = PreemptionWatcher(signals=(signal.SIGUSR1,))
+    yield w
+    w.close()
+
+
+def _fire() -> None:
+    os.kill(os.getpid(), signal.SIGUSR1)
+
+
+def test_flag_and_should_save(watcher):
+    assert not watcher.preempted
+    assert not watcher.should_save()
+    _fire()
+    assert watcher.preempted
+    assert watcher.should_save()
+    # Not consumed until a save handles it.
+    assert not watcher.consumed
+    watcher.consume()
+    assert watcher.consumed
+
+
+def test_previous_handler_chained():
+    hits = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: hits.append(s))
+    try:
+        w = PreemptionWatcher(signals=(signal.SIGUSR1,))
+        try:
+            _fire()
+            assert w.preempted
+            assert hits == [signal.SIGUSR1]  # the old handler still ran
+        finally:
+            w.close()
+        # close() restored the previous handler.
+        _fire()
+        assert hits == [signal.SIGUSR1, signal.SIGUSR1]
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_manager_emergency_save_off_cadence(tmp_path, watcher):
+    w = jnp.arange(256, dtype=jnp.float32)
+    mgr = CheckpointManager(
+        str(tmp_path / "ckpts"), save_interval_steps=100, preemption=watcher
+    )
+    state = {"m": StateDict(w=w)}
+    assert not mgr.save(1, state)  # not due, no preemption
+    _fire()
+    assert mgr.save(2, state)  # off-cadence emergency save
+    assert watcher.consumed
+    assert mgr.all_steps() == [2]
+    # Grace-window loop continues: no re-save every step.
+    assert not mgr.save(3, state)
+    dst = {"m": StateDict(w=jnp.zeros_like(w))}
+    Snapshot(mgr.path_for(2)).restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+
+
+def test_emergency_save_is_synchronous(tmp_path, watcher):
+    """async_save managers still commit emergency snapshots before save()
+    returns — the process is about to die."""
+    w = jnp.arange(256, dtype=jnp.float32)
+    mgr = CheckpointManager(
+        str(tmp_path / "ckpts"),
+        save_interval_steps=100,
+        async_save=True,
+        preemption=watcher,
+    )
+    _fire()
+    assert mgr.save(5, {"m": StateDict(w=w)})
+    # Committed synchronously: no pending handle, metadata on disk.
+    assert mgr._pending is None
+    assert mgr.all_steps() == [5]
+
+
+def test_simulate_helper_uses_sigterm():
+    from torchsnapshot_tpu import simulate_preemption_now
+
+    w = PreemptionWatcher()  # default: SIGTERM
+    try:
+        simulate_preemption_now()
+        assert w.preempted
+    finally:
+        w.close()
+
+
+def _preemption_drill_worker(rank: int, world_size: int, root: str):
+    """Rank 0 alone receives SIGTERM; the collective decision must bring
+    BOTH ranks into the same emergency save."""
+    from torchsnapshot_tpu import PreemptionWatcher, StateDict
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    watcher = PreemptionWatcher()  # SIGTERM
+    try:
+        mgr = CheckpointManager(
+            root, save_interval_steps=1000, preemption=watcher
+        )
+        state = {
+            "model": StateDict(w=np.arange(64, dtype=np.float32)),
+            "local": StateDict(r=np.full((4,), rank, dtype=np.int32)),
+        }
+        saved_at = None
+        last_step = None
+        for step in range(1, 100):
+            last_step = step
+            if rank == 0 and step == 4:
+                os.kill(os.getpid(), signal.SIGTERM)
+            if mgr.save(step, state):
+                saved_at = step
+            if watcher.consumed:  # the documented recipe: set on EVERY
+                break             # rank, so all exit the loop together
+        assert saved_at == 4, saved_at
+        assert last_step == 4, last_step  # both ranks broke immediately
+        assert watcher.consumed
+        assert not mgr._pending  # synchronous commit
+        return saved_at
+    finally:
+        watcher.close()
+
+
+@pytest.mark.multiprocess
+def test_multiprocess_preemption_drill(tmp_path):
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _preemption_drill_worker, 2, str(tmp_path / "ckpts")
+    )
+    assert set(results.values()) == {4}
+    # The emergency snapshot is complete and restorable.
+    dst = {
+        "model": StateDict(w=jnp.zeros(64, jnp.float32)),
+        "local": StateDict(r=np.zeros((4,), np.int32)),
+    }
+    Snapshot(str(tmp_path / "ckpts" / "step_0000000004")).restore(dst)
+    np.testing.assert_array_equal(
+        np.asarray(dst["model"]["w"]), np.arange(64, dtype=np.float32)
+    )
+
+
+def test_emergency_at_already_committed_step_consumes(tmp_path, watcher):
+    """Resume recipe: the loop re-runs the restored step; a preemption
+    there finds the step already committed — the existing snapshot IS the
+    resume point, and the watcher must still be consumed so the loop's
+    consumed-break fires."""
+    w = jnp.arange(64, dtype=jnp.float32)
+    state = {"m": StateDict(w=w)}
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), preemption=watcher)
+    assert mgr.save(3, state)
+    mgr2 = CheckpointManager(str(tmp_path / "ckpts"), preemption=watcher)
+    assert mgr2.restore(state) == 3
+    _fire()
+    assert not mgr2.save(3, state)  # nothing re-saved ...
+    assert watcher.consumed  # ... but the preemption is handled
+    assert mgr2.all_steps() == [3]

@@ -10,6 +10,7 @@ try:
     from .rng_state import RNGState  # noqa: F401
     from .snapshot import PendingRestore, PendingSnapshot, Snapshot  # noqa: F401
     from .manager import CheckpointManager  # noqa: F401
+    from .preemption import PreemptionWatcher, simulate_preemption_now  # noqa: F401
     from .io_preparers.array import warmup_staging  # noqa: F401
 except ImportError:  # pragma: no cover - during incremental bring-up only
     pass

@@ -61,6 +61,7 @@ import re
 from typing import Any, Dict, List, Optional
 
 from .pg_wrapper import PGWrapper, ProcessGroup
+from .preemption import PreemptionWatcher
 from .snapshot import PendingSnapshot, Snapshot
 from .stateful import AppState
 
@@ -95,6 +96,7 @@ class CheckpointManager:
         replicated: Optional[List[str]] = None,
         storage_options: Optional[Dict[str, Any]] = None,
         pg: Optional[ProcessGroup] = None,
+        preemption: Optional[PreemptionWatcher] = None,
     ) -> None:
         if save_interval_steps < 1:
             raise ValueError("save_interval_steps must be >= 1")
@@ -114,6 +116,7 @@ class CheckpointManager:
         self.replicated = replicated
         self.storage_options = storage_options
         self.pg = pg
+        self.preemption = preemption
         self._pending: Optional[PendingSnapshot] = None
         self._pending_step: Optional[int] = None
         self._last_committed: Optional[int] = self.latest_step()
@@ -282,8 +285,27 @@ class CheckpointManager:
 
         Returns True when a save was started/completed. Blocks only for
         staging when ``async_save`` (draining any previous pending save
-        first — one in flight at a time)."""
-        if not force and not self.should_save(step):
+        first — one in flight at a time).
+
+        With a ``preemption`` watcher configured, every call also makes
+        the COLLECTIVE should-we-emergency-save decision (so ``save``
+        must be called at the same steps on all ranks — it already must
+        be, being a collective itself when due): on a preemption the
+        current step saves regardless of cadence, SYNCHRONOUSLY (the
+        process is about to die; an async save's background commit could
+        be killed mid-write), and the watcher is consumed so the rest of
+        the grace-window loop doesn't re-save every step."""
+        emergency = False
+        if self.preemption is not None and not self.preemption.consumed:
+            # The decision rides THIS manager's group: a watcher gathered
+            # over a different/absent group could split-brain (the
+            # signaled rank alone entering the multi-rank take).
+            if self.preemption.should_save(pg=self.pg):
+                emergency = True
+                logger.warning(
+                    "preemption flagged: emergency snapshot at step %d", step
+                )
+        if not force and not emergency and not self.should_save(step):
             return False
         self.wait()  # at most one pending; also runs its retention
         if self._already_committed(step):
@@ -291,6 +313,20 @@ class CheckpointManager:
             # re-save would overwrite the committed snapshot in place —
             # non-atomically, and under incremental=True with ITSELF as
             # the dedup base. Never overwrite a committed step.
+            if emergency:
+                # The committed snapshot of THIS step (a previous run's)
+                # already provides a resume point; only the current
+                # partial re-run is lost, which eviction makes
+                # inevitable. The branch is collectively consistent (the
+                # committed check is broadcast), so every rank consumes
+                # together and the loop's consumed-break stays in step.
+                self.preemption.consume()
+                logger.warning(
+                    "preemption at already-committed step %d: existing "
+                    "snapshot is the resume point; nothing re-saved",
+                    step,
+                )
+                return False
             logger.info("step %d already has a committed snapshot; skipping", step)
             return False
 
@@ -300,6 +336,7 @@ class CheckpointManager:
             if self.incremental and self._last_committed is not None
             else None
         )
+        use_async = self.async_save and not emergency
         kwargs: Dict[str, Any] = dict(
             pg=self.pg,
             replicated=self.replicated,
@@ -310,12 +347,15 @@ class CheckpointManager:
             compression=self.compression,
             save_dtype=self.save_dtype,
         )
-        if self.async_save:
+        if use_async:
             self._pending = Snapshot.async_take(path, app_state, **kwargs)
             self._pending_step = step
         else:
             Snapshot.take(path, app_state, **kwargs)
             self._committed(step)
+        if emergency:
+            self.preemption.consume()
+            logger.warning("emergency snapshot committed at step %d", step)
         return True
 
     def wait(self) -> None:
