@@ -13,6 +13,10 @@ way a job would actually run it:
 5. The process "crashes"; a fresh manager discovers the latest
    committed step and resumes — and re-running the restored step does
    NOT overwrite its committed snapshot.
+6. A preemption (SIGTERM, as cloud spot/maintenance eviction sends)
+   triggers a collectively consistent off-cadence emergency save; the
+   loop exits cleanly, and a third run resumes from the exact
+   preempted step.
 
 Run: JAX_PLATFORMS=cpu python examples/production_loop.py
 """
@@ -30,7 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from torchsnapshot_tpu import CheckpointManager, RNGState, StateDict
+from torchsnapshot_tpu import (
+    CheckpointManager,
+    PreemptionWatcher,
+    RNGState,
+    StateDict,
+    simulate_preemption_now,
+)
 
 D = 256
 
@@ -49,16 +59,24 @@ def loss_fn(params, x, y):
     return jnp.mean((jnp.tanh(x @ params["w1"]) @ params["w2"] - y) ** 2)
 
 
-def train(root: str, mirror: str, n_steps: int, crash_at: int | None) -> float:
+def train(
+    root: str,
+    mirror: str,
+    n_steps: int,
+    crash_at: int | None,
+    preempt_at: int | None = None,
+) -> float:
     key = jax.random.PRNGKey(0)
     params, tx, opt_state = init_state(key)
 
+    watcher = PreemptionWatcher()   # SIGTERM -> flag; handler chained
     mgr = CheckpointManager(
         root,
         save_interval_steps=5,      # checkpoint every 5 steps
         keep_last=2,                # retention: newest 2 survive
         async_save=True,            # block only for staging
         storage_options={"mirror_url": mirror},
+        preemption=watcher,         # emergency save on eviction
     )
     app_state = {
         "model": StateDict(params=params),
@@ -93,7 +111,17 @@ def train(root: str, mirror: str, n_steps: int, crash_at: int | None) -> float:
         app_state["model"] = StateDict(params=params)
         app_state["optim"] = StateDict(state=opt_state)
         app_state["progress"] = StateDict(step=step)
+        if preempt_at is not None and step == preempt_at:
+            # What the cloud does to a spot slice, self-inflicted:
+            simulate_preemption_now()
         mgr.save(step, app_state)   # no-op unless due; drains previous async
+        if watcher.consumed:
+            # Emergency snapshot committed ON EVERY RANK (consumed is the
+            # collective signal; `preempted` is rank-local). Exit inside
+            # the grace window.
+            print(f"preempted: emergency snapshot committed at step {step}")
+            watcher.close()
+            return float("nan")
 
         if crash_at is not None and step == crash_at:
             mgr.wait()
@@ -102,6 +130,7 @@ def train(root: str, mirror: str, n_steps: int, crash_at: int | None) -> float:
 
         loss = float(loss_fn(params, x, y))
     mgr.wait()
+    watcher.close()
     return loss
 
 
@@ -111,17 +140,19 @@ def main() -> None:
     mirror = f"fs://{tmp}/mirror"
 
     train(root, mirror, n_steps=20, crash_at=11)   # run 1: dies at step 11
-    loss = train(root, mirror, n_steps=20, crash_at=None)  # run 2: resumes
+    train(root, mirror, n_steps=20, crash_at=None, preempt_at=17)  # run 2: evicted
+    loss = train(root, mirror, n_steps=20, crash_at=None)  # run 3: resumes
 
     steps = sorted(os.listdir(root))
     print(f"committed snapshots after retention: {steps}")
-    assert steps == ["step_0000000010", "step_0000000015"], steps
+    # Step 17 is the off-cadence emergency snapshot from the eviction.
+    assert steps == ["step_0000000015", "step_0000000017"], steps
     # Retention governs the PRIMARY tier; the durable mirror keeps every
     # step as archival history (prune it with `torchsnapshot-tpu prune`
     # when that history should be bounded too).
     mirrors = sorted(os.listdir(os.path.join(tmp, "mirror")))
     print(f"mirror replicas (archival, unpruned): {mirrors}")
-    print(f"final loss {loss:.5f} — resume + retention + mirror all verified")
+    print(f"final loss {loss:.5f} — resume + retention + mirror + preemption all verified")
 
 
 if __name__ == "__main__":

@@ -177,3 +177,33 @@ def test_emergency_at_already_committed_step_consumes(tmp_path, watcher):
     assert not mgr2.save(3, state)  # nothing re-saved ...
     assert watcher.consumed  # ... but the preemption is handled
     assert mgr2.all_steps() == [3]
+
+
+def test_explicit_none_pg_is_authoritative(tmp_path):
+    """An explicit pg (even None) to should_save never falls back to the
+    watcher's constructor group — the manager's group always wins."""
+
+    class FakeSubgroupPG:
+        # A watcher constructed over some subgroup object; if should_save
+        # fell back to it, PGWrapper would choke on this non-pg — the
+        # test passes only because the explicit pg=None wins.
+        pass
+
+    w = PreemptionWatcher(pg=FakeSubgroupPG(), signals=(signal.SIGUSR1,))
+    try:
+        _fire()
+        assert w.should_save(pg=None) is True  # default group: world 1
+    finally:
+        w.close()
+
+
+def test_handler_does_not_log(watcher, caplog):
+    """The handler itself must not touch logging (stream reentrancy at
+    eviction time); the record is emitted lazily from should_save."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="torchsnapshot_tpu.preemption"):
+        _fire()
+        assert caplog.records == []  # nothing logged inside the handler
+        assert watcher.should_save()
+    assert any("flagged for emergency" in r.message for r in caplog.records)

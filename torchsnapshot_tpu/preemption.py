@@ -61,6 +61,13 @@ from .pg_wrapper import PGWrapper, ProcessGroup
 
 logger = logging.getLogger(__name__)
 
+# Distinguishes "caller passed pg explicitly (even None)" from "caller
+# did not pass pg": an explicit pg — CheckpointManager always passes its
+# own, None meaning the default group — is AUTHORITATIVE, never falling
+# back to the watcher's constructor group (which could be a different
+# subgroup: the split-brain this exists to prevent).
+_UNSET = object()
+
 
 class PreemptionWatcher:
     """Watches termination signals and answers, collectively, "should we
@@ -78,28 +85,39 @@ class PreemptionWatcher:
     ) -> None:
         self._pg_raw = pg
         self._flagged = threading.Event()
+        self._signums: list = []
         self._consumed = False
         self._prev = {}
         for sig in signals:
             self._prev[sig] = signal.signal(sig, self._handle)
 
     def _handle(self, signum, frame) -> None:
+        # Async-signal-safe: set flags only. Logging from a handler can
+        # hit stream-reentrancy RuntimeErrors mid-write — aborting the
+        # training loop at the exact moment the watcher exists to protect
+        # — so the signal is recorded here and logged lazily from the
+        # next should_save()/consume() call.
+        self._signums.append(signum)
         self._flagged.set()
-        logger.warning(
-            "received signal %d: flagging for emergency checkpoint", signum
-        )
         prev = self._prev.get(signum)
         if callable(prev):
             prev(signum, frame)
         # SIG_DFL/SIG_IGN/None: nothing to chain; termination is deferred
         # to the caller's loop, which breaks after the committed save.
 
+    def _log_pending(self) -> None:
+        while self._signums:
+            logger.warning(
+                "received signal %d: flagged for emergency checkpoint",
+                self._signums.pop(0),
+            )
+
     @property
     def preempted(self) -> bool:
         """This process observed a signal (local, non-collective)."""
         return self._flagged.is_set()
 
-    def should_save(self, pg: Optional[ProcessGroup] = None) -> bool:
+    def should_save(self, pg: "Optional[ProcessGroup]" = _UNSET) -> bool:  # type: ignore[assignment]
         """True when ANY rank observed a signal. COLLECTIVE: all ranks
         must call at the same point in the loop; all receive the same
         answer (each decision is one gather, so ranks can never split on
@@ -109,11 +127,14 @@ class PreemptionWatcher:
         passes its own, so the decision always rides the SAME group as
         the save that follows (a watcher gathered over a different/empty
         group could split-brain: the signaled rank alone entering a
-        multi-rank take). Groups resolve per call (not at watcher
+        multi-rank take). An EXPLICIT ``pg`` is authoritative even when
+        it is None (None = the default group) — it never falls back to
+        the constructor's group. Groups resolve per call (not at watcher
         construction), so a watcher built before ``init_process_group``
         still joins the collective; each call's wrapper retires its
         store keys, so per-step polling leaves no coordinator residue."""
-        wrapper = PGWrapper(pg if pg is not None else self._pg_raw)
+        self._log_pending()
+        wrapper = PGWrapper(pg if pg is not _UNSET else self._pg_raw)
         if wrapper.get_world_size() == 1:
             return self._flagged.is_set()
         try:
@@ -126,6 +147,7 @@ class PreemptionWatcher:
         """Mark the preemption handled (a snapshot committed): subsequent
         ``CheckpointManager.save`` calls stop re-triggering while the
         loop finishes its grace-window teardown."""
+        self._log_pending()
         self._consumed = True
 
     @property
