@@ -436,3 +436,53 @@ def test_collective_sequences_fuzz(world, ops) -> None:
         t.join(timeout=120)
     server.close()
     assert not errors, errors[0]
+
+
+# --------------------------------------------------- device fingerprints
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    dtype_str=st.sampled_from(
+        ["float32", "bfloat16", "float16", "int32", "int8", "uint8", "bool"]
+    ),
+    shape=st.lists(st.integers(0, 9), min_size=0, max_size=3).map(tuple),
+    seed=st.integers(0, 2**31 - 1),
+    data=st.data(),
+)
+def test_device_fingerprint_properties(dtype_str, shape, seed, data) -> None:
+    """Content-determined, copy-invariant, and bit-flip sensitive across
+    the dtype table (device_digest.py's trust model reduced to testable
+    properties)."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.device_digest import PREFIX, device_fingerprint
+
+    rng = np.random.default_rng(seed)
+    np_dtype = string_to_dtype(dtype_str)
+    if dtype_str == "bool":
+        host = rng.integers(0, 2, size=shape).astype(np_dtype)
+    elif np.issubdtype(np_dtype, np.integer):
+        info = np.iinfo(np_dtype)
+        host = rng.integers(info.min, info.max, size=shape, endpoint=True).astype(
+            np_dtype
+        )
+    else:
+        host = rng.standard_normal(size=shape).astype(np_dtype)
+
+    x = jnp.asarray(host)
+    fp = device_fingerprint(x)
+    assert fp is not None and fp.startswith(PREFIX + ":")
+    # Copy invariance: a distinct buffer with equal content hashes equal.
+    assert device_fingerprint(jnp.asarray(host.copy())) == fp
+
+    if host.size == 0 or dtype_str == "bool":
+        return
+    # Single-bit sensitivity at a random element: flip the lowest bit of
+    # the element's raw representation (always changes the byte stream).
+    flat = host.reshape(-1).copy()
+    idx = data.draw(st.integers(0, flat.size - 1))
+    raw = flat.view(f"u{flat.dtype.itemsize}")
+    raw[idx] ^= 1
+    mutated = raw.view(np_dtype).reshape(shape)
+    assert device_fingerprint(jnp.asarray(mutated)) != fp
