@@ -300,6 +300,16 @@ def test_consolidate_materializes_device_deduped(tmp_path):
     dst = {"m": StateDict(w=jnp.zeros_like(w))}
     Snapshot(str(tmp_path / "solid")).restore(dst)
     np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), np.asarray(w))
+    # Fingerprints survive consolidation (origins cleared): the flattened
+    # snapshot still serves as a DtoH-skipping base for future takes.
+    from torchsnapshot_tpu.dedup import _iter_payload_entries
+
+    payloads = [
+        p
+        for e in Snapshot(str(tmp_path / "solid")).metadata.manifest.values()
+        for p in _iter_payload_entries(e)
+    ]
+    assert payloads and all(p.device_digest and p.origin is None for p in payloads)
 
 def test_int4_payload_falls_back_without_crashing(tmp_path, staging_spy):
     """Sub-byte packings (int4) have no elementwise uint8 bitcast — jax
