@@ -520,3 +520,32 @@ def test_manager_warmup_compiles_fingerprints(tmp_path, monkeypatch):
     )
     mgr.warmup({"m": StateDict(w=w, b=b)})
     assert (64, 64) in dispatched and (128,) in dispatched
+
+
+def test_compression_composes_with_device_digests(tmp_path, staging_spy, consume_spy):
+    """Fingerprints cover the UNCOMPRESSED device content, so the skip
+    works identically for compressed snapshots on both sides."""
+    w = jnp.arange(8192, dtype=jnp.float32)  # compressible
+    Snapshot.take(
+        str(tmp_path / "base"),
+        {"m": StateDict(w=w)},
+        device_digests=True,
+        compression="zstd",
+    )
+    staging_spy.clear()
+    snap = Snapshot.take(
+        str(tmp_path / "incr"),
+        {"m": StateDict(w=w + 0)},
+        incremental_base=str(tmp_path / "base"),
+        device_digests=True,
+        compression="zstd",
+    )
+    assert staging_spy == []  # DtoH skipped despite the codec
+    consume_spy.clear()
+    dst = {"m": StateDict(w=w + 0)}
+    snap.restore(dst, device_digests=True)
+    assert consume_spy == []  # read skipped too
+    # And a cold restore still decompresses correctly.
+    cold = {"m": StateDict(w=jnp.zeros_like(w))}
+    snap.restore(cold)
+    np.testing.assert_array_equal(np.asarray(cold["m"]["w"]), np.asarray(w))
