@@ -125,6 +125,8 @@ def train(
 
         if crash_at is not None and step == crash_at:
             mgr.wait()
+            watcher.close()  # a real crash wouldn't, but an in-process
+            # "crash" must not leak its SIGTERM handler into later runs
             print(f"simulating a crash after step {step}")
             return float("nan")
 

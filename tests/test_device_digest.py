@@ -549,3 +549,26 @@ def test_compression_composes_with_device_digests(tmp_path, staging_spy, consume
     cold = {"m": StateDict(w=jnp.zeros_like(w))}
     snap.restore(cold)
     np.testing.assert_array_equal(np.asarray(cold["m"]["w"]), np.asarray(w))
+
+
+def test_env_var_falsy_spellings(monkeypatch):
+    from torchsnapshot_tpu.device_digest import enabled_by_env
+
+    for off in ("", "0", "false"):
+        monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEVICE_DIGESTS", off)
+        assert not enabled_by_env(), off
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEVICE_DIGESTS", "1")
+    assert enabled_by_env()
+
+
+def test_batching_warns_for_device_digests(tmp_path, monkeypatch, caplog):
+    """Batched (small) payloads can never match fingerprints; the
+    existing batching/dedup warning must fire for device_digests-only
+    takes too."""
+    import logging
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    w = jnp.arange(64, dtype=jnp.float32)
+    with caplog.at_level(logging.WARNING, logger="torchsnapshot_tpu.snapshot"):
+        Snapshot.take(str(tmp_path / "s"), {"m": StateDict(w=w)}, device_digests=True)
+    assert any("batching" in r.message.lower() for r in caplog.records)

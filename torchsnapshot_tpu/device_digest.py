@@ -48,7 +48,14 @@ _SEEDS = (
 
 
 def enabled_by_env() -> bool:
-    return os.environ.get("TORCHSNAPSHOT_TPU_DEVICE_DIGESTS", "") not in ("", "0")
+    # Falsy spellings match the repo's other flags (integrity._env_on,
+    # batcher.batching_enabled): an explicit "false" must never turn the
+    # opt-in trust model ON.
+    return os.environ.get("TORCHSNAPSHOT_TPU_DEVICE_DIGESTS", "0") not in (
+        "0",
+        "",
+        "false",
+    )
 
 
 def _mix32(x):

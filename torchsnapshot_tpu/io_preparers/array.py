@@ -570,16 +570,29 @@ class ArrayBufferStager(BufferStager):
                 # No base fingerprint to match (first save, or a base
                 # taken without device digests): the DMA must happen, so
                 # kick it first and let the recording fingerprint — pure
-                # on-device compute — overlap the transfer.
+                # on-device compute — overlap the transfer. The dispatch
+                # (kick) happens before staging; the 16-byte fetch waits
+                # until after, so neither the device pass nor its
+                # roundtrip ever sits ahead of the staging copy.
                 record_fp = True
         if _is_jax_array(arr):
             try:
                 arr.copy_to_host_async()  # kick off the DMA before blocking
             except Exception:
                 pass
+        pending_fp = None
         if record_fp:
-            await loop.run_in_executor(executor, self._record_device_fingerprint, arr)
-        return await loop.run_in_executor(executor, self._stage_and_sum, arr)
+            from ..device_digest import _dispatch
+
+            pending_fp = await loop.run_in_executor(executor, _dispatch, arr)
+        buf = await loop.run_in_executor(executor, self._stage_and_sum, arr)
+        if pending_fp is not None:
+            from ..device_digest import _finalize
+
+            self.entry.device_digest = await loop.run_in_executor(
+                executor, _finalize, arr, pending_fp
+            )
+        return buf
 
     def get_staging_cost_bytes(self) -> int:
         return array_nbytes(self.arr)

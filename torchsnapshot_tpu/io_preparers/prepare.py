@@ -129,7 +129,11 @@ def _dst_already_matches(entry: Entry, obj_out: Any) -> bool:
             return False
         if dtype_to_string(obj_out.dtype) != entry.dtype:
             return False
-        if any(c.array.device_digest is None for c in entry.chunks):
+        if not entry.chunks or any(
+            c.array.device_digest is None for c in entry.chunks
+        ):
+            # Empty chunks would make the all() below vacuously true and
+            # keep arbitrary destination content with zero verification.
             return False
         # Batched: all chunk fingerprints dispatch before the first fetch
         # — one roundtrip of latency, not one per chunk.

@@ -360,7 +360,9 @@ class Snapshot:
             incremental_base = canonical_base_url(incremental_base)
 
         dedup_ctx: Optional[DedupContext] = None
-        if (incremental_base is not None or record_digests) and batching_enabled():
+        if (
+            incremental_base is not None or record_digests or device_digests
+        ) and batching_enabled():
             # Slab packing rewrites small-write locations to batched/<uuid>
             # before staging, which can never match a base's ref index, and
             # byte-ranged slab sub-entries are excluded from future indexes
