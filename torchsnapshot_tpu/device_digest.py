@@ -28,6 +28,7 @@ match recomputations on another.
 
 from __future__ import annotations
 
+import itertools
 import os
 from typing import Optional
 
@@ -148,20 +149,24 @@ def _dispatch(arr):
         return None
 
 
-def _finalize(arr, pending) -> str:
+def _finalize_from_nbytes(nbytes: int, pending) -> str:
     """Fetch a dispatched computation's 16 bytes and fold in the length."""
     import jax
 
     lanes = np.asarray(jax.device_get(pending), dtype=np.uint32)
     # Fold the byte length in on the host (it is static per shape): equal
     # word streams of different underlying sizes stay distinct.
-    nbytes = int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape, dtype=np.int64))
     with np.errstate(over="ignore"):
         final = [
             np.uint32(lane) ^ _mix32(np.uint32(nbytes & 0xFFFFFFFF) ^ seed)
             for lane, seed in zip(lanes, _SEEDS)
         ]
     return PREFIX + ":" + "".join(f"{int(v):08x}" for v in final)
+
+
+def _finalize(arr, pending) -> str:
+    nbytes = int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape, dtype=np.int64))
+    return _finalize_from_nbytes(nbytes, pending)
 
 
 def device_fingerprint(arr) -> Optional[str]:
@@ -178,13 +183,53 @@ def device_fingerprint(arr) -> Optional[str]:
     return _finalize(arr, pending)
 
 
-def device_fingerprints(arrs) -> "list[Optional[str]]":
-    """Fingerprint many arrays with overlapped dispatch: all jit calls are
-    kicked before the first result is fetched, so N fingerprints cost ~one
-    host<->device roundtrip instead of N serial ones (the roundtrip, not
-    the hash, dominates for small/medium arrays on tunneled links)."""
-    pendings = [_dispatch(a) for a in arrs]
-    return [
-        _finalize(a, p) if p is not None else None
-        for a, p in zip(arrs, pendings)
-    ]
+# Restore-side verification window: slices per in-flight batch. Small
+# enough that transient slice copies never approach the array's own
+# footprint (chunks are <=512 MB, so <=4 slices is <=2 GB transient at
+# the pathological maximum, and typically far less), large enough to
+# amortize the host<->device roundtrip across a window.
+MATCH_WINDOW = 4
+
+
+def fingerprints_match(pairs, window: int = MATCH_WINDOW) -> bool:
+    """Bounded-memory fingerprint comparison for restore-side skips.
+
+    ``pairs`` is an iterable of ``(get_slice, expected)`` where
+    ``get_slice`` is a thunk producing the device slice to verify and
+    ``expected`` the manifest-recorded digest. At most ``window`` slices
+    are live at once: each window's fingerprints dispatch together before
+    the first 16-byte fetch — ~one host<->device roundtrip per window,
+    not per slice (the roundtrip, not the hash, dominates for small/
+    medium slices on tunneled links) — then the slice references are
+    dropped before the next window materializes, so verifying a chunked/
+    sharded array never transiently duplicates its whole footprint in
+    device memory, only ``window`` pieces of it. Returns False on the
+    first mismatch or unfingerprintable slice (callers fall back to a
+    normal read); remaining windows are never materialized.
+    """
+    if window < 1:
+        # islice(it, 0) would yield an empty first batch and return True
+        # with ZERO verification — a silent skip of arbitrary content.
+        raise ValueError(f"window must be >= 1, got {window}")
+    it = iter(pairs)
+    while True:
+        batch = list(itertools.islice(it, window))
+        if not batch:
+            return True
+        pendings = []
+        for get_slice, expected in batch:
+            arr = get_slice()
+            pending = _dispatch(arr)
+            if pending is None:
+                return False
+            nbytes = int(np.dtype(arr.dtype).itemsize) * int(
+                np.prod(arr.shape, dtype=np.int64)
+            )
+            # Keep only (pending, nbytes): the slice buffer itself can be
+            # freed as soon as the jit consumes it.
+            pendings.append((pending, nbytes, expected))
+            del arr
+        del batch
+        for pending, nbytes, expected in pendings:
+            if _finalize_from_nbytes(nbytes, pending) != expected:
+                return False

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import contextvars
+import logging
 import os
 import sys
 import threading
@@ -48,6 +49,14 @@ from ..serialization import (
 _copy_for_consistency: contextvars.ContextVar[bool] = contextvars.ContextVar(
     "tsnap_copy_for_consistency", default=True
 )
+
+logger = logging.getLogger(__name__)
+
+# One warning per process when a device-digest dedup match inherits a
+# missing checksum from a base saved with checksums disabled: unlike the
+# host dedup path there are no staged bytes to recompute one from, so
+# restore-time verification coverage narrows for those entries.
+_warned_none_checksum = False
 
 
 @contextlib.contextmanager
@@ -439,7 +448,8 @@ class ArrayBufferStager(BufferStager):
         the (opt-in, non-cryptographic) trust model documented in
         device_digest.py. Unlike the host path there is no staged buffer
         here, so a base saved without checksums leaves the entry's
-        checksum unset rather than recomputing one."""
+        checksum unset rather than recomputing one — a one-time warning
+        flags the narrowed verification coverage when that happens."""
         fp = self._record_device_fingerprint(arr)
         if fp is None:
             return False
@@ -453,6 +463,21 @@ class ArrayBufferStager(BufferStager):
         self.entry.origin = ref.origin
         self.entry.codec = ref.codec
         self.entry.checksum = ref.checksum
+        if ref.checksum is None:
+            from ..integrity import checksums_enabled
+
+            if checksums_enabled():
+                global _warned_none_checksum
+                if not _warned_none_checksum:
+                    _warned_none_checksum = True
+                    logger.warning(
+                        "device-digest dedup match for %s inherits no "
+                        "checksum (base snapshot was saved with checksums "
+                        "disabled); restore-time verification will not "
+                        "cover deduplicated entries until a full (non-"
+                        "dedup) save records checksums again",
+                        self.entry.location,
+                    )
         return True
 
     def _stage_fused(self, arr) -> Optional[BufferType]:

@@ -109,7 +109,7 @@ def _dst_already_matches(entry: Entry, obj_out: Any) -> bool:
     kept. Conservative on every edge: any missing fingerprint, dtype or
     shape difference, or unfingerprintable destination means False.
     """
-    from ..device_digest import device_fingerprint, device_fingerprints
+    from ..device_digest import device_fingerprint, fingerprints_match
     from .array import dtype_to_string
 
     if isinstance(entry, ArrayEntry):
@@ -132,18 +132,27 @@ def _dst_already_matches(entry: Entry, obj_out: Any) -> bool:
         if not entry.chunks or any(
             c.array.device_digest is None for c in entry.chunks
         ):
-            # Empty chunks would make the all() below vacuously true and
-            # keep arbitrary destination content with zero verification.
+            # fingerprints_match([]) is vacuously True; empty chunks must
+            # not keep arbitrary destination content with no verification.
             return False
-        # Batched: all chunk fingerprints dispatch before the first fetch
-        # — one roundtrip of latency, not one per chunk.
-        slices = [
-            obj_out[tuple(slice(o, o + s) for o, s in zip(c.offsets, c.sizes))]
-            for c in entry.chunks
-        ]
-        fps = device_fingerprints(slices)
-        return all(
-            fp == c.array.device_digest for fp, c in zip(fps, entry.chunks)
+        # Windowed: a few chunk slices are live at a time (fingerprints
+        # in a window dispatch together, then the slices are dropped), so
+        # verifying a chunked array — which only exists above 512 MB —
+        # never transiently duplicates its whole footprint in device
+        # memory the way a full eager slice list would.
+        return fingerprints_match(
+            (
+                (
+                    lambda c=c: obj_out[
+                        tuple(
+                            slice(o, o + s)
+                            for o, s in zip(c.offsets, c.sizes)
+                        )
+                    ],
+                    c.array.device_digest,
+                )
+                for c in entry.chunks
+            )
         )
     return False
 

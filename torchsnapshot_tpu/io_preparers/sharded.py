@@ -301,7 +301,7 @@ class ShardedArrayIOPreparer:
         logical values. Conservative on every edge: a missing
         fingerprint, dtype difference, or a piece this rank cannot
         fingerprint locally means False (read normally)."""
-        from ..device_digest import device_fingerprints
+        from ..device_digest import fingerprints_match
 
         if dtype_to_string(obj_out.dtype) != entry.dtype:
             return False
@@ -313,16 +313,22 @@ class ShardedArrayIOPreparer:
                 s.array.device_digest is None for s in entry.shards
             ):
                 return False
-            slices = [
-                obj_out[
-                    tuple(slice(o, o + sz) for o, sz in zip(s.offsets, s.sizes))
-                ]
-                for s in entry.shards
-            ]
-            # Batched: all fingerprints dispatch before the first fetch.
-            fps = device_fingerprints(slices)
-            return all(
-                fp == s.array.device_digest for fp, s in zip(fps, entry.shards)
+            # Windowed: a few piece slices live at a time (dispatched
+            # together per window, dropped before the next window), so
+            # verification never duplicates the array's footprint.
+            return fingerprints_match(
+                (
+                    (
+                        lambda s=s: obj_out[
+                            tuple(
+                                slice(o, o + sz)
+                                for o, sz in zip(s.offsets, s.sizes)
+                            )
+                        ],
+                        s.array.device_digest,
+                    )
+                    for s in entry.shards
+                )
             )
         # Multi-process: only shard.data (single-device) is sliceable.
         # Verify every piece overlapping an addressable box; each must be
@@ -360,12 +366,16 @@ class ShardedArrayIOPreparer:
                 for (lo, hi), (blo, _) in zip(piece, container)
             )
             to_check.append(
-                (local[container][local_slices], shard.array.device_digest)
+                (
+                    lambda c=container, ls=local_slices: local[c][ls],
+                    shard.array.device_digest,
+                )
             )
         if not to_check:
             return False
-        fps = device_fingerprints([arr for arr, _ in to_check])
-        return all(fp == want for fp, (_, want) in zip(fps, to_check))
+        # Thunks: slices materialize windowed inside fingerprints_match,
+        # never all at once.
+        return fingerprints_match(to_check)
 
     @classmethod
     def prepare_read(

@@ -110,7 +110,17 @@ class CheckpointManager:
         self.keep_every = keep_every
         self.async_save = async_save
         self.incremental = incremental
-        self.device_digests = device_digests
+        # Resolved ONCE, here: an explicit option wins, else the
+        # TORCHSNAPSHOT_TPU_DEVICE_DIGESTS env fallback is read now and
+        # the resolved bool is passed through to every take/restore — so
+        # warmup (pool sizing, fingerprint jit pre-compiles) and the
+        # saves it warms can never disagree if the env var changes
+        # between the two calls.
+        if device_digests is None:
+            from .device_digest import enabled_by_env
+
+            device_digests = enabled_by_env()
+        self.device_digests = bool(device_digests)
         self.compression = compression
         self.save_dtype = save_dtype
         self.replicated = replicated
@@ -186,9 +196,9 @@ class CheckpointManager:
         (dedup digesting, codec compression, fingerprint recording) never
         draw from the pool, so warming it would pin memory no save
         uses."""
-        if self._device_digests_effective():
+        if self.device_digests:
             self._warmup_fingerprints(app_state)
-        if self.incremental or self.compression or self._device_digests_effective():
+        if self.incremental or self.compression or self.device_digests:
             return 0
         from .io_preparers.array import warmup_staging
 
@@ -198,16 +208,6 @@ class CheckpointManager:
             replicated=self.replicated,
             save_dtype=self.save_dtype,
         )
-
-    def _device_digests_effective(self) -> bool:
-        """The flag the SAVE path will resolve: the explicit option, else
-        the TORCHSNAPSHOT_TPU_DEVICE_DIGESTS env fallback (matching
-        Snapshot._take_impl)."""
-        if self.device_digests is not None:
-            return bool(self.device_digests)
-        from .device_digest import enabled_by_env
-
-        return enabled_by_env()
 
     def _warmup_fingerprints(self, app_state: AppState) -> None:
         """Compile fingerprint jits for every piece the save will hash
