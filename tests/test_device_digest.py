@@ -681,3 +681,38 @@ def test_device_dedup_none_checksum_warns_once(tmp_path, monkeypatch, caplog):
             record_digests=True,
         )
     assert not [r for r in caplog.records if "checksum" in r.message.lower()]
+
+
+def test_fingerprints_match_byte_budget():
+    """The window also closes on a BYTE budget: sharded pieces have no
+    512 MB cap, so a count-only window could hold an array's whole
+    footprint in slice copies. An over-budget slice goes alone; a slice
+    that overflows a non-empty window is carried to the next one."""
+    from torchsnapshot_tpu.device_digest import fingerprints_match
+
+    arrs = [jnp.full((256,), i, jnp.float32) for i in range(6)]  # 1 KB each
+    fps = [device_fingerprint(a) for a in arrs]
+    live = []
+
+    def pairs():
+        return [
+            (lambda i=i, a=a: (live.append(i), a)[1], fp)
+            for i, (a, fp) in enumerate(zip(arrs, fps))
+        ]
+
+    # Budget of ~1.5 slices: every window carries its second slice over,
+    # so each slice is materialized at most twice and all still verify.
+    live.clear()
+    assert fingerprints_match(pairs(), window=4, window_bytes=1536)
+    assert set(live) == set(range(6))
+
+    # Budget smaller than one slice: each goes alone, still verifies.
+    assert fingerprints_match(pairs(), window=4, window_bytes=16)
+
+    # Mismatch under byte-budgeting still fails.
+    bad = pairs()
+    bad[5] = (bad[5][0], "xxh4x32:" + "0" * 32)
+    assert not fingerprints_match(bad, window=4, window_bytes=1536)
+
+    with pytest.raises(ValueError):
+        fingerprints_match(pairs(), window=0)

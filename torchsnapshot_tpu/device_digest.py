@@ -28,7 +28,6 @@ match recomputations on another.
 
 from __future__ import annotations
 
-import itertools
 import os
 from typing import Optional
 
@@ -164,9 +163,14 @@ def _finalize_from_nbytes(nbytes: int, pending) -> str:
     return PREFIX + ":" + "".join(f"{int(v):08x}" for v in final)
 
 
+def _nbytes(arr) -> int:
+    return int(np.dtype(arr.dtype).itemsize) * int(
+        np.prod(arr.shape, dtype=np.int64)
+    )
+
+
 def _finalize(arr, pending) -> str:
-    nbytes = int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape, dtype=np.int64))
-    return _finalize_from_nbytes(nbytes, pending)
+    return _finalize_from_nbytes(_nbytes(arr), pending)
 
 
 def device_fingerprint(arr) -> Optional[str]:
@@ -183,53 +187,74 @@ def device_fingerprint(arr) -> Optional[str]:
     return _finalize(arr, pending)
 
 
-# Restore-side verification window: slices per in-flight batch. Small
-# enough that transient slice copies never approach the array's own
-# footprint (chunks are <=512 MB, so <=4 slices is <=2 GB transient at
-# the pathological maximum, and typically far less), large enough to
-# amortize the host<->device roundtrip across a window.
+# Restore-side verification window: at most MATCH_WINDOW slices AND
+# MATCH_WINDOW_BYTES of slice data in flight per batch. The count bound
+# amortizes the host<->device roundtrip; the BYTE bound is what actually
+# limits transient device memory — sharded pieces (unlike <=512 MB
+# chunks) have no size cap of their own, so a count-only window could
+# still hold the whole array's footprint in slice copies.
 MATCH_WINDOW = 4
+MATCH_WINDOW_BYTES = 512 * 1024 * 1024
 
 
-def fingerprints_match(pairs, window: int = MATCH_WINDOW) -> bool:
+def fingerprints_match(
+    pairs, window: int = MATCH_WINDOW, window_bytes: int = MATCH_WINDOW_BYTES
+) -> bool:
     """Bounded-memory fingerprint comparison for restore-side skips.
 
     ``pairs`` is an iterable of ``(get_slice, expected)`` where
     ``get_slice`` is a thunk producing the device slice to verify and
-    ``expected`` the manifest-recorded digest. At most ``window`` slices
-    are live at once: each window's fingerprints dispatch together before
-    the first 16-byte fetch — ~one host<->device roundtrip per window,
-    not per slice (the roundtrip, not the hash, dominates for small/
-    medium slices on tunneled links) — then the slice references are
-    dropped before the next window materializes, so verifying a chunked/
-    sharded array never transiently duplicates its whole footprint in
-    device memory, only ``window`` pieces of it. Returns False on the
-    first mismatch or unfingerprintable slice (callers fall back to a
-    normal read); remaining windows are never materialized.
+    ``expected`` the manifest-recorded digest. A window of slices is
+    dispatched together before the first 16-byte fetch — ~one
+    host<->device roundtrip per window, not per slice (the roundtrip,
+    not the hash, dominates for small/medium slices on tunneled links) —
+    then the slice references are dropped before the next window
+    materializes. A window closes at ``window`` slices or once it holds
+    ``window_bytes`` of slice data, whichever comes first (a single
+    over-budget slice still goes alone), so verification transiently
+    holds at most ~window_bytes of copied slices, never the array's
+    whole footprint. Returns False on the first mismatch or
+    unfingerprintable slice (callers fall back to a normal read);
+    remaining windows are never materialized.
     """
     if window < 1:
-        # islice(it, 0) would yield an empty first batch and return True
-        # with ZERO verification — a silent skip of arbitrary content.
+        # An empty first window would return True with ZERO verification
+        # — a silent skip of arbitrary content.
         raise ValueError(f"window must be >= 1, got {window}")
     it = iter(pairs)
+    carried = None  # the pair that overflowed the previous window's budget
     while True:
-        batch = list(itertools.islice(it, window))
-        if not batch:
-            return True
         pendings = []
-        for get_slice, expected in batch:
+        batch_bytes = 0
+        while len(pendings) < window and batch_bytes < window_bytes:
+            if carried is not None:
+                get_slice, expected = carried
+                carried = None
+            else:
+                try:
+                    get_slice, expected = next(it)
+                except StopIteration:
+                    break
             arr = get_slice()
+            nbytes = _nbytes(arr)
+            if pendings and batch_bytes + nbytes > window_bytes:
+                # Over budget with work already in flight: finalize the
+                # current window first. The slice is re-materialized next
+                # window (thunks are cheap; device slices are lazy views
+                # until dispatched).
+                del arr
+                carried = (get_slice, expected)
+                break
             pending = _dispatch(arr)
             if pending is None:
                 return False
-            nbytes = int(np.dtype(arr.dtype).itemsize) * int(
-                np.prod(arr.shape, dtype=np.int64)
-            )
             # Keep only (pending, nbytes): the slice buffer itself can be
             # freed as soon as the jit consumes it.
             pendings.append((pending, nbytes, expected))
+            batch_bytes += nbytes
             del arr
-        del batch
+        if not pendings:
+            return True
         for pending, nbytes, expected in pendings:
             if _finalize_from_nbytes(nbytes, pending) != expected:
                 return False

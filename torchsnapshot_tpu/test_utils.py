@@ -88,6 +88,38 @@ def rand_array(dtype_str: str, shape=(8, 8), seed: int = 0) -> np.ndarray:
     return rng.standard_normal(shape).astype(dtype)
 
 
+def init_pod_world(rank: int, world_size: int, port: int, local_devices: int):
+    """Bring up a pod-shaped ``jax.distributed`` world in THIS process:
+    ``local_devices`` virtual CPU devices here, ``world_size *
+    local_devices`` devices globally. Must run before any jax device
+    access; rewrites any inherited ``xla_force_host_platform_device_count``
+    (the pytest conftest forces 8) to the requested per-process count.
+    Returns the initialized ``jax`` module."""
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flag = f"--xla_force_host_platform_device_count={local_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=world_size,
+        process_id=rank,
+    )
+    assert len(jax.local_devices()) == local_devices
+    assert len(jax.devices()) == world_size * local_devices
+    return jax
+
+
 # ---------------------------------------------------------------- launcher
 
 
