@@ -207,3 +207,148 @@ def test_liveness_does_not_overwrite_existing_key(store) -> None:
     c1.close()
     time.sleep(0.3)
     assert store.get("death/one", timeout=5.0) == b"already-there"
+
+
+# ------------------------------------------------ store-server SPOF story
+
+
+def _host_store_and_block(port_q):
+    """Subprocess: host a store server, report its port, then sleep until
+    killed (the server thread keeps serving)."""
+    s = TCPStore("127.0.0.1", is_server=True, timeout=60.0)
+    port_q.put(s.port)
+    time.sleep(600)
+
+
+def test_server_death_fails_blocked_clients_fast() -> None:
+    """When the store-HOSTING process dies, a client blocked in a
+    long-timeout get raises within seconds — naming the store host — not
+    after the 1800 s barrier timeout (the SPOF the reference's
+    rank-0-hosted TCPStore shares, dist_store.py:53-88)."""
+    import multiprocessing as mp
+
+    from torchsnapshot_tpu.dist_store import StoreConnectionLostError
+
+    ctx = mp.get_context("spawn")
+    port_q = ctx.Queue()
+    server_proc = ctx.Process(target=_host_store_and_block, args=(port_q,))
+    server_proc.start()
+    try:
+        port = port_q.get(timeout=30)
+        client = TCPStore("127.0.0.1", port)
+        client.set("warm", b"1")  # the connection works
+
+        failed_at = {}
+
+        def blocked_get():
+            t0 = time.monotonic()
+            try:
+                client.get("never-set", timeout=120.0)
+            except StoreConnectionLostError as e:
+                failed_at["elapsed"] = time.monotonic() - t0
+                failed_at["msg"] = str(e)
+
+        t = threading.Thread(target=blocked_get)
+        t.start()
+        time.sleep(0.5)  # let the get block server-side
+        server_proc.kill()
+        t.join(timeout=30)
+        assert not t.is_alive(), "blocked get did not fail after server death"
+        assert failed_at["elapsed"] < 10.0, failed_at
+        assert f"127.0.0.1:{port}" in failed_at["msg"]
+        assert "rank 0" in failed_at["msg"]
+
+        # Subsequent ops fail fast instead of re-blocking.
+        t0 = time.monotonic()
+        with pytest.raises(StoreConnectionLostError):
+            client.set("more", b"1")
+        assert time.monotonic() - t0 < 1.0
+        # A clone (the async-commit thread's path) also fails by name —
+        # including against the loopback ephemeral SELF-CONNECT trap
+        # (connecting to the dead server's freed ephemeral port can
+        # TCP-simultaneous-open onto itself and "succeed"; TCPStore
+        # detects and refuses it).
+        with pytest.raises(StoreConnectionLostError):
+            client.clone()
+    finally:
+        if server_proc.is_alive():
+            server_proc.kill()
+        server_proc.join(timeout=10)
+
+
+def test_unresponsive_server_hits_response_deadline(monkeypatch) -> None:
+    """A wedged server (host alive, process stuck): detected at CONNECT
+    time by the probe round-trip, and mid-session by the per-request
+    response deadline — never an infinite hang."""
+    import socket as socket_mod
+
+    from torchsnapshot_tpu import dist_store
+    from torchsnapshot_tpu.dist_store import (
+        StoreConnectionLostError,
+        _recv_msg,
+        _send_msg,
+    )
+
+    monkeypatch.setattr(dist_store, "STORE_RPC_TIMEOUT_S", 1.0)
+    monkeypatch.setattr(dist_store, "RPC_GRACE_S", 1.0)
+    monkeypatch.setattr(dist_store, "CONNECT_TIMEOUT_S", 2.0)
+
+    # --- never responds at all: the connect-time probe rejects it.
+    lsock = socket_mod.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    t = threading.Thread(target=lambda: lsock.accept(), daemon=True)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            TCPStore("127.0.0.1", port)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        lsock.close()
+
+    # --- wedges AFTER the handshake: the response deadline converts the
+    # hang into StoreConnectionLostError, bounded by the op's own
+    # timeout + grace (blocking ops) or the quick-op RPC deadline.
+    lsock2 = socket_mod.socket()
+    lsock2.bind(("127.0.0.1", 0))
+    lsock2.listen(8)
+    port2 = lsock2.getsockname()[1]
+
+    def answer_probe_then_wedge():
+        while True:
+            try:
+                conn, _ = lsock2.accept()
+            except OSError:
+                return
+            _recv_msg(conn)  # the probe
+            _send_msg(conn, {"ok": True, "value": False})
+            # ...then go silent forever (but keep the socket open).
+
+    t2 = threading.Thread(target=answer_probe_then_wedge, daemon=True)
+    t2.start()
+    try:
+        client = TCPStore("127.0.0.1", port2)
+        t0 = time.monotonic()
+        with pytest.raises(StoreConnectionLostError):
+            client.set("k", b"v")  # quick op: STORE_RPC_TIMEOUT_S bound
+        assert time.monotonic() - t0 < 5.0
+
+        client2 = TCPStore("127.0.0.1", port2)
+        t0 = time.monotonic()
+        with pytest.raises(StoreConnectionLostError):
+            client2.get("k", timeout=1.0)  # op timeout + grace bound
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        lsock2.close()
+
+
+def test_op_timeout_is_not_connection_loss(store) -> None:
+    """A server-side op timeout (key never appears) stays a TimeoutError
+    and the connection REMAINS usable — only server silence/death maps
+    to StoreConnectionLostError."""
+    with pytest.raises(TimeoutError):
+        store.get("never-set", timeout=0.3)
+    store.set("after", b"1")  # connection still fine
+    assert store.get("after") == b"1"

@@ -12,5 +12,6 @@ try:
     from .manager import CheckpointManager  # noqa: F401
     from .preemption import PreemptionWatcher, simulate_preemption_now  # noqa: F401
     from .io_preparers.array import warmup_staging  # noqa: F401
+    from .dist_store import StoreConnectionLostError  # noqa: F401
 except ImportError:  # pragma: no cover - during incremental bring-up only
     pass

@@ -16,6 +16,7 @@ checkpoint scale (one client per process, metadata-sized payloads).
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import socket
 import struct
@@ -26,11 +27,59 @@ from typing import Any, Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+# Client-side response deadlines: the store SERVER is itself a peer that
+# can die (it lives in rank 0's process — the same SPOF the reference's
+# rank-0-hosted TCPStore has, dist_store.py:53-88). A killed server
+# process RSTs its sockets and clients fail instantly; a SILENTLY dead
+# host (power loss, network partition) sends nothing, so without a
+# deadline a blocked recv would hang forever. Every request therefore
+# bounds the wait for the server's response:
+#   - ops that carry their own timeout (get/wait_any/collect) wait
+#     op_timeout + RPC_GRACE_S (the server answers "timeout" at
+#     op_timeout; the grace covers scheduling + network),
+#   - quick ops (set/add/mset/...) wait STORE_RPC_TIMEOUT_S (in-memory
+#     ops; generous for a loaded single-core host).
+# TCP keepalive (~20 s of silence) and TCP_USER_TIMEOUT (~20 s unacked
+# data) additionally tear down the connection under long-deadline
+# blocking ops, so silent server death surfaces in tens of seconds, not
+# at the 1800 s barrier timeout.
+RPC_GRACE_S = 30.0
+STORE_RPC_TIMEOUT_S = float(
+    os.environ.get("TORCHSNAPSHOT_TPU_STORE_RPC_TIMEOUT", "120")
+)
+CONNECT_TIMEOUT_S = 30.0
 # Failure-detection channel shared with pg_wrapper: the server publishes
 # this key when a liveness-registered connection (one per rank) drops
 # without a clean deregister. Collective waits watch it.
 DEATH_KEY = "pgw/death"
 _LEN = struct.Struct(">Q")
+
+
+class StoreConnectionLostError(ConnectionError):
+    """The coordination KV store is unreachable — its hosting process
+    (rank 0 / the snapshot leader) has likely died.
+
+    Raised by every blocked or subsequent store operation on this client
+    within seconds of the loss (RST from a killed process, TCP keepalive
+    or the per-request response deadline for a silent host). Nothing was
+    committed: the metadata-last protocol means an in-flight snapshot
+    whose coordination plane died is simply absent. Recovery: restart
+    the world — a fresh store is bootstrapped by the new rank 0 — and
+    restore from the last committed snapshot (docs: elasticity.rst,
+    "Coordination-plane failure").
+    """
+
+    def __init__(self, addr: str, op: str, cause: BaseException) -> None:
+        super().__init__(
+            f"Lost connection to the coordination store at {addr} during "
+            f"{op!r} ({type(cause).__name__}: {cause}). The store-hosting "
+            "process (rank 0, the snapshot leader) has likely died; "
+            "in-flight snapshot coordination on this rank is aborted and "
+            "nothing was committed. Restart the world and restore from "
+            "the last committed snapshot."
+        )
+        self.addr = addr
+        self.op = op
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:
@@ -235,17 +284,95 @@ class TCPStore:
         self.port = port
         self.timeout = timeout
         self._lock = threading.Lock()
-        self._sock = socket.create_connection((host, port), timeout=None)
+        self._dead: Optional[StoreConnectionLostError] = None
+        self._sock = socket.create_connection(
+            (host, port), timeout=CONNECT_TIMEOUT_S
+        )
+        # A TCP connect alone does not prove a STORE is on the other end:
+        # on loopback, connecting to a freed ephemeral port (a dead store
+        # host's port is the classic case) can simultaneous-open onto
+        # itself or yield a phantom connection that dies on first use.
+        # Validate with one probe round-trip: only a real server answers
+        # it correctly (a self-connect echoes our own request back, which
+        # fails the response check).
+        try:
+            if self._sock.getsockname() == self._sock.getpeername():
+                raise ConnectionRefusedError(
+                    f"self-connect to {host}:{port} (no server listening)"
+                )
+            _send_msg(self._sock, {"op": "check", "key": "__conn_probe__"})
+            resp = _recv_msg(self._sock)
+            if not isinstance(resp, dict) or "ok" not in resp:
+                raise ConnectionRefusedError(
+                    f"{host}:{port} did not answer the store probe "
+                    "(not a store server)"
+                )
+        except (ConnectionError, EOFError, OSError):
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
+        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Silent-death detection at the TCP layer (a killed process RSTs
+        # and needs none of this; these cover power loss / partitions):
+        # - keepalive (idle 5 s + 3 probes x 5 s = ~20 s) tears down
+        #   connections idle in a blocked recv;
+        # - TCP_USER_TIMEOUT (~20 s) covers the case keepalive cannot:
+        #   request bytes sent but never ACKed (keepalive probes are
+        #   suppressed while data is outstanding — without this, that
+        #   path would ride retransmission backoff for ~15 minutes).
+        # Both land long before the 1800 s barrier timeout.
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        for opt, val in (
+            ("TCP_KEEPIDLE", 5),
+            ("TCP_KEEPINTVL", 5),
+            ("TCP_KEEPCNT", 3),
+            ("TCP_USER_TIMEOUT", 20_000),  # milliseconds
+        ):
+            if hasattr(socket, opt):  # Linux; harmless to skip elsewhere
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, getattr(socket, opt), val
+                )
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
 
     def _request(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op_timeout = req.get("timeout")
+        # How long the CLIENT waits for the server's response: the op's
+        # own timeout (server answers "timeout" at that point) plus
+        # grace, or the quick-op RPC deadline. A deadline expiring here
+        # means the SERVER went silent, not that the op timed out.
+        response_deadline = (
+            op_timeout + RPC_GRACE_S
+            if op_timeout is not None
+            else STORE_RPC_TIMEOUT_S
+        )
         with self._lock:
-            _send_msg(self._sock, req)
-            resp = _recv_msg(self._sock)
+            if self._dead is not None:
+                # The connection is gone (and mid-message state would be
+                # corrupt anyway): every subsequent op fails fast.
+                raise self._dead
+            try:
+                self._sock.settimeout(response_deadline)
+                _send_msg(self._sock, req)
+                resp = _recv_msg(self._sock)
+                self._sock.settimeout(None)
+            except (ConnectionError, EOFError, OSError) as e:
+                # socket.timeout is an OSError subclass, so a silent
+                # server (deadline) and a dead one (RST/FIN) both land
+                # here; keepalive converts long silences into errors too.
+                self._dead = StoreConnectionLostError(
+                    self.addr, req["op"], e
+                )
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise self._dead from e
         if resp.get("timeout"):
             raise TimeoutError(
                 f"Store operation {req['op']!r} on {req.get('key') or req.get('keys')} "
@@ -332,7 +459,14 @@ class TCPStore:
 
     def clone(self) -> "TCPStore":
         """A new connection to the same server (for use from another thread)."""
-        return TCPStore(self.host, self.port, is_server=False, timeout=self.timeout)
+        try:
+            return TCPStore(
+                self.host, self.port, is_server=False, timeout=self.timeout
+            )
+        except OSError as e:
+            # The server is already gone (refused / connect deadline):
+            # name the store host instead of a bare socket error.
+            raise StoreConnectionLostError(self.addr, "clone", e) from e
 
     def close(self) -> None:
         try:

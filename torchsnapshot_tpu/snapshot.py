@@ -1393,18 +1393,21 @@ class PendingSnapshot:
         barrier_timeout_s: float,
     ) -> None:
         barrier = None
-        if pg_wrapper.get_world_size() > 1:
-            # Own store connection: the main thread keeps using the primary.
-            store = pg_wrapper.pg.store.clone()
-            # Nested under the wrapper's namespace so the barrier keys are
-            # reclaimed together with it once every rank retires.
-            barrier = LinearBarrier(
-                prefix=f"{pg_wrapper._namespace()}/commit/{barrier_id}",
-                store=store,
-                rank=pg_wrapper.get_rank(),
-                world_size=pg_wrapper.get_world_size(),
-            )
         try:
+            if pg_wrapper.get_world_size() > 1:
+                # Own store connection: the main thread keeps using the
+                # primary. Inside the try: a dead store host (clone raises
+                # StoreConnectionLostError) must reach wait() as _exc, not
+                # kill this thread with _done never set.
+                store = pg_wrapper.pg.store.clone()
+                # Nested under the wrapper's namespace so the barrier keys
+                # are reclaimed together with it once every rank retires.
+                barrier = LinearBarrier(
+                    prefix=f"{pg_wrapper._namespace()}/commit/{barrier_id}",
+                    store=store,
+                    rank=pg_wrapper.get_rank(),
+                    world_size=pg_wrapper.get_world_size(),
+                )
             pending_io_work.sync_complete(event_loop)
             _drain_background_storage(storage, event_loop)
             if self._timer is not None:

@@ -172,11 +172,23 @@ def _find_free_port() -> int:
 
 
 def run_with_subprocesses(
-    fn: Callable, world_size: int, *args: Any, timeout: float = 180.0
+    fn: Callable,
+    world_size: int,
+    *args: Any,
+    timeout: float = 180.0,
+    expect_dead: Tuple[int, ...] = (),
 ) -> Dict[int, Any]:
     """Run ``fn(rank, world_size, *args)`` in ``world_size`` subprocesses with
     a shared KV-store rendezvous. Returns {rank: result}; raises on any
-    rank's failure (reference analogue: test_utils.py:166-205)."""
+    rank's failure (reference analogue: test_utils.py:166-205).
+
+    ``expect_dead``: ranks the TEST kills (e.g. SIGKILL drills on the
+    store host). They are not required to report a result; the launcher
+    returns once every other rank has reported and the expected-dead
+    processes have exited. An expected-dead rank that DOES report is
+    included in the results (the caller asserts on what it sees)."""
+    import time as _time
+
     ctx = mp.get_context("spawn")
     result_queue = ctx.Queue()
     port = _find_free_port()
@@ -191,18 +203,32 @@ def run_with_subprocesses(
         p.start()
         procs.append(p)
 
+    dead_set = set(expect_dead)
+    survivors = set(range(world_size)) - dead_set
     results: Dict[int, Any] = {}
     errors = []
-    for _ in range(world_size):
+    deadline = _time.monotonic() + timeout
+    while len(results) + len(errors) < world_size:
+        # Only SURVIVOR reports satisfy the early exit: an expected-dead
+        # rank may report before its kill lands, and counting that report
+        # must not let the launcher break before every survivor does.
+        reported = {r for r in results} | {r for r, _ in errors}
+        if (
+            survivors <= reported
+            and all(not procs[r].is_alive() for r in dead_set)
+        ):
+            break  # every surviving rank reported; the doomed ones died
         try:
-            rank, status, payload = result_queue.get(timeout=timeout)
+            rank, status, payload = result_queue.get(timeout=1.0)
         except Exception:
-            for p in procs:
-                p.terminate()
-            raise TimeoutError(
-                f"Multi-process test timed out after {timeout}s; "
-                f"got results from ranks {sorted(results)} of {world_size}."
-            )
+            if _time.monotonic() > deadline:
+                for p in procs:
+                    p.terminate()
+                raise TimeoutError(
+                    f"Multi-process test timed out after {timeout}s; "
+                    f"got results from ranks {sorted(results)} of {world_size}."
+                )
+            continue
         if status == "ok":
             results[rank] = payload
         else:
