@@ -588,24 +588,28 @@ def test_fingerprints_match_windowed_correctness():
 
     calls = []
 
-    def pairs(bad_at=None):
+    def items(bad_at=None):
         out = []
         for i, (a, fp) in enumerate(zip(arrs, fps)):
             want = "xxh4x32:" + "0" * 32 if i == bad_at else fp
-            out.append((lambda i=i, a=a: (calls.append(i), a)[1], want))
+            out.append(
+                (a.nbytes, lambda i=i, a=a: (calls.append(i), a)[1], want)
+            )
         return out
 
     calls.clear()
-    assert fingerprints_match(pairs(), window=3)
+    assert fingerprints_match(items(), window=3)
     assert calls == list(range(10))  # all verified, in order
 
     # Mismatch in the first window: later windows never materialize.
     calls.clear()
-    assert not fingerprints_match(pairs(bad_at=1), window=3)
+    assert not fingerprints_match(items(bad_at=1), window=3)
     assert max(calls) <= 2  # only the first window's slices were touched
 
     # An unfingerprintable slice (numpy, not jax) also fails closed.
-    assert not fingerprints_match([(lambda: np.zeros(4), "xxh4x32:" + "0" * 32)])
+    assert not fingerprints_match(
+        [(16, lambda: np.zeros(4), "xxh4x32:" + "0" * 32)]
+    )
 
     # Empty iterable is vacuously True (callers guard non-emptiness).
     assert fingerprints_match([])
@@ -687,32 +691,38 @@ def test_fingerprints_match_byte_budget():
     """The window also closes on a BYTE budget: sharded pieces have no
     512 MB cap, so a count-only window could hold an array's whole
     footprint in slice copies. An over-budget slice goes alone; a slice
-    that overflows a non-empty window is carried to the next one."""
+    that overflows a non-empty window is carried to the next one —
+    WITHOUT being materialized twice (sizes come from the manifest, so
+    the budget check precedes the slice thunk)."""
     from torchsnapshot_tpu.device_digest import fingerprints_match
 
     arrs = [jnp.full((256,), i, jnp.float32) for i in range(6)]  # 1 KB each
     fps = [device_fingerprint(a) for a in arrs]
     live = []
 
-    def pairs():
+    def items():
         return [
-            (lambda i=i, a=a: (live.append(i), a)[1], fp)
+            (a.nbytes, lambda i=i, a=a: (live.append(i), a)[1], fp)
             for i, (a, fp) in enumerate(zip(arrs, fps))
         ]
 
-    # Budget of ~1.5 slices: every window carries its second slice over,
-    # so each slice is materialized at most twice and all still verify.
+    # Budget of ~1.5 slices: every window carries its second slice over;
+    # each slice is materialized EXACTLY once and all still verify.
     live.clear()
-    assert fingerprints_match(pairs(), window=4, window_bytes=1536)
-    assert set(live) == set(range(6))
+    assert fingerprints_match(items(), window=4, window_bytes=1536)
+    assert live == list(range(6))
 
     # Budget smaller than one slice: each goes alone, still verifies.
-    assert fingerprints_match(pairs(), window=4, window_bytes=16)
+    live.clear()
+    assert fingerprints_match(items(), window=4, window_bytes=16)
+    assert live == list(range(6))
 
     # Mismatch under byte-budgeting still fails.
-    bad = pairs()
-    bad[5] = (bad[5][0], "xxh4x32:" + "0" * 32)
+    bad = items()
+    bad[5] = (bad[5][0], bad[5][1], "xxh4x32:" + "0" * 32)
     assert not fingerprints_match(bad, window=4, window_bytes=1536)
 
     with pytest.raises(ValueError):
-        fingerprints_match(pairs(), window=0)
+        fingerprints_match(items(), window=0)
+    with pytest.raises(ValueError):
+        fingerprints_match(items(), window_bytes=0)

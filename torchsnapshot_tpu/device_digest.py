@@ -198,53 +198,56 @@ MATCH_WINDOW_BYTES = 512 * 1024 * 1024
 
 
 def fingerprints_match(
-    pairs, window: int = MATCH_WINDOW, window_bytes: int = MATCH_WINDOW_BYTES
+    items, window: int = MATCH_WINDOW, window_bytes: int = MATCH_WINDOW_BYTES
 ) -> bool:
     """Bounded-memory fingerprint comparison for restore-side skips.
 
-    ``pairs`` is an iterable of ``(get_slice, expected)`` where
-    ``get_slice`` is a thunk producing the device slice to verify and
-    ``expected`` the manifest-recorded digest. A window of slices is
-    dispatched together before the first 16-byte fetch — ~one
+    ``items`` is an iterable of ``(nbytes, get_slice, expected)``:
+    ``nbytes`` the slice's byte size (callers know it from the manifest
+    geometry — shapes x dtype — without touching the device; it must
+    equal the materialized slice's size, since the digest folds the
+    length in), ``get_slice`` a thunk producing the device slice to
+    verify, ``expected`` the manifest-recorded digest. A window of
+    slices is dispatched together before the first 16-byte fetch — ~one
     host<->device roundtrip per window, not per slice (the roundtrip,
     not the hash, dominates for small/medium slices on tunneled links) —
     then the slice references are dropped before the next window
-    materializes. A window closes at ``window`` slices or once it holds
-    ``window_bytes`` of slice data, whichever comes first (a single
-    over-budget slice still goes alone), so verification transiently
-    holds at most ~window_bytes of copied slices, never the array's
-    whole footprint. Returns False on the first mismatch or
-    unfingerprintable slice (callers fall back to a normal read);
-    remaining windows are never materialized.
+    materializes. A window closes at ``window`` slices or before the
+    slice that would push it past ``window_bytes`` (a single over-budget
+    slice still goes alone); the budget check runs BEFORE ``get_slice``,
+    so nothing is materialized twice and transient device memory never
+    exceeds ~window_bytes of copied slices — not the array's whole
+    footprint. Returns False on the first mismatch or unfingerprintable
+    slice (callers fall back to a normal read); remaining windows are
+    never materialized.
     """
-    if window < 1:
+    if window < 1 or window_bytes < 1:
         # An empty first window would return True with ZERO verification
         # — a silent skip of arbitrary content.
-        raise ValueError(f"window must be >= 1, got {window}")
-    it = iter(pairs)
-    carried = None  # the pair that overflowed the previous window's budget
+        raise ValueError(
+            f"window and window_bytes must be >= 1, got {window}/{window_bytes}"
+        )
+    it = iter(items)
+    carried = None  # the item that overflowed the previous window's budget
     while True:
         pendings = []
         batch_bytes = 0
         while len(pendings) < window and batch_bytes < window_bytes:
             if carried is not None:
-                get_slice, expected = carried
+                nbytes, get_slice, expected = carried
                 carried = None
             else:
                 try:
-                    get_slice, expected = next(it)
+                    nbytes, get_slice, expected = next(it)
                 except StopIteration:
                     break
-            arr = get_slice()
-            nbytes = _nbytes(arr)
             if pendings and batch_bytes + nbytes > window_bytes:
                 # Over budget with work already in flight: finalize the
-                # current window first. The slice is re-materialized next
-                # window (thunks are cheap; device slices are lazy views
-                # until dispatched).
-                del arr
-                carried = (get_slice, expected)
+                # current window first. Nothing was materialized for this
+                # item yet — the size came from the manifest.
+                carried = (nbytes, get_slice, expected)
                 break
+            arr = get_slice()
             pending = _dispatch(arr)
             if pending is None:
                 return False

@@ -140,9 +140,12 @@ def _dst_already_matches(entry: Entry, obj_out: Any) -> bool:
         # verifying a chunked array — which only exists above 512 MB —
         # never transiently duplicates its whole footprint in device
         # memory the way a full eager slice list would.
+        from ..serialization import array_size_bytes
+
         return fingerprints_match(
             (
                 (
+                    array_size_bytes(c.sizes, entry.dtype),
                     lambda c=c: obj_out[
                         tuple(
                             slice(o, o + s)

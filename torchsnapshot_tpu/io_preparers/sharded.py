@@ -117,6 +117,32 @@ def _subdivide(
     return pieces
 
 
+def _make_assembler(local: Dict[Box, Any], overlaps, piece_shape):
+    """Thunk assembling a saved piece from this process's overlapping
+    shard regions, on ONE local device (cross-device moves are DtoD —
+    they ride ICI on TPU, never the host). Used by the restore-side
+    digest check to verify a piece that no single addressable shard
+    contains; called windowed by fingerprints_match, so at most a few
+    assembled pieces are live at a time. The caller guarantees the
+    overlap regions exactly cover the piece."""
+
+    def assemble():
+        import jax
+        import jax.numpy as jnp
+
+        (box0, (src0, dst0)), *rest = overlaps
+        part0 = local[box0][dst0] if dst0 else local[box0]
+        dev = next(iter(part0.devices())) if hasattr(part0, "devices") else None
+        piece = jax.device_put(jnp.zeros(piece_shape, part0.dtype), dev)
+        piece = piece.at[src0].set(part0)
+        for box, (src, dst) in rest:
+            part = local[box][dst] if dst else local[box]
+            piece = piece.at[src].set(jax.device_put(part, dev))
+        return piece
+
+    return assemble
+
+
 class _ShardScatterConsumer(BufferConsumer):
     """Reads one saved shard and scatters it into every overlapping region of
     the destination boxes."""
@@ -300,7 +326,18 @@ class ShardedArrayIOPreparer:
         local decision only keeps/rebuilds the local handle of the same
         logical values. Conservative on every edge: a missing
         fingerprint, dtype difference, or a piece this rank cannot
-        fingerprint locally means False (read normally)."""
+        fingerprint locally means False (read normally).
+
+        A piece is locally verifiable when it is contained in ONE
+        addressable shard (zero-copy slice) or, failing that, when the
+        UNION of this process's addressable shards covers it — the
+        overlap regions are stitched together on device and the
+        assembled piece fingerprinted (pod topologies: a process owning
+        several boxes can verify across a layout change, e.g. a serving
+        mesh transposed from the training mesh). Only a piece cut
+        across PROCESS boundaries still falls back to a normal read:
+        its digest covers the whole piece and no single process holds
+        all of its bytes."""
         from ..device_digest import fingerprints_match
 
         if dtype_to_string(obj_out.dtype) != entry.dtype:
@@ -319,6 +356,7 @@ class ShardedArrayIOPreparer:
             return fingerprints_match(
                 (
                     (
+                        array_size_bytes(s.sizes, entry.dtype),
                         lambda s=s: obj_out[
                             tuple(
                                 slice(o, o + sz)
@@ -331,27 +369,31 @@ class ShardedArrayIOPreparer:
                 )
             )
         # Multi-process: only shard.data (single-device) is sliceable.
-        # Verify every piece overlapping an addressable box; each must be
-        # fully contained in one addressable shard.
+        # Verify every piece overlapping an addressable box: contained in
+        # one shard -> zero-copy slice; covered by the UNION of local
+        # shards -> assembled on device; else unverifiable locally.
         local: Dict[Box, Any] = {}
         for s in obj_out.addressable_shards:
             local.setdefault(_normalize_index(s.index, shape), s.data)
-        to_check: List[Tuple[Any, str]] = []
+        to_check: List[Tuple[int, Any, str]] = []  # (nbytes, thunk, digest)
         for shard in entry.shards:
             piece: Box = tuple(
                 (o, o + sz) for o, sz in zip(shard.offsets, shard.sizes)
             )
-            overlapping = [
-                box
+            overlaps = [
+                (box, ov)
                 for box in local
-                if _overlap(shard.offsets, shard.sizes, box) is not None
+                for ov in (_overlap(shard.offsets, shard.sizes, box),)
+                if ov is not None
             ]
-            if not overlapping:
+            if not overlaps:
                 continue  # some other rank's piece
+            if shard.array.device_digest is None:
+                return False
             container = next(
                 (
                     box
-                    for box in overlapping
+                    for box, _ in overlaps
                     if all(
                         lo >= blo and hi <= bhi
                         for (lo, hi), (blo, bhi) in zip(piece, box)
@@ -359,22 +401,46 @@ class ShardedArrayIOPreparer:
                 ),
                 None,
             )
-            if container is None or shard.array.device_digest is None:
-                return False
-            local_slices = tuple(
-                slice(lo - blo, hi - blo)
-                for (lo, hi), (blo, _) in zip(piece, container)
+            if container is not None:
+                local_slices = tuple(
+                    slice(lo - blo, hi - blo)
+                    for (lo, hi), (blo, _) in zip(piece, container)
+                )
+                to_check.append(
+                    (
+                        array_size_bytes(shard.sizes, entry.dtype),
+                        lambda c=container, ls=local_slices: local[c][ls],
+                        shard.array.device_digest,
+                    )
+                )
+                continue
+            # Union coverage: distinct GSPMD boxes are disjoint, so the
+            # piece is fully covered iff the overlap volumes sum to its
+            # volume. A cell owned by another process means a shortfall
+            # -> this piece is unverifiable here (digest spans bytes this
+            # process doesn't hold).
+            piece_vol = int(np.prod(shard.sizes, dtype=np.int64))
+            covered = sum(
+                int(
+                    np.prod(
+                        [s.stop - s.start for s in src], dtype=np.int64
+                    )
+                )
+                for _, (src, _) in overlaps
             )
+            if covered != piece_vol:
+                return False
             to_check.append(
                 (
-                    lambda c=container, ls=local_slices: local[c][ls],
+                    array_size_bytes(shard.sizes, entry.dtype),
+                    _make_assembler(local, overlaps, tuple(shard.sizes)),
                     shard.array.device_digest,
                 )
             )
         if not to_check:
             return False
-        # Thunks: slices materialize windowed inside fingerprints_match,
-        # never all at once.
+        # Thunks: slices/assemblies materialize windowed inside
+        # fingerprints_match, never all at once.
         return fingerprints_match(to_check)
 
     @classmethod

@@ -185,8 +185,10 @@ def run_with_subprocesses(
     ``expect_dead``: ranks the TEST kills (e.g. SIGKILL drills on the
     store host). They are not required to report a result; the launcher
     returns once every other rank has reported and the expected-dead
-    processes have exited. An expected-dead rank that DOES report is
-    included in the results (the caller asserts on what it sees)."""
+    processes have exited (draining any report a doomed rank managed to
+    enqueue first). An expected-dead rank's "ok" report is included in
+    the results; its ERROR reports are dropped — a rank being killed is
+    expected to die messily, and its failure must not fail the test."""
     import time as _time
 
     ctx = mp.get_context("spawn")
@@ -208,6 +210,13 @@ def run_with_subprocesses(
     results: Dict[int, Any] = {}
     errors = []
     deadline = _time.monotonic() + timeout
+    def record(rank: int, status: str, payload: Any) -> None:
+        if status == "ok":
+            results[rank] = payload
+        elif rank not in dead_set:
+            errors.append((rank, payload))
+        # else: a doomed rank erroring while dying is expected noise
+
     while len(results) + len(errors) < world_size:
         # Only SURVIVOR reports satisfy the early exit: an expected-dead
         # rank may report before its kill lands, and counting that report
@@ -217,7 +226,16 @@ def run_with_subprocesses(
             survivors <= reported
             and all(not procs[r].is_alive() for r in dead_set)
         ):
-            break  # every surviving rank reported; the doomed ones died
+            # Doomed ranks are dead and every survivor reported: drain
+            # whatever a doomed rank enqueued before dying, then stop
+            # (the documented "a dead rank that DID report is included"
+            # contract must not race the kill).
+            while True:
+                try:
+                    record(*result_queue.get_nowait())
+                except Exception:
+                    break
+            break
         try:
             rank, status, payload = result_queue.get(timeout=1.0)
         except Exception:
@@ -229,10 +247,7 @@ def run_with_subprocesses(
                     f"got results from ranks {sorted(results)} of {world_size}."
                 )
             continue
-        if status == "ok":
-            results[rank] = payload
-        else:
-            errors.append((rank, payload))
+        record(rank, status, payload)
     for p in procs:
         p.join(timeout=30)
         if p.is_alive():
