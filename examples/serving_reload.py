@@ -29,7 +29,23 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The final act reshards across a 4-device mesh; give the CPU backend
+# virtual devices BEFORE jax initializes (a plain JAX_PLATFORMS=cpu run
+# has one device and would silently skip the demo's point).
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
 import jax
+
+# Honor the documented run command even when the interpreter pre-imported
+# jax aimed at an experimental platform: env vars are too late then, but
+# jax.config takes effect at first backend initialization.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
