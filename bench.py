@@ -201,6 +201,7 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     stage = legs.get("dma_overlap/stage")
     take = legs.get("dma_overlap/async_take")
     sync = legs.get("dma_overlap/sync_take")
+    ceiling = legs.get("dma_overlap/ceiling")
     if not (stage and take and sync):
         _log(f"TPU side-leg output incomplete ({sorted(legs)}); omitting")
         return None, False
@@ -208,8 +209,20 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
         "dma_overlap_ratio": stage["overlap_ratio"],
         "async_step_inflation": take["step_inflation"],
         "sync_take_mbps": sync["take_mbps"],
+        "sync_take_state_mb": sync.get("state_mb"),
         "sync_take_bit_exact": sync["bit_exact"],
     }
+    if ceiling is not None and ceiling.get("dtoh_ceiling_mbps") is not None:
+        # Normalized view: absolute MB/s through a tunneled relay
+        # measures the tunnel; achieved-%-of-(measured)-ceiling is the
+        # design number. >100% is possible — the pipeline overlaps many
+        # DtoH streams while the ceiling probe is one serial device_get.
+        # .get throughout: a partial/older ceiling record degrades to
+        # omitted fields, never a crash.
+        out["ceiling_gbps"] = round(ceiling["dtoh_ceiling_mbps"] / 1e3, 4)
+        out["host_memcpy_gbps"] = ceiling.get("host_memcpy_gbps")
+        out["achieved_pct"] = sync.get("take_pct_of_ceiling")
+        out["async_stage_pct_of_ceiling"] = stage.get("async_pct_of_ceiling")
     # Second side-leg: device-resident change detection (benchmarks/
     # device_dedup.py) — unchanged-resave speedup from skipping DtoH.
     # Optional: its absence never discards the DMA numbers above.
@@ -363,6 +376,9 @@ def main() -> None:
         "unit": "GB/s",
         "vs_baseline": round(gbps / REFERENCE_SAVE_GBPS, 2),
         "p50_gbps": round((nbytes / 1e9) / p50, 3),
+        # Raw trial walls: makes best-vs-p50 divergence auditable when a
+        # 1-core VM throws an outlier trial (page-cache effects).
+        "save_trials_s": [round(t, 3) for t in save_times],
         "restore_gbps": round((nbytes / 1e9) / min(restore_times), 3),
         "platform": jax.default_backend(),
     }

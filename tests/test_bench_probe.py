@@ -113,6 +113,36 @@ def test_force_cpu_env(monkeypatch):
 
 def test_tpu_hw_leg_parses_output(monkeypatch):
     out = (
+        '{"benchmark": "dma_overlap/ceiling", "dtoh_ceiling_mbps": 15.0, '
+        '"host_memcpy_gbps": 1.8}\n'
+        '{"benchmark": "dma_overlap/stage", "overlap_ratio": 1.8, '
+        '"async_pct_of_ceiling": 160.0}\n'
+        '{"benchmark": "dma_overlap/async_take", "step_inflation": 1.02}\n'
+        '{"benchmark": "dma_overlap/sync_take", "take_mbps": 12.4, '
+        '"state_mb": 600.0, "take_pct_of_ceiling": 82.7, '
+        '"bit_exact": true}\n'
+    )
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *a, **k: FakeResult(0, out)
+    )
+    summary, killed = bench._tpu_hw_leg()
+    assert not killed
+    assert summary == {
+        "dma_overlap_ratio": 1.8,
+        "async_step_inflation": 1.02,
+        "sync_take_mbps": 12.4,
+        "sync_take_state_mb": 600.0,
+        "sync_take_bit_exact": True,
+        "ceiling_gbps": 0.015,
+        "host_memcpy_gbps": 1.8,
+        "achieved_pct": 82.7,
+        "async_stage_pct_of_ceiling": 160.0,
+    }
+
+
+def test_tpu_hw_leg_without_ceiling_leg(monkeypatch):
+    """Older side-leg output (no ceiling record) still summarizes."""
+    out = (
         '{"benchmark": "dma_overlap/stage", "overlap_ratio": 1.8}\n'
         '{"benchmark": "dma_overlap/async_take", "step_inflation": 1.02}\n'
         '{"benchmark": "dma_overlap/sync_take", "take_mbps": 12.4, '
@@ -127,6 +157,7 @@ def test_tpu_hw_leg_parses_output(monkeypatch):
         "dma_overlap_ratio": 1.8,
         "async_step_inflation": 1.02,
         "sync_take_mbps": 12.4,
+        "sync_take_state_mb": None,
         "sync_take_bit_exact": True,
     }
 

@@ -42,7 +42,10 @@ def _state_for(step: int, rank: int):
     base = np.arange(64 * 32, dtype=np.float32).reshape(SHAPE)
     return {
         "train": {
-            "w": jnp.asarray(base + step),  # per-rank device state
+            # Rank mixed into the VALUE: restore verification would miss
+            # a payload routed to the wrong rank if both ranks held
+            # identical bytes.
+            "w": jnp.asarray(base + step + 100_000 * rank),
             "host": base * 2 + step,  # replicated host state
             "step": step,
         }
