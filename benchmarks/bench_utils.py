@@ -30,7 +30,9 @@ def report(name: str, result: Dict[str, Any], data_bytes: int | None = None) -> 
     out = {"benchmark": name, **result}
     if data_bytes is not None and result.get("wall_s"):
         out["gbps"] = round(data_bytes / 1e9 / result["wall_s"], 3)
-    print(json.dumps(out))
+    # flush: completed legs must survive a later leg being killed at a
+    # timeout (block-buffered stdout to a pipe/file would lose them all).
+    print(json.dumps(out), flush=True)
 
 
 def force_cpu_devices(n: int = 8) -> None:

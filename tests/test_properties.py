@@ -486,3 +486,46 @@ def test_device_fingerprint_properties(dtype_str, shape, seed, data) -> None:
     raw[idx] ^= 1
     mutated = raw.view(np_dtype).reshape(shape)
     assert device_fingerprint(jnp.asarray(mutated)) != fp
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    sizes=st.lists(st.integers(min_value=1, max_value=512), min_size=1, max_size=12),
+    window=st.integers(min_value=1, max_value=6),
+    window_bytes=st.integers(min_value=1, max_value=4096),
+    bad_at=st.one_of(st.none(), st.integers(min_value=0, max_value=11)),
+)
+def test_fingerprints_match_equals_naive_oracle(
+    sizes, window, window_bytes, bad_at
+) -> None:
+    """Windowed/byte-budgeted verification must return exactly what the
+    naive compare-every-fingerprint oracle returns, for any window
+    geometry, slice-size mix (incl. slices far over the byte budget),
+    and mismatch position — and every thunk runs at most once."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.device_digest import (
+        device_fingerprint,
+        fingerprints_match,
+    )
+
+    arrs = [
+        jnp.arange(n, dtype=jnp.float32) + 3.0 * i
+        for i, n in enumerate(sizes)
+    ]
+    fps = [device_fingerprint(a) for a in arrs]
+    expected = list(fps)
+    if bad_at is not None and bad_at < len(expected):
+        expected[bad_at] = "xxh4x32:" + "0" * 32
+    oracle = all(f == e for f, e in zip(fps, expected))
+
+    calls = []
+    items = [
+        (a.nbytes, lambda i=i, a=a: (calls.append(i), a)[1], e)
+        for i, (a, e) in enumerate(zip(arrs, expected))
+    ]
+    got = fingerprints_match(items, window=window, window_bytes=window_bytes)
+    assert got == oracle
+    assert len(calls) == len(set(calls)), "a slice thunk ran twice"
+    if got:
+        assert calls == list(range(len(arrs)))  # everything verified
