@@ -68,7 +68,13 @@ def run_group(name: str, marker: str, timeout: int):
         out = proc.stdout + proc.stderr
         rc = proc.returncode
     except subprocess.TimeoutExpired as e:
-        out = (e.stdout or "") + (e.stderr or "")
+        # TimeoutExpired carries undecoded bytes even under text=True.
+        def _as_text(x):
+            if x is None:
+                return ""
+            return x.decode(errors="replace") if isinstance(x, bytes) else x
+
+        out = _as_text(e.stdout) + _as_text(e.stderr)
         rc = -1
     elapsed = time.monotonic() - t0
 

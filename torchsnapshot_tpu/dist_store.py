@@ -38,14 +38,24 @@ DEFAULT_BARRIER_TIMEOUT_S = 1800.0
 #     op_timeout + RPC_GRACE_S (the server answers "timeout" at
 #     op_timeout; the grace covers scheduling + network),
 #   - quick ops (set/add/mset/...) wait STORE_RPC_TIMEOUT_S (in-memory
-#     ops; generous for a loaded single-core host).
+#     ops that normally answer in microseconds).
 # TCP keepalive (~20 s of silence) and TCP_USER_TIMEOUT (~20 s unacked
 # data) additionally tear down the connection under long-deadline
 # blocking ops, so silent server death surfaces in tens of seconds, not
 # at the 1800 s barrier timeout.
+#
+# The quick-op deadline is deliberately GENEROUS (10 min): the server
+# thread shares rank 0's GIL, and a host in swap thrash or a long
+# GIL-held stretch can stall it for minutes while the kernel keeps
+# ACKing (so keepalive/USER_TIMEOUT never fire). A premature deadline
+# here is worse than a slow one — the client latches dead and its
+# liveness-registered connection's drop publishes a death key for a
+# LIVE rank. The kernel-dead cases (the common ones) are still caught
+# in ~20 s by the TCP-layer settings above; this deadline only backstops
+# the ACKing-but-silent pathology, where 10 min still beats 30.
 RPC_GRACE_S = 30.0
 STORE_RPC_TIMEOUT_S = float(
-    os.environ.get("TORCHSNAPSHOT_TPU_STORE_RPC_TIMEOUT", "120")
+    os.environ.get("TORCHSNAPSHOT_TPU_STORE_RPC_TIMEOUT", "600")
 )
 CONNECT_TIMEOUT_S = 30.0
 # Failure-detection channel shared with pg_wrapper: the server publishes
@@ -307,12 +317,31 @@ class TCPStore:
                     f"{host}:{port} did not answer the store probe "
                     "(not a store server)"
                 )
+        except ConnectionRefusedError:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
         except (ConnectionError, EOFError, OSError):
             try:
                 self._sock.close()
             except OSError:
                 pass
             raise
+        except Exception as e:
+            # A non-store service on the port can answer with bytes that
+            # explode anywhere inside unpickling (UnpicklingError,
+            # ValueError, AttributeError, ...): that is still "not a
+            # store server", and the socket must not leak.
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise ConnectionRefusedError(
+                f"{host}:{port} answered the store probe with garbage "
+                f"({type(e).__name__}: {e}) — not a store server"
+            ) from e
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Silent-death detection at the TCP layer (a killed process RSTs

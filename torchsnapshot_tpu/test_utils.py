@@ -161,14 +161,27 @@ def _worker_entry(
         result_queue.put((rank, "error", traceback.format_exc()))
 
 
+# Ports this process already handed out: a just-closed probe socket's
+# port can be reassigned immediately (no TIME_WAIT on a never-connected
+# listener), so a test allocating a jax-coordinator port followed by the
+# launcher allocating a store port could receive the SAME port — EADDRINUSE
+# when rank 0 binds both. Never return a port twice per process.
+_handed_out_ports: "set[int]" = set()
+
+
 def _find_free_port() -> int:
     import socket
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    port = 0
+    for _ in range(128):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        if port not in _handed_out_ports:
+            _handed_out_ports.add(port)
+            return port
+    return port  # pragma: no cover - kernel cycling through <128 ports
 
 
 def run_with_subprocesses(
