@@ -190,3 +190,26 @@ def test_async_take_reshards(tmp_path, src_kind, dst_kind) -> None:
     dst = StateDict(emb=dst_arr)
     snapshot.restore({"m": dst})
     np.testing.assert_array_equal(np.asarray(dst["emb"]), data)
+
+
+def test_writer_election_balances_across_holders():
+    """_stable_owner's hash election must spread boxes roughly evenly
+    across holder processes (the docstring's 'load-spreading' claim):
+    with B boxes and H holders each holder should own ~B/H, never 0 and
+    never a dominating share. Also deterministic across call order."""
+    from torchsnapshot_tpu.io_preparers.sharded import _stable_owner
+
+    holders = [0, 1, 2, 3]
+    boxes = [
+        ((r * 7, r * 7 + 7), (c * 13, c * 13 + 13))
+        for r in range(32)
+        for c in range(16)
+    ]  # 512 distinct boxes
+    counts = {h: 0 for h in holders}
+    for box in boxes:
+        owner = _stable_owner(box, holders)
+        assert owner == _stable_owner(box, list(reversed(holders)))  # det.
+        counts[owner] += 1
+    expected = len(boxes) / len(holders)  # 128
+    for h, n in counts.items():
+        assert 0.6 * expected <= n <= 1.4 * expected, counts
