@@ -1,0 +1,16 @@
+"""Shared example plumbing."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    """Honor JAX_PLATFORMS=cpu even when the interpreter pre-imported
+    jax aimed at an experimental TPU platform (the env var alone can be
+    too late; jax.config takes effect at first backend init). Call
+    after ``import jax`` and before any device use."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

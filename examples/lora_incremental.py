@@ -20,6 +20,10 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+from _example_utils import force_cpu_if_requested
+
+force_cpu_if_requested()
 import jax.numpy as jnp
 import numpy as np
 import optax

@@ -40,11 +40,9 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
 
 import jax
 
-# Honor the documented run command even when the interpreter pre-imported
-# jax aimed at an experimental platform: env vars are too late then, but
-# jax.config takes effect at first backend initialization.
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+from _example_utils import force_cpu_if_requested
+
+force_cpu_if_requested()
 
 import jax.numpy as jnp
 import numpy as np

@@ -28,6 +28,11 @@ if "--cpu-devices" in sys.argv:
     _jax.config.update("jax_platforms", "cpu")
 
 import jax
+
+from _example_utils import force_cpu_if_requested
+
+force_cpu_if_requested()
+
 import numpy as np
 
 from torchsnapshot_tpu import Snapshot, StateDict
