@@ -352,3 +352,50 @@ def test_op_timeout_is_not_connection_loss(store) -> None:
         store.get("never-set", timeout=0.3)
     store.set("after", b"1")  # connection still fine
     assert store.get("after") == b"1"
+
+
+def test_non_store_service_on_port_is_refused() -> None:
+    """A port occupied by something that ANSWERS but is not a store
+    (e.g. a service that grabbed the dead store's freed port): the
+    connect-time probe must refuse it — whether the reply is non-pickle
+    garbage or a pickled non-response — and must not leak the socket."""
+    import socket as socket_mod
+
+    def garbage_server(payload: bytes):
+        lsock = socket_mod.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                try:
+                    conn.recv(4096)  # swallow the probe
+                    conn.sendall(payload)
+                    conn.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=serve, daemon=True).start()
+        return lsock
+
+    import struct
+
+    # Length-prefixed non-pickle bytes: explodes inside unpickling.
+    garbage = struct.pack(">Q", 8) + b"not-pkl!"
+    # A pickled object that is not a response dict.
+    import pickle
+
+    notdict = pickle.dumps(["hello"])
+    framed_notdict = struct.pack(">Q", len(notdict)) + notdict
+
+    for payload in (garbage, framed_notdict, b"HTTP/1.1 400\r\n\r\n"[:8]):
+        lsock = garbage_server(payload)
+        try:
+            with pytest.raises(OSError):
+                TCPStore("127.0.0.1", lsock.getsockname()[1])
+        finally:
+            lsock.close()
