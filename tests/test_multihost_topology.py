@@ -317,12 +317,22 @@ def _digest_cross_layout_worker(rank, world_size, base, port, local):
     (sharded._make_assembler) stitches + verifies on device and no
     reads are planned. A mutated destination must still re-read — on
     the rank whose region went stale; the other rank's local handle is
-    unchanged and stays skipped (per-rank locality)."""
+    unchanged and stays skipped (per-rank locality).
+
+    The DISTRIBUTED verification pass (summed partial lanes; its own
+    test below) would verify this layout first and short-circuit the
+    local paths; it is disabled here so this test keeps pinning the
+    rank-local assembly machinery, which remains the fallback for
+    non-collective read paths and failed exchanges."""
     from jax.sharding import PartitionSpec as P
 
     jax = _init_pod(rank, world_size, port, local)
     from torchsnapshot_tpu import Snapshot, StateDict
     from torchsnapshot_tpu.io_preparers.sharded import _ShardScatterConsumer
+
+    Snapshot._distributed_preverify = (
+        lambda self, flattened, available, pg_wrapper: set()
+    )
 
     mesh = _pod_mesh(jax, world_size, local)
     arr = _make_array(jax, mesh, P("proc", "local"))
@@ -389,6 +399,99 @@ def test_pod_2x2_device_digest_cross_layout(tmp_path) -> None:
     port = _find_free_port()
     results = run_with_subprocesses(
         _digest_cross_layout_worker,
+        2,
+        str(tmp_path / "base"),
+        port,
+        2,
+        timeout=300.0,
+    )
+    assert all(v == "ok" for v in results.values())
+
+
+def _digest_cross_process_worker(rank, world_size, base, port, local):
+    """Distributed digest verification: the destination layout cuts every
+    saved piece ACROSS PROCESS BOUNDARIES, so no process can verify any
+    piece alone (containment and union assembly both impossible). The
+    ranks exchange 16-byte partial fingerprint lanes over the
+    coordination plane (snapshot._distributed_preverify) and skip every
+    read with zero payload bytes moved. A single-cell mutation on ONE
+    rank's region must fail the piece's summed lanes and re-read it on
+    the ranks that hold its regions."""
+    from jax.sharding import PartitionSpec as P
+
+    jax = _init_pod(rank, world_size, port, local)
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.io_preparers.sharded import _ShardScatterConsumer
+
+    mesh = _pod_mesh(jax, world_size, local)
+    # Saved: column pieces replicated over procs -> pieces span ALL rows.
+    arr = _make_array(jax, mesh, P(None, "local"))
+    Snapshot.take(base, {"m": StateDict(emb=arr)}, device_digests=True)
+
+    # Destination: row boxes across procs, full width -> every saved
+    # column piece intersects EVERY process's boxes; no box contains a
+    # piece and no process's union covers one.
+    dst_spec = P("proc", None)
+    consumed = []
+    orig_c = _ShardScatterConsumer._consume_sync
+    _ShardScatterConsumer._consume_sync = (
+        lambda self, buf: consumed.append(1) or orig_c(self, buf)
+    )
+    try:
+        dst = StateDict(emb=_make_array(jax, mesh, dst_spec))
+        Snapshot(base).restore({"m": dst}, device_digests=True)
+    finally:
+        _ShardScatterConsumer._consume_sync = orig_c
+    assert consumed == [], f"rank {rank} consumed {consumed}"
+    _check_restored(dst["emb"])
+
+    # Stale cell at [0, 0] (inside rank 0's region of the first column
+    # piece): that piece's summed lanes mismatch, the whole entry's
+    # verdict fails (verdicts are whole-entry, like every other skip
+    # path — a partially-skipped scatter would leave unread regions of
+    # the rebuild buffers uninitialized), and every rank re-reads the
+    # pieces overlapping its boxes: both column pieces per rank here.
+    from jax.sharding import NamedSharding
+
+    stale_host = _global_data()
+    stale_host[0, 0] += 9.0
+    stale = jax.make_array_from_callback(
+        SHAPE, NamedSharding(mesh, dst_spec), lambda idx: stale_host[idx]
+    )
+    consumed2 = []
+    _ShardScatterConsumer._consume_sync = (
+        lambda self, buf: consumed2.append(1) or orig_c(self, buf)
+    )
+    try:
+        dst2 = StateDict(emb=stale)
+        Snapshot(base).restore({"m": dst2}, device_digests=True)
+    finally:
+        _ShardScatterConsumer._consume_sync = orig_c
+    assert len(consumed2) == 2, f"rank {rank} consumed {len(consumed2)} pieces"
+    _check_restored(dst2["emb"])
+
+    # The corrected destination verifies again on the next reload: the
+    # distributed pass plans zero reads (the serving hot-reload steady
+    # state for pieces cut across processes).
+    consumed3 = []
+    _ShardScatterConsumer._consume_sync = (
+        lambda self, buf: consumed3.append(1) or orig_c(self, buf)
+    )
+    try:
+        dst3 = StateDict(emb=dst2["emb"])
+        Snapshot(base).restore({"m": dst3}, device_digests=True)
+    finally:
+        _ShardScatterConsumer._consume_sync = orig_c
+    assert consumed3 == [], f"rank {rank} consumed {consumed3}"
+    return "ok"
+
+
+def test_pod_2x2_distributed_digest_verification(tmp_path) -> None:
+    """Pieces cut across process boundaries verify via summed partial
+    lanes — zero payload movement — instead of falling back to reads."""
+    port = _find_free_port()
+    results = run_with_subprocesses(
+        _digest_cross_process_worker,
         2,
         str(tmp_path / "base"),
         port,

@@ -148,19 +148,26 @@ def _dispatch(arr):
         return None
 
 
-def _finalize_from_nbytes(nbytes: int, pending) -> str:
-    """Fetch a dispatched computation's 16 bytes and fold in the length."""
-    import jax
-
-    lanes = np.asarray(jax.device_get(pending), dtype=np.uint32)
-    # Fold the byte length in on the host (it is static per shape): equal
-    # word streams of different underlying sizes stay distinct.
+def _fold_lanes(lanes, nbytes: int) -> str:
+    """Fold the byte length into 4 summed lanes and format the digest.
+    THE single definition of the final fold: device_fingerprint and the
+    distributed combine_partials must agree bit-exactly or cross-process
+    verdicts would silently diverge from recorded fingerprints."""
     with np.errstate(over="ignore"):
         final = [
             np.uint32(lane) ^ _mix32(np.uint32(nbytes & 0xFFFFFFFF) ^ seed)
-            for lane, seed in zip(lanes, _SEEDS)
+            for lane, seed in zip(np.asarray(lanes, np.uint32), _SEEDS)
         ]
     return PREFIX + ":" + "".join(f"{int(v):08x}" for v in final)
+
+
+def _finalize_from_nbytes(nbytes: int, pending) -> str:
+    """Fetch a dispatched computation's 16 bytes and fold in the length
+    (folding on the host: the length is static per shape, and equal word
+    streams of different underlying sizes stay distinct)."""
+    import jax
+
+    return _fold_lanes(jax.device_get(pending), nbytes)
 
 
 def _nbytes(arr) -> int:
@@ -171,6 +178,112 @@ def _nbytes(arr) -> int:
 
 def _finalize(arr, pending) -> str:
     return _finalize_from_nbytes(_nbytes(arr), pending)
+
+
+# -------------------------------------------------------- partial lanes
+#
+# The lanes are COMMUTATIVE uint32 sums over position-tagged words, so a
+# piece's fingerprint is ADDITIVE over any disjoint cover of its word
+# stream: fingerprint(piece) = fold(sum of partial_lanes(region_i)) for
+# regions partitioning the piece, each tagged with its words' absolute
+# indices in the piece. This is what lets a piece CUT ACROSS PROCESSES
+# be verified with zero payload movement — every process computes the
+# 16-byte partial sum over the sub-region it holds, the partials ride
+# the coordination plane, and their wrapping sum (plus the length fold)
+# must equal the manifest's recorded fingerprint.
+
+
+def _partial_jit(region, offsets, strides):
+    """Lanes contribution of ``region``, an N-D sub-block of a piece:
+    identical math to ``_fingerprint_jit`` except each word's tag uses
+    its absolute index in the PIECE's row-major word stream, computed
+    from the region's ``offsets`` and the piece's row-major ``strides``
+    (both uint32 vectors, dynamic so same-shaped regions share one
+    compilation)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    words = _as_uint32_words(region)
+    n_elem = 1
+    for s in region.shape:
+        n_elem *= s
+    wpe = words.shape[0] // max(n_elem, 1)  # words per element (1 or 2)
+    e = jnp.zeros(region.shape, jnp.uint32)
+    for d in range(region.ndim):
+        e = e + (
+            offsets[d] + lax.broadcasted_iota(jnp.uint32, region.shape, d)
+        ) * strides[d]
+    if wpe == 1:
+        w = e.reshape(-1)
+    else:
+        w = (
+            e.reshape(-1, 1) * jnp.uint32(wpe)
+            + lax.iota(jnp.uint32, wpe)[None, :]
+        ).reshape(-1)
+    lanes = []
+    for seed in _SEEDS:
+        tag = _mix32(w * _GOLDEN + seed)
+        lanes.append(jnp.sum(_mix32(words ^ tag), dtype=jnp.uint32))
+    return jnp.stack(lanes)
+
+
+_partial_jitted = None
+
+
+def _get_partial_jitted():
+    global _partial_jitted
+    if _partial_jitted is None:
+        import jax
+
+        _partial_jitted = jax.jit(_partial_jit)
+    return _partial_jitted
+
+
+def partial_dispatch(region, piece_shape, region_offsets):
+    """Kick the partial-lanes computation for ``region``, located at
+    ``region_offsets`` within a piece of shape ``piece_shape``. Returns
+    the in-flight device lanes, or None when the region cannot be
+    fingerprinted on device."""
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(region, jax.Array):
+        return None
+    if not getattr(region, "is_fully_addressable", False):
+        return None
+    strides = []
+    acc = 1
+    for dim in reversed(tuple(piece_shape)):
+        strides.append(acc)
+        acc *= int(dim)
+    strides = list(reversed(strides))
+    try:
+        return _get_partial_jitted()(
+            region,
+            jnp.asarray(np.asarray(region_offsets, np.uint32)),
+            jnp.asarray(np.asarray(strides, np.uint32)),
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+def partial_fetch(pending) -> "tuple[int, int, int, int]":
+    """Fetch a dispatched partial's 16 bytes (4 uint32 lanes)."""
+    import jax
+
+    lanes = np.asarray(jax.device_get(pending), dtype=np.uint32)
+    return tuple(int(v) for v in lanes)
+
+
+def combine_partials(lane_groups, nbytes: int) -> str:
+    """Wrapping-sum partial lanes covering a whole piece and fold the
+    piece's byte length — equals the piece's ``device_fingerprint`` by
+    lane additivity. ``lane_groups``: iterables of 4 ints each."""
+    total = np.zeros(4, np.uint32)
+    with np.errstate(over="ignore"):
+        for lanes in lane_groups:
+            total = total + np.asarray(lanes, np.uint32)
+    return _fold_lanes(total, nbytes)
 
 
 def device_fingerprint(arr) -> Optional[str]:

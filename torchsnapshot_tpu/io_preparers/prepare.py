@@ -166,6 +166,7 @@ def prepare_read(
     callback: Optional[Callable[[Any], None]] = None,
     buffer_size_limit_bytes: Optional[int] = None,
     device_digests: bool = False,
+    assume_verified: bool = False,
 ) -> List[ReadReq]:
     """Plan reads for ``entry`` into/for ``obj_out``.
 
@@ -183,10 +184,18 @@ def prepare_read(
     fingerprint) plan NO reads and keep their current array — the
     restore-side mirror of the take-side DtoH skip.
 
+    ``assume_verified``: the destination was already proven to hold this
+    entry's exact content by DISTRIBUTED digest verification (partial
+    fingerprint lanes summed across processes over the coordination
+    plane, snapshot.py) — plan no reads and keep it.
+
     PrimitiveEntry requires no I/O and must be handled by the caller
     (reference: io_preparer.py:888-890).
     """
     if isinstance(entry, PrimitiveEntry):
+        return []
+
+    if assume_verified:
         return []
 
     if (

@@ -216,6 +216,35 @@ class ShardedArrayIOPreparer:
 
     # ------------------------------------------------------------------ save
 
+    @staticmethod
+    def _elected_local_boxes(sharding, shape, addressable_shards):
+        """Yield ``(box, data)`` for every unique shard box this process
+        is ELECTED to act for: the dedup + hash-balanced election shared
+        by the save-side writer partition (``_owned_pieces``) and
+        restore-side distributed digest verification
+        (``partial_digest_contributions``) — one definition, so the two
+        sides can never disagree about ownership."""
+        import jax
+
+        process_index = jax.process_index()
+        # box -> holder process indices (computed identically everywhere)
+        holders: Dict[Box, List[int]] = {}
+        for device, index in sharding.devices_indices_map(shape).items():
+            box = _normalize_index(index, shape)
+            holders.setdefault(box, []).append(device.process_index)
+        local_data: Dict[Box, Any] = {}
+        for shard in addressable_shards:
+            box = _normalize_index(shard.index, shape)
+            if box not in local_data:
+                local_data[box] = shard.data
+        for box in sorted(holders.keys()):
+            if _stable_owner(box, holders[box]) != process_index:
+                continue
+            data = local_data.get(box)
+            if data is None:  # pragma: no cover - owner is always a holder
+                continue
+            yield box, data
+
     @classmethod
     def _owned_pieces(cls, arr, itemsize: Optional[int] = None):
         """Yield ``(p_off, p_sz, get_piece)`` for every piece THIS process
@@ -227,33 +256,13 @@ class ShardedArrayIOPreparer:
         it, warmup_staging sizes pool slabs from it. ``itemsize`` lets the
         warmup subdivide at the dtype a save_dtype-converted save will
         actually stage (boundaries depend on itemsize)."""
-        import jax
-
-        sharding = arr.sharding
         shape = tuple(arr.shape)
         if itemsize is None:
             itemsize = string_to_dtype(dtype_to_string(arr.dtype)).itemsize
-        process_index = jax.process_index()
 
-        # box -> holder process indices (computed identically on every process)
-        holders: Dict[Box, List[int]] = {}
-        for device, index in sharding.devices_indices_map(shape).items():
-            box = _normalize_index(index, shape)
-            holders.setdefault(box, []).append(device.process_index)
-
-        # addressable shard data by box
-        local_data: Dict[Box, Any] = {}
-        for shard in arr.addressable_shards:
-            box = _normalize_index(shard.index, shape)
-            if box not in local_data:
-                local_data[box] = shard.data
-
-        for box in sorted(holders.keys()):
-            if _stable_owner(box, holders[box]) != process_index:
-                continue
-            data = local_data.get(box)
-            if data is None:  # pragma: no cover - owner is always a holder
-                continue
+        for box, data in cls._elected_local_boxes(
+            arr.sharding, shape, arr.addressable_shards
+        ):
             offsets = [lo for lo, _ in box]
             sizes = [hi - lo for lo, hi in box]
             for p_off, p_sz in _subdivide(
@@ -442,6 +451,91 @@ class ShardedArrayIOPreparer:
         # Thunks: slices/assemblies materialize windowed inside
         # fingerprints_match, never all at once.
         return fingerprints_match(to_check)
+
+    @classmethod
+    def partial_digest_contributions(
+        cls, entry: ShardedArrayEntry, obj_out
+    ) -> "Optional[Dict[int, List[Tuple[str, int, Tuple[int, int, int, int]]]]]":
+        """This process's contributions to DISTRIBUTED (zero-byte) digest
+        verification of ``entry`` against ``obj_out``: for every unique
+        destination box ELECTED to this process (the same hash election
+        the save-side writer dedup uses), the partial fingerprint lanes
+        of each saved piece's intersection with that box, tagged with the
+        region's absolute offsets within the piece. Fingerprint lanes are
+        additive over disjoint word covers (device_digest.py), so peers
+        can sum every process's 16-byte partials and compare against the
+        manifest — verifying a piece CUT ACROSS PROCESSES with no payload
+        movement at all.
+
+        Returns ``{piece_index: [(box_key, n_elements, lanes4), ...]}``
+        (possibly empty — this process elected no boxes), or None when a
+        region could not be fingerprinted on device; the caller then
+        publishes non-participation so peers see incomplete coverage and
+        fall back to normal reads. Dispatch is windowed: at most a few
+        region slices are live at a time."""
+        from ..device_digest import (
+            MATCH_WINDOW,
+            MATCH_WINDOW_BYTES,
+            partial_dispatch,
+            partial_fetch,
+        )
+
+        shape = tuple(entry.shape)
+        itemsize = string_to_dtype(entry.dtype).itemsize
+
+        # All (piece, elected-box) overlap regions, as geometry + data.
+        work: List[Tuple[int, str, Tuple, Tuple, Any]] = []
+        for box, data in cls._elected_local_boxes(
+            obj_out.sharding, shape, obj_out.addressable_shards
+        ):
+            for i, shard in enumerate(entry.shards):
+                ov = _overlap(shard.offsets, shard.sizes, box)
+                if ov is None:
+                    continue
+                src_slices, dst_slices = ov
+                n_elems = 1
+                for sl in src_slices:
+                    n_elems *= sl.stop - sl.start
+                work.append(
+                    (
+                        i,
+                        _box_key(box),
+                        tuple(shard.sizes),
+                        tuple(sl.start for sl in src_slices),
+                        (data, dst_slices, n_elems),
+                    )
+                )
+
+        out: Dict[int, List[Tuple[str, int, Tuple[int, int, int, int]]]] = {}
+        # Windowed dispatch: same bounds as fingerprints_match.
+        pos = 0
+        while pos < len(work):
+            batch = []
+            batch_bytes = 0
+            while (
+                pos < len(work)
+                and len(batch) < MATCH_WINDOW
+                and batch_bytes < MATCH_WINDOW_BYTES
+            ):
+                i, box_key, piece_shape, offs, (data, dst_slices, n_elems) = (
+                    work[pos]
+                )
+                nbytes = n_elems * itemsize
+                if batch and batch_bytes + nbytes > MATCH_WINDOW_BYTES:
+                    break
+                region = data[dst_slices] if dst_slices else data
+                pending = partial_dispatch(region, piece_shape, offs)
+                del region
+                if pending is None:
+                    return None
+                batch.append((i, box_key, n_elems, pending))
+                batch_bytes += nbytes
+                pos += 1
+            for i, box_key, n_elems, pending in batch:
+                out.setdefault(i, []).append(
+                    (box_key, n_elems, partial_fetch(pending))
+                )
+        return out
 
     @classmethod
     def prepare_read(

@@ -67,6 +67,7 @@ from .manifest import (
     Entry,
     Manifest,
     PrimitiveEntry,
+    ShardedArrayEntry,
     SnapshotMetadata,
     get_manifest_for_rank,
     is_container_entry,
@@ -682,8 +683,44 @@ class Snapshot:
             # inside and hang healthy peers — then raises the first error
             # after the last key.
             exc: Optional[BaseException] = None
+            # Distributed digest verification is COLLECTIVE (one object
+            # all-gather per key), so when active every rank participates
+            # at every key slot — including ranks whose app_state lacks
+            # the key (they contribute nothing) — or peers would hang.
+            # The state flatten happens here, before the gather, and is
+            # reused by the load. Gated on the MANIFEST actually holding
+            # digest-bearing sharded entries (identical on every rank:
+            # sharded entries are merged globally), so restores with
+            # nothing to verify pay no extra round trips.
+            dist_verify = (
+                device_digests
+                and pg_wrapper.get_world_size() > 1
+                and any(
+                    isinstance(e, ShardedArrayEntry)
+                    and e.shards
+                    and all(
+                        s.array.device_digest is not None for s in e.shards
+                    )
+                    for e in available.values()
+                )
+            )
             for key in ordered:
+                prepared = None
                 if key in app_state:
+                    try:
+                        sd = app_state[key].state_dict()
+                        prepared = (sd, flatten(sd, prefix=key)[1])
+                    except BaseException as e:  # noqa: B036
+                        if exc is None:
+                            exc = e
+                preverified: set = set()
+                if dist_verify:
+                    preverified = self._distributed_preverify(
+                        prepared[1] if prepared is not None else {},
+                        available,
+                        pg_wrapper,
+                    )
+                if prepared is not None:
                     try:
                         self._load_stateful(
                             rank=rank,
@@ -695,6 +732,8 @@ class Snapshot:
                             event_loop=event_loop,
                             memory_budget=memory_budget,
                             device_digests=device_digests,
+                            prepared=prepared,
+                            preverified=preverified,
                         )
                     except BaseException as e:  # noqa: B036
                         if exc is None:
@@ -712,6 +751,136 @@ class Snapshot:
             storage.sync_close(event_loop)
             event_loop.close()
 
+    def _distributed_preverify(
+        self,
+        flattened: Dict[str, Any],
+        available: Manifest,
+        pg_wrapper: PGWrapper,
+    ) -> set:
+        """Zero-byte verification of sharded destinations ACROSS process
+        boundaries: fingerprint lanes are additive over disjoint word
+        covers (device_digest.py), so each process computes 16-byte
+        partial lanes over the destination regions it was elected for,
+        one object all-gather moves the partials over the coordination
+        plane, and every rank sums them against the manifest's recorded
+        piece fingerprints. A piece no single process fully holds —
+        which the local verification paths of
+        ShardedArrayIOPreparer._dst_already_matches must fall back on —
+        is verified here without moving a payload byte.
+
+        Returns the logical paths whose entries are fully verified AND
+        locally eligible on THIS rank (verdicts are identical everywhere
+        — computed from identical gathered data — but they only apply
+        where the rank's own destination passed the eligibility checks:
+        a rank whose local object is e.g. a numpy array or has a shape
+        mismatch must go through the normal read path and raise its
+        normal errors). Collective: EVERY rank must call this at the
+        same key slot, with an empty ``flattened`` when it has nothing,
+        and the local-contribution phase NEVER raises — an unexpected
+        per-entry failure just withholds that entry's contribution (its
+        coverage then falls short and it reads normally) — because an
+        asymmetric exception before the all-gather would desert peers
+        mid-collective."""
+        from .device_digest import combine_partials
+        from .io_preparers.sharded import ShardedArrayIOPreparer
+
+        local: Dict[str, Any] = {}
+        eligible: set = set()
+        for lp, obj in flattened.items():
+            try:
+                entry = available.get(lp)
+                if not isinstance(entry, ShardedArrayEntry):
+                    continue
+                if not is_jax_array(obj) or getattr(
+                    obj, "is_fully_addressable", True
+                ):
+                    # Fully-addressable destinations verify locally
+                    # (global slices) — cheaper, and no exchange needed.
+                    continue
+                if list(obj.shape) != list(entry.shape):
+                    continue
+                if dtype_to_string(obj.dtype) != entry.dtype:
+                    continue
+                if not entry.shards or any(
+                    s.array.device_digest is None for s in entry.shards
+                ):
+                    continue
+                contribs = (
+                    ShardedArrayIOPreparer.partial_digest_contributions(
+                        entry, obj
+                    )
+                )
+                # None (unfingerprintable region) is published as-is:
+                # peers must see this rank failed, not "no overlap".
+                local[lp] = contribs
+                if contribs is not None:
+                    eligible.add(lp)
+            except Exception:  # noqa: BLE001 - lockstep safety
+                logger.exception(
+                    "distributed digest verification: contribution for "
+                    "%r failed; it will read normally",
+                    lp,
+                )
+                local[lp] = None
+
+        gathered = pg_wrapper.all_gather_object(local)
+
+        verified: set = set()
+        try:
+            candidate_lps = sorted(set().union(*(set(g) for g in gathered)))
+            for lp in candidate_lps:
+                entry = available.get(lp)
+                if not isinstance(entry, ShardedArrayEntry):  # pragma: no cover
+                    continue
+                merged: Dict[int, Dict[str, Any]] = {}
+                failed = False
+                for g in gathered:
+                    if lp not in g:
+                        continue
+                    contribs = g[lp]
+                    if contribs is None:
+                        failed = True
+                        break
+                    for i, regions in contribs.items():
+                        bucket = merged.setdefault(int(i), {})
+                        for box_key, n_elems, lanes in regions:
+                            # Replicated boxes are elected to ONE owner,
+                            # so a duplicate (piece, box) means equal
+                            # values; keep the first.
+                            bucket.setdefault(box_key, (n_elems, lanes))
+                if failed:
+                    continue
+                ok = True
+                for i, shard in enumerate(entry.shards):
+                    piece_elems = 1
+                    for s in shard.sizes:
+                        piece_elems *= s
+                    regions = merged.get(i, {})
+                    covered = sum(n for n, _ in regions.values())
+                    if covered != piece_elems:
+                        ok = False  # a rank missing, or boxes didn't cover
+                        break
+                    digest = combine_partials(
+                        (lanes for _, lanes in regions.values()),
+                        array_size_bytes(shard.sizes, entry.dtype),
+                    )
+                    if digest != shard.array.device_digest:
+                        ok = False
+                        break
+                if ok:
+                    verified.add(lp)
+        except Exception:  # noqa: BLE001 - lockstep safety
+            # Malformed gathered data (e.g. version skew) must not raise
+            # asymmetrically between the gather and the key barrier.
+            logger.exception(
+                "distributed digest verification: verdicts failed; "
+                "reading normally"
+            )
+            return set()
+        # Global verdicts, locally applied: skip only what THIS rank's
+        # destination was eligible for.
+        return verified & eligible
+
     def _load_stateful(
         self,
         rank: int,
@@ -723,9 +892,15 @@ class Snapshot:
         event_loop: asyncio.AbstractEventLoop,
         memory_budget: int,
         device_digests: bool = False,
+        prepared: "Optional[Tuple[Any, Dict[str, Any]]]" = None,
+        preverified: "Optional[set]" = None,
     ) -> None:
-        state_dict = stateful.state_dict()
-        _, flattened = flatten(state_dict, prefix=key)
+        if prepared is not None:
+            state_dict, flattened = prepared
+        else:
+            state_dict = stateful.state_dict()
+            _, flattened = flatten(state_dict, prefix=key)
+        preverified = preverified or set()
 
         read_reqs: List[ReadReq] = []
         for logical_path, obj in flattened.items():
@@ -756,7 +931,11 @@ class Snapshot:
 
             read_reqs.extend(
                 prepare_read(
-                    entry, obj_out=obj, callback=_cb, device_digests=device_digests
+                    entry,
+                    obj_out=obj,
+                    callback=_cb,
+                    device_digests=device_digests,
+                    assume_verified=logical_path in preverified,
                 )
             )
 
