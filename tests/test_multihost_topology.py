@@ -499,3 +499,18 @@ def test_pod_2x2_distributed_digest_verification(tmp_path) -> None:
         timeout=300.0,
     )
     assert all(v == "ok" for v in results.values())
+
+
+def test_pod_4x2_distributed_digest_verification(tmp_path) -> None:
+    """Four contributors per piece: a wider world where every saved
+    piece's verification sums partial lanes from ALL FOUR processes."""
+    port = _find_free_port()
+    results = run_with_subprocesses(
+        _digest_cross_process_worker,
+        4,
+        str(tmp_path / "base"),
+        port,
+        2,
+        timeout=300.0,
+    )
+    assert all(v == "ok" for v in results.values())

@@ -879,7 +879,23 @@ class Snapshot:
             return set()
         # Global verdicts, locally applied: skip only what THIS rank's
         # destination was eligible for.
-        return verified & eligible
+        applied = verified & eligible
+        if applied:
+            kept = sum(
+                array_size_bytes(
+                    available[lp].shape, available[lp].dtype
+                )
+                for lp in applied
+            )
+            logger.info(
+                "distributed digest verification: %d sharded entr%s "
+                "(%.1f MB global) verified across process boundaries — "
+                "no payload read",
+                len(applied),
+                "y" if len(applied) == 1 else "ies",
+                kept / 1e6,
+            )
+        return applied
 
     def _load_stateful(
         self,
