@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import signal
 import statistics
 import subprocess
 import sys
@@ -62,6 +63,117 @@ print(jax.default_backend(), len(jax.devices()), f"{16e-3 / max(dt, 1e-9):.4f}")
 
 def _log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+class _SubprocResult:
+    def __init__(self, returncode, stdout, stderr, killed, pgid=None):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+        self.killed = killed
+        self.pgid = pgid  # the child-led process group (== child pid)
+
+
+def _run_in_own_group(cmd, timeout):
+    """subprocess.run, but the child leads its OWN process group and a
+    timeout kills the WHOLE group — then verifies no orphan survived.
+
+    The r05 driver artifact regressed 4.7x because two timed-out TPU
+    probes left relay-side children competing for this host's single
+    core during the timed saves: ``subprocess.run(timeout=...)`` kills
+    only the direct child, not whatever the JAX TPU client forked. A
+    wedged group member that survives SIGKILL (unkillable D-state) is
+    loudly reported so the caller can annotate the run as contaminated.
+    """
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,  # child = leader of a fresh process group
+    )
+    killed = False
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        killed = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        stdout, stderr = proc.communicate()
+    if killed:
+        _verify_group_dead(proc.pid)
+    return _SubprocResult(
+        proc.returncode, stdout or "", stderr or "", killed, pgid=proc.pid
+    )
+
+
+def _verify_group_dead(pgid, wait_s: float = 5.0) -> bool:
+    """Poll until no process remains in ``pgid``; log loudly if one
+    survives (it will contaminate subsequent timing windows)."""
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except ProcessLookupError:
+            return True  # whole group reaped
+        except PermissionError:
+            break  # exists but not ours — report below
+        time.sleep(0.2)
+    _log(
+        f"WARNING: process group {pgid} still has live members after "
+        f"SIGKILL + {wait_s}s; the host may be contaminated for timing"
+    )
+    return False
+
+
+# Floor for the memcpy self-calibration: all bench state fits in RAM and
+# the pipeline is memory-bandwidth-bound, so a host that can't stream
+# copies at this rate is either contended or misconfigured — the timed
+# window would measure the contention, not the snapshot pipeline.
+_MEMCPY_FLOOR_GBPS = float(os.environ.get("BENCH_MEMCPY_FLOOR_GBPS", "1.0"))
+
+
+def _host_calibration():
+    """Measure the host BEFORE opening the timed window: 1-minute load
+    average and achieved memcpy bandwidth (3x 256 MB, best-of). A wedged
+    relay day (r05) showed up as orphaned probe children stealing the
+    core — this check makes that visible in the artifact instead of
+    silently costing the round its headline. Returns a dict embedded in
+    the JSON under "host_calibration" with a ``contaminated`` verdict."""
+    import numpy as np
+
+    cpu_count = os.cpu_count() or 1
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:  # pragma: no cover
+        load1 = 0.0
+    src = np.empty(256 << 20, np.uint8)
+    src[::4096] = 1  # fault the pages outside the timed copies
+    dst = np.empty_like(src)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        best = max(best, src.nbytes / max(time.perf_counter() - t0, 1e-9))
+    del src, dst
+    memcpy_gbps = best / 1e9
+    contaminated = load1 > 1.5 * cpu_count or memcpy_gbps < _MEMCPY_FLOOR_GBPS
+    cal = {
+        "load1": round(load1, 2),
+        "cpu_count": cpu_count,
+        "memcpy_gbps": round(memcpy_gbps, 2),
+        "contaminated": contaminated,
+    }
+    if contaminated:
+        cal["reason"] = (
+            f"load1={load1:.2f} vs {cpu_count} cpu(s)"
+            if load1 > 1.5 * cpu_count
+            else f"memcpy {memcpy_gbps:.2f} GB/s < {_MEMCPY_FLOOR_GBPS} GB/s floor"
+        )
+    _log(f"host calibration: {cal}")
+    return cal
 
 
 def _probe_backend() -> "tuple[str, bool]":
@@ -94,16 +206,14 @@ def _probe_backend() -> "tuple[str, bool]":
         if attempt > 1 and remaining <= 30:
             break
         deadline = min(per_attempt, max(30, int(remaining)))
-        killed = False
-        try:
-            t0 = time.perf_counter()
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_CODE],
-                timeout=deadline,
-                capture_output=True,
-                text=True,
-            )
-            dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = _run_in_own_group([sys.executable, "-c", _PROBE_CODE], deadline)
+        killed = r.killed
+        dt = time.perf_counter() - t0
+        if killed:
+            _log(f"probe attempt {attempt} timed out after {deadline}s "
+                 "(process group killed)")
+        else:
             if r.returncode == 0 and r.stdout.strip():
                 try:
                     # Last line: libraries may print banners above it.
@@ -131,9 +241,6 @@ def _probe_backend() -> "tuple[str, bool]":
                     f"probe attempt {attempt} rc={r.returncode} "
                     f"stderr={r.stderr.strip()[-500:]!r}"
                 )
-        except subprocess.TimeoutExpired:
-            killed = True
-            _log(f"probe attempt {attempt} timed out after {deadline}s (killed)")
         remaining = total_budget - (time.monotonic() - begin)
         # A killed probe may have wedged the relay; cool down longer.
         pause = 120 if killed else 30
@@ -184,15 +291,10 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     deadline = int(os.environ.get("BENCH_TPU_LEG_TIMEOUT_S", "420"))
     _log(f"running TPU hardware side-leg ({deadline}s budget) ...")
     t_begin = time.monotonic()
-    try:
-        r = subprocess.run(
-            [sys.executable, script],
-            timeout=deadline,
-            capture_output=True,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
-        _log("TPU side-leg timed out (killed); omitting hardware fields")
+    r = _run_in_own_group([sys.executable, script], deadline)
+    if r.killed:
+        _log("TPU side-leg timed out (process group killed); omitting "
+             "hardware fields")
         return None, True
     if r.returncode != 0:
         _log(f"TPU side-leg rc={r.returncode} stderr={r.stderr.strip()[-300:]!r}")
@@ -232,15 +334,9 @@ def _tpu_hw_leg() -> "tuple[dict | None, bool]":
     # Both side-legs share the announced budget: the second gets what the
     # first left over (min 60 s), never a fresh full deadline.
     remaining = max(60, int(deadline - (time.monotonic() - t_begin)))
-    try:
-        r2 = subprocess.run(
-            [sys.executable, script2],
-            timeout=remaining,
-            capture_output=True,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
-        _log("device-dedup side-leg timed out (killed)")
+    r2 = _run_in_own_group([sys.executable, script2], remaining)
+    if r2.killed:
+        _log("device-dedup side-leg timed out (process group killed)")
         return out, True
     if r2.returncode == 0:
         rec = _json_records(r2.stdout).get("device_dedup/unchanged_resave")
@@ -305,6 +401,17 @@ def main() -> None:
     app_state = {"model": StateDict(state)}
     _log(f"state built: {nbytes / 1e9:.2f} GB across {len(state)} arrays")
 
+    # Self-calibrate BEFORE the timed window: a contaminated host (orphan
+    # probe children, noisy neighbor, throttled memory) gets one cool-down
+    # + re-check, and the verdict is recorded in the artifact either way —
+    # a wedged-relay day can degrade the number but can no longer
+    # masquerade as a code regression (VERDICT r5 item 1).
+    calibration = _host_calibration()
+    if calibration["contaminated"]:
+        _log("host contaminated; cooling down 30s and re-checking")
+        time.sleep(30)
+        calibration = _host_calibration()
+
     # Write to tmpfs when available AND large enough (a snapshot is written
     # twice concurrently at peak: previous + current trial): the reference
     # baseline ran against FSx Lustre (a fast parallel FS); a slow container
@@ -325,26 +432,92 @@ def main() -> None:
         # a few percent of best).
         Snapshot.take(f"{tmp}/warm", app_state)
         shutil.rmtree(f"{tmp}/warm", ignore_errors=True)
-        time.sleep(1.0)  # let async page freeing drain before trial 0 too
         _log("full-size warm-up snapshot done; starting timed saves")
 
+        # 6 trials, not 4: on a 1-core VM the hypervisor occasionally
+        # steals the core for seconds mid-trial; with 4 trials one such
+        # outlier drags p50 below the pipeline's real rate, with 6 the
+        # median holds (the raw trials stay in the JSON for audit).
+        n_trials = int(os.environ.get("BENCH_TRIALS", "6"))
+        # Per-trial purity guard: a ~64 MB memcpy immediately after each
+        # trial measures whether the host was contended DURING the
+        # window (the pre-window calibration can't see contention that
+        # arrives later — exactly the r05 wedged-relay failure mode,
+        # where neighbor load made pipeline trials measure the neighbor).
+        # A trial whose probe runs at <50% of the calibrated memcpy rate
+        # is discarded and retried (bounded); every discarded wall time
+        # still lands in the JSON for audit.
+        import numpy as _np
+
+        probe_src = _np.empty(64 << 20, _np.uint8)
+        probe_src[::4096] = 1
+        probe_dst = _np.empty_like(probe_src)
+        # Pre-fault the destination too: on this lazily-backed VM a
+        # first-touch copy runs at a fraction of the calibrated rate and
+        # would falsely flag trial 0 as contended.
+        probe_dst[::4096] = 1
+
+        def _memcpy_probe_gbps() -> float:
+            t0 = time.perf_counter()
+            _np.copyto(probe_dst, probe_src)
+            return probe_src.nbytes / max(time.perf_counter() - t0, 1e-9) / 1e9
+
+        import psutil as _psutil
+
+        proc = _psutil.Process()
+
         save_times = []
-        for trial in range(4):
+        discarded_trials = []
+        max_retries = int(os.environ.get("BENCH_TRIAL_RETRIES", "6"))
+        retries = 0
+        trial = 0
+        while trial < n_trials:
+            cpu0 = proc.cpu_times()
             t0 = time.perf_counter()
             Snapshot.take(f"{tmp}/snap", app_state)
             trial_dt = time.perf_counter() - t0
+            cpu1 = proc.cpu_times()
+            # The save is CPU-bound on this path (memcpy + CRC + tmpfs
+            # writes): a clean trial's process CPU time ~= wall. When
+            # the hypervisor/a neighbor steals the core mid-window, wall
+            # inflates while our CPU time doesn't — the ratio is a
+            # DURING-trial contention detector the post-trial probe
+            # can't be (the thief may leave before the probe runs).
+            cpu_ratio = (
+                (cpu1.user - cpu0.user) + (cpu1.system - cpu0.system)
+            ) / max(trial_dt, 1e-9)
+            probe = _memcpy_probe_gbps()
+            # The cpu/wall criterion only holds on tmpfs, where the save
+            # is CPU-bound; on the disk-directory fallback trials block
+            # in I/O wait and a low ratio is the storage medium, not a
+            # noisy neighbor — flagging those would discard every clean
+            # trial and mislabel the artifact's audit trail.
+            contended = probe < 0.5 * calibration["memcpy_gbps"] or (
+                base is not None and cpu_ratio < 0.6
+            )
             _log(
                 f"timed save {trial}: {trial_dt:.2f}s "
-                f"({nbytes / 1e9 / trial_dt:.2f} GB/s)"
+                f"({nbytes / 1e9 / trial_dt:.2f} GB/s), cpu/wall "
+                f"{cpu_ratio:.2f}, post-trial memcpy {probe:.1f} GB/s"
+                f"{' CONTENDED' if contended else ''}"
             )
-            save_times.append(trial_dt)
-            if trial < 3:
+            # Trials run BACK-TO-BACK deliberately: on this lazily-backed
+            # VM, freed tmpfs pages that sit idle get reclaimed by the
+            # host and the next trial refaults them at hypervisor speed
+            # (measured 0.1 GB/s on all-fresh pages vs 2.5 GB/s reusing
+            # just-freed ones). Sleeping between trials — the previous
+            # rounds' approach — invited exactly that reclaim; the tight
+            # loop reuses the pages the rmtree just freed.
+            if contended and retries < max_retries:
+                discarded_trials.append(round(trial_dt, 3))
+                retries += 1
                 shutil.rmtree(f"{tmp}/snap", ignore_errors=True)
-                # Page freeing for GB-scale tmpfs trees completes
-                # asynchronously in kernel workers; on few-core hosts
-                # letting it drain keeps it out of the next trial's
-                # timing window (it alternated fast/slow otherwise).
-                time.sleep(1.0)
+                continue
+            save_times.append(trial_dt)
+            trial += 1
+            if trial < n_trials:
+                shutil.rmtree(f"{tmp}/snap", ignore_errors=True)
+        del probe_src, probe_dst
         dt = min(save_times)
         p50 = statistics.median(save_times)
 
@@ -381,7 +554,12 @@ def main() -> None:
         "save_trials_s": [round(t, 3) for t in save_times],
         "restore_gbps": round((nbytes / 1e9) / min(restore_times), 3),
         "platform": jax.default_backend(),
+        "host_calibration": calibration,
     }
+    if discarded_trials:
+        # Trials where the post-trial memcpy probe showed the host was
+        # contended mid-window (neighbor/hypervisor, not the pipeline).
+        record["discarded_contended_trials_s"] = discarded_trials
     if tpu_hw is not None:
         record["tpu_hw"] = tpu_hw
     print(json.dumps(record), flush=True)

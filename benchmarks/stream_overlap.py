@@ -1,0 +1,193 @@
+"""Intra-entry streaming overlap: single-large-array save wall vs the
+serial stage+write sum.
+
+The buffered write path only overlaps staging with I/O ACROSS entries —
+within one entry, staging fully completes before the first byte hits
+storage, so a single large array's critical path is stage + write. The
+streaming path (sub-chunk pipeline, scheduler.stream_write) overlaps the
+two WITHIN the entry: sub-chunk N writes while N+1 stages, collapsing
+the wall toward max(stage, write).
+
+Two legs:
+
+- **throttled**: storage latency is simulated (per-chunk sleep at a
+  configured GB/s, the network-filesystem regime BASELINE.json targets).
+  On any host — including 1-core CI boxes where two memcpy-bound phases
+  can't parallelize — the sleep component genuinely overlaps staging, so
+  this leg ASSERTS wall_streamed < stage_s + write_s and reports the
+  overlap ratio. This is the design claim, measured.
+- **tmpfs**: real end-to-end `Snapshot.take` streamed vs buffered on
+  tmpfs, with a bit-exact restore check. Reported without an overlap
+  assertion: on a 1-core host both phases are memory-bandwidth-bound and
+  overlap cannot manifest; on multi-core hosts this leg shows the real
+  gain.
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/stream_overlap.py [mb] [sim_gbps]
+Emits one JSON line per leg.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    mb = float(sys.argv[1]) if len(sys.argv) > 1 else 512.0
+    sim_gbps = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+    from torchsnapshot_tpu.io_types import WriteReq
+    from torchsnapshot_tpu.manifest import ArrayEntry
+    from torchsnapshot_tpu.scheduler import execute_write_reqs
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    nbytes = int(mb * 1e6)
+    rows = nbytes // (1024 * 4)
+    arr = np.arange(rows * 1024, dtype=np.float32).reshape(rows, 1024)
+
+    sim_bps = sim_gbps * 1e9
+
+    class ThrottledFS(FSStoragePlugin):
+        """Adds simulated storage latency proportional to bytes written
+        — the component that genuinely overlaps with staging."""
+
+        def _pwrite_all(self, fd, buf, offset):  # streamed sub-chunks
+            n = memoryview(buf).nbytes
+            time.sleep(n / sim_bps)  # executor thread: off the loop
+            return FSStoragePlugin._pwrite_all(fd, buf, offset)
+
+        async def write(self, write_io):  # buffered whole-entry write
+            await asyncio.sleep(memoryview(write_io.buf).nbytes / sim_bps)
+            await super().write(write_io)
+
+    def mk_req():
+        entry = ArrayEntry(
+            location="payload",
+            serializer="buffer_protocol",
+            dtype="float32",
+            shape=list(arr.shape),
+            replicated=False,
+        )
+        return WriteReq(path="payload", buffer_stager=ArrayBufferStager(arr, entry))
+
+    tmp = tempfile.mkdtemp(prefix="stream_overlap_")
+    try:
+        loop = asyncio.new_event_loop()
+        plugin = ThrottledFS(tmp)
+
+        # Best-of-N legs: single measurements on a noisy 1-core host can
+        # invert a real ~25% gap; the minimum of each leg is the
+        # contention-free number the pipeline comparison is about.
+        reps = int(os.environ.get("STREAM_OVERLAP_REPS", "3"))
+
+        # -- serial reference: full stage, then full write --------------
+        from concurrent.futures import ThreadPoolExecutor
+
+        from torchsnapshot_tpu.io_types import WriteIO
+
+        stage_s = write_s = float("inf")
+        for _ in range(reps):
+            req = mk_req()
+            with ThreadPoolExecutor(2) as pool:
+                t0 = time.perf_counter()
+                buf = loop.run_until_complete(
+                    req.buffer_stager.stage_buffer(pool)
+                )
+                stage_s = min(stage_s, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            loop.run_until_complete(
+                plugin.write(WriteIO(path="serial", buf=buf))
+            )
+            write_s = min(write_s, time.perf_counter() - t0)
+            del buf
+        serial_s = stage_s + write_s
+
+        # -- streamed: one entry through the streaming pipeline ---------
+        streamed_s = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            pending = loop.run_until_complete(
+                execute_write_reqs(
+                    [mk_req()], plugin, 1 << 31, rank=0, allow_streaming=True
+                )
+            )
+            pending.sync_complete(loop)
+            streamed_s = min(streamed_s, time.perf_counter() - t0)
+
+        ok = streamed_s < serial_s
+        print(
+            json.dumps(
+                {
+                    "benchmark": "stream_overlap/throttled",
+                    "state_mb": mb,
+                    "sim_storage_gbps": sim_gbps,
+                    "stage_s": round(stage_s, 3),
+                    "write_s": round(write_s, 3),
+                    "serial_sum_s": round(serial_s, 3),
+                    "streamed_s": round(streamed_s, 3),
+                    "overlap_ratio": round(serial_s / max(streamed_s, 1e-9), 2),
+                    "wall_below_serial_sum": ok,
+                }
+            ),
+            flush=True,
+        )
+        assert ok, (
+            f"no intra-entry overlap: streamed {streamed_s:.2f}s >= "
+            f"serial {serial_s:.2f}s"
+        )
+        loop.close()
+
+        # -- tmpfs end-to-end: streamed vs buffered take + bit-exact ----
+        state = {"m": StateDict(w=arr)}
+        base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        tmp2 = tempfile.mkdtemp(prefix="stream_e2e_", dir=base)
+        try:
+            t0 = time.perf_counter()
+            Snapshot.take(f"{tmp2}/streamed", state)
+            streamed_take_s = time.perf_counter() - t0
+            os.environ["TORCHSNAPSHOT_TPU_STREAM_WRITES"] = "0"
+            t0 = time.perf_counter()
+            Snapshot.take(f"{tmp2}/buffered", state)
+            buffered_take_s = time.perf_counter() - t0
+            del os.environ["TORCHSNAPSHOT_TPU_STREAM_WRITES"]
+
+            dst = {"m": StateDict(w=np.zeros_like(arr))}
+            Snapshot(f"{tmp2}/streamed").restore(dst)
+            bit_exact = dst["m"]["w"].tobytes() == arr.tobytes()
+            print(
+                json.dumps(
+                    {
+                        "benchmark": "stream_overlap/tmpfs_take",
+                        "state_mb": mb,
+                        "streamed_take_s": round(streamed_take_s, 3),
+                        "buffered_take_s": round(buffered_take_s, 3),
+                        "streamed_gbps": round(nbytes / 1e9 / streamed_take_s, 3),
+                        "buffered_gbps": round(nbytes / 1e9 / buffered_take_s, 3),
+                        "bit_exact": bit_exact,
+                    }
+                ),
+                flush=True,
+            )
+            assert bit_exact, "streamed snapshot restore not bit-exact"
+        finally:
+            shutil.rmtree(tmp2, ignore_errors=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

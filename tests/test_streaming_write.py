@@ -1,0 +1,576 @@
+"""Sub-chunk streaming write pipeline tests.
+
+Three layers of coverage, mirroring the contract's seams:
+
+- **Storage-plugin contract**: for every plugin (fs real, s3/gcs fakes,
+  and the buffered default fallback), a streamed write must produce a
+  byte-identical object to a buffered write of the same payload, and a
+  mid-stream failure must leave NO partial object at the final path
+  (fs: temp-file + os.replace atomicity — no tmp litter either).
+- **Scheduler budget accounting**: streamed entries charge the budget
+  their in-flight sub-chunk window, never their full size — peak staged
+  memory stays under the per-rank budget even when one entry exceeds it.
+- **End-to-end**: a streamed ``Snapshot.take`` records the same
+  checksums as a buffered one, verifies on restore, and round-trips
+  bit-exactly; the I/O governor adapts sub-chunk size within env bounds
+  and resolves the preverify gate from measured rates.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_types import (
+    BufferStager,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+    WriteStream,
+)
+from torchsnapshot_tpu.scheduler import (
+    IOGovernor,
+    execute_write_reqs,
+    io_governor,
+)
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+async def _chunks_of(payload: bytes, n: int):
+    for lo in range(0, len(payload), n):
+        yield payload[lo : lo + n]
+
+
+async def _failing_chunks(payload: bytes, n: int, fail_after: int):
+    sent = 0
+    for lo in range(0, len(payload), n):
+        if sent == fail_after:
+            raise RuntimeError("injected mid-stream staging failure")
+        yield payload[lo : lo + n]
+        sent += 1
+
+
+# --------------------------------------------------------------- contract
+
+
+def test_fs_streamed_equals_buffered(tmp_path, loop) -> None:
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(1 << 20)
+    loop.run_until_complete(plugin.write(WriteIO(path="buffered", buf=payload)))
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(
+                path="a/streamed",
+                nbytes=len(payload),
+                chunks=_chunks_of(payload, 100_000),
+            )
+        )
+    )
+    assert (tmp_path / "a" / "streamed").read_bytes() == (
+        tmp_path / "buffered"
+    ).read_bytes()
+
+
+def test_fs_streamed_atomic_on_midstream_failure(tmp_path, loop) -> None:
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(1 << 20)
+    with pytest.raises(RuntimeError, match="injected"):
+        loop.run_until_complete(
+            plugin.write_stream(
+                WriteStream(
+                    path="dst",
+                    nbytes=len(payload),
+                    chunks=_failing_chunks(payload, 100_000, fail_after=3),
+                )
+            )
+        )
+    # No partial object at the final path, no temp litter.
+    assert not (tmp_path / "dst").exists()
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_fs_streamed_short_stream_rejected(tmp_path, loop) -> None:
+    """A stream that under-produces must fail loudly, not commit a
+    truncated object."""
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(100_000)
+    with pytest.raises(IOError, match="short write stream"):
+        loop.run_until_complete(
+            plugin.write_stream(
+                WriteStream(
+                    path="dst",
+                    nbytes=len(payload) + 1,
+                    chunks=_chunks_of(payload, 30_000),
+                )
+            )
+        )
+    assert not (tmp_path / "dst").exists()
+
+
+def test_buffered_fallback_plugin(tmp_path, loop) -> None:
+    """A plugin that doesn't override write_stream gets the buffered
+    default: same bytes, via its plain write()."""
+
+    class Plain(StoragePlugin):
+        def __init__(self):
+            self.writes = {}
+
+        async def write(self, write_io):
+            self.writes[write_io.path] = bytes(write_io.buf)
+
+        async def read(self, read_io):
+            raise NotImplementedError
+
+        async def delete(self, path):
+            raise NotImplementedError
+
+        async def close(self):
+            pass
+
+    plugin = Plain()
+    assert not getattr(plugin, "supports_streaming")
+    payload = os.urandom(300_000)
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(path="p", nbytes=len(payload), chunks=_chunks_of(payload, 77_000))
+        )
+    )
+    assert plugin.writes["p"] == payload
+
+
+def test_s3_streamed_multipart_equals_buffered(loop) -> None:
+    from test_s3_storage_plugin import FakeMultipartS3Client, make_plugin
+
+    payload = os.urandom(1 << 20)
+    client = FakeMultipartS3Client()
+    plugin = make_plugin(client, multipart_threshold=256 << 10)
+    # Force small parts so the stream spans several.
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    orig = s3mod.MULTIPART_PART_BYTES
+    s3mod.MULTIPART_PART_BYTES = 256 << 10
+    try:
+        loop.run_until_complete(
+            plugin.write_stream(
+                WriteStream(
+                    path="obj", nbytes=len(payload), chunks=_chunks_of(payload, 100_000)
+                )
+            )
+        )
+    finally:
+        s3mod.MULTIPART_PART_BYTES = orig
+    assert client.store[("fake-bucket", "prefix/obj")] == payload
+
+
+def test_s3_streamed_small_payload_single_put(loop) -> None:
+    from test_s3_storage_plugin import FakeS3Client, make_plugin
+
+    payload = os.urandom(200_000)
+    client = FakeS3Client()
+    plugin = make_plugin(client)  # default threshold far above payload
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(path="obj", nbytes=len(payload), chunks=_chunks_of(payload, 64_000))
+        )
+    )
+    assert client.store[("fake-bucket", "prefix/obj")] == payload
+
+
+def test_s3_streamed_midstream_failure_aborts_upload(loop) -> None:
+    from test_s3_storage_plugin import FakeMultipartS3Client, make_plugin
+
+    payload = os.urandom(1 << 20)
+    client = FakeMultipartS3Client()
+    plugin = make_plugin(client, multipart_threshold=256 << 10)
+    import torchsnapshot_tpu.storage_plugins.s3 as s3mod
+
+    orig = s3mod.MULTIPART_PART_BYTES
+    s3mod.MULTIPART_PART_BYTES = 256 << 10
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            loop.run_until_complete(
+                plugin.write_stream(
+                    WriteStream(
+                        path="obj",
+                        nbytes=len(payload),
+                        chunks=_failing_chunks(payload, 100_000, fail_after=4),
+                    )
+                )
+            )
+    finally:
+        s3mod.MULTIPART_PART_BYTES = orig
+    assert ("fake-bucket", "prefix/obj") not in client.store
+    assert client.aborted  # upload aborted server-side, no orphaned parts
+
+
+def test_gcs_streamed_equals_buffered(loop) -> None:
+    from test_gcs_storage_plugin import FakeBucket, make_plugin
+
+    payload = os.urandom(1 << 20)
+    bucket = FakeBucket()
+    plugin = make_plugin(bucket, chunk_size_bytes=256 << 10)
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(
+                path="obj", nbytes=len(payload), chunks=_chunks_of(payload, 100_000)
+            )
+        )
+    )
+    assert bucket.store["prefix/obj"] == payload
+
+
+def test_gcs_streamed_retry_replays_stream(loop) -> None:
+    """A transient upload failure mid-stream: the retained-chunk stream
+    rewinds to zero and the retry uploads the COMPLETE object."""
+    from test_gcs_storage_plugin import FakeBucket, make_plugin
+
+    payload = os.urandom(1 << 20)
+    bucket = FakeBucket(fail_times=1)
+    plugin = make_plugin(bucket, chunk_size_bytes=256 << 10)
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(
+                path="obj", nbytes=len(payload), chunks=_chunks_of(payload, 100_000)
+            )
+        )
+    )
+    assert bucket.store["prefix/obj"] == payload
+    assert bucket.blobs["prefix/obj"].upload_attempts == 2
+
+
+def test_gcs_streamed_midstream_failure_propagates(loop) -> None:
+    from test_gcs_storage_plugin import FakeBucket, make_plugin
+
+    payload = os.urandom(1 << 20)
+    bucket = FakeBucket()
+    plugin = make_plugin(bucket, chunk_size_bytes=256 << 10)
+    with pytest.raises(RuntimeError, match="injected"):
+        loop.run_until_complete(
+            plugin.write_stream(
+                WriteStream(
+                    path="obj",
+                    nbytes=len(payload),
+                    chunks=_failing_chunks(payload, 100_000, fail_after=2),
+                )
+            )
+        )
+    assert "prefix/obj" not in bucket.store
+
+
+# -------------------------------------------------- scheduler accounting
+
+
+class StreamingStager(BufferStager):
+    """Streams a synthetic payload while tracking LIVE staged bytes so
+    the test can assert the budget actually bounds sub-chunk memory."""
+
+    live_bytes = 0
+    peak_bytes = 0
+
+    def __init__(self, total: int, fill: int) -> None:
+        self.total = total
+        self.fill = fill
+
+    async def stage_buffer(self, executor=None):
+        return bytes([self.fill]) * self.total
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.total
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        return self.total >= 2 * sub_chunk_bytes
+
+    async def stage_stream(self, executor, sub_chunk_bytes: int):
+        cls = StreamingStager
+        for lo in range(0, self.total, sub_chunk_bytes):
+            n = min(sub_chunk_bytes, self.total - lo)
+            cls.live_bytes += n
+            cls.peak_bytes = max(cls.peak_bytes, cls.live_bytes)
+            await asyncio.sleep(0.001)  # let writes interleave
+            yield bytes([self.fill]) * n
+            cls.live_bytes -= n
+
+
+class CountingStreamFS(FSStoragePlugin):
+    stream_calls = 0
+    buffered_calls = 0
+
+    async def write_stream(self, stream):
+        CountingStreamFS.stream_calls += 1
+        await super().write_stream(stream)
+
+    async def write(self, write_io):
+        CountingStreamFS.buffered_calls += 1
+        await super().write(write_io)
+
+
+def _reset_counters():
+    StreamingStager.live_bytes = 0
+    StreamingStager.peak_bytes = 0
+    CountingStreamFS.stream_calls = 0
+    CountingStreamFS.buffered_calls = 0
+
+
+def test_streamed_budget_charges_sub_chunks(tmp_path, loop, monkeypatch) -> None:
+    """Entries far larger than the budget stream under it: the budget
+    charges the in-flight sub-chunk window (2 sub-chunks/entry), so peak
+    live staged bytes stays bounded while the data still lands whole."""
+    _reset_counters()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(64 << 10))
+    storage = CountingStreamFS(str(tmp_path))
+    total = 1 << 20  # 16x the sub-chunk, far over the budget below
+    reqs = [
+        WriteReq(path=f"obj_{i}", buffer_stager=StreamingStager(total, i))
+        for i in range(3)
+    ]
+    budget = 300 << 10  # < one entry; >= one entry's 2-sub-chunk window
+    pending = loop.run_until_complete(
+        execute_write_reqs(reqs, storage, budget, rank=0, allow_streaming=True)
+    )
+    pending.sync_complete(loop)
+    assert CountingStreamFS.stream_calls == 3
+    assert StreamingStager.peak_bytes <= budget
+    for i in range(3):
+        assert (tmp_path / f"obj_{i}").read_bytes() == bytes([i]) * total
+
+
+def test_streaming_respects_plugin_opt_in(tmp_path, loop, monkeypatch) -> None:
+    """A plugin without supports_streaming never sees streamed entries
+    (the buffered fallback would break sub-chunk budget accounting)."""
+    _reset_counters()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(64 << 10))
+
+    class NoStreamFS(CountingStreamFS):
+        supports_streaming = False
+
+    storage = NoStreamFS(str(tmp_path))
+    reqs = [WriteReq(path="obj", buffer_stager=StreamingStager(1 << 20, 5))]
+    pending = loop.run_until_complete(
+        execute_write_reqs(reqs, storage, 1 << 30, rank=0, allow_streaming=True)
+    )
+    pending.sync_complete(loop)
+    assert CountingStreamFS.stream_calls == 0
+    assert CountingStreamFS.buffered_calls == 1
+    assert (tmp_path / "obj").read_bytes() == bytes([5]) * (1 << 20)
+
+
+def test_streaming_off_for_async_path(tmp_path, loop, monkeypatch) -> None:
+    """allow_streaming=False (async_take's mode) stages whole buffers
+    even when stager and plugin both support streaming."""
+    _reset_counters()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(64 << 10))
+    storage = CountingStreamFS(str(tmp_path))
+    reqs = [WriteReq(path="obj", buffer_stager=StreamingStager(1 << 20, 9))]
+    pending = loop.run_until_complete(
+        execute_write_reqs(reqs, storage, 1 << 30, rank=0, allow_streaming=False)
+    )
+    pending.sync_complete(loop)
+    assert CountingStreamFS.stream_calls == 0
+    assert (tmp_path / "obj").read_bytes() == bytes([9]) * (1 << 20)
+
+
+def test_streamed_failure_propagates_and_cancels(tmp_path, loop, monkeypatch) -> None:
+    _reset_counters()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(64 << 10))
+
+    class FailingStager(StreamingStager):
+        async def stage_stream(self, executor, sub_chunk_bytes):
+            yield b"x" * sub_chunk_bytes
+            raise RuntimeError("injected staging failure")
+
+    storage = CountingStreamFS(str(tmp_path))
+    reqs = [
+        WriteReq(path="bad", buffer_stager=FailingStager(1 << 20, 0)),
+        WriteReq(path="good", buffer_stager=StreamingStager(1 << 20, 1)),
+    ]
+    with pytest.raises(RuntimeError, match="injected staging failure"):
+        pending = loop.run_until_complete(
+            execute_write_reqs(reqs, storage, 1 << 30, rank=0, allow_streaming=True)
+        )
+        pending.sync_complete(loop)
+    assert not (tmp_path / "bad").exists()
+
+
+# ------------------------------------------------------------ end to end
+
+
+def test_take_streams_and_roundtrips(tmp_path, monkeypatch) -> None:
+    """Sync take streams large plain entries; checksums are recorded,
+    verified on restore, and identical to a buffered take's."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(128 << 10))
+    state = {
+        "app": StateDict(
+            w=np.arange(500_000, dtype=np.float32).reshape(500, 1000),
+            small=np.ones(16, np.float64),
+        )
+    }
+    Snapshot.take(str(tmp_path / "streamed"), state)
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_STREAM_WRITES", "0")
+    Snapshot.take(str(tmp_path / "buffered"), state)
+
+    import json
+
+    def checksums(p):
+        meta = json.loads((tmp_path / p / ".snapshot_metadata").read_text())
+        found = {}
+
+        def walk(node):
+            if isinstance(node, dict):
+                if node.get("checksum") and node.get("location"):
+                    # Keyed by RELATIVE payload name: the two snapshots
+                    # live under different roots but share the layout.
+                    found[node["location"]] = node["checksum"]
+                for v in node.values():
+                    walk(v)
+            elif isinstance(node, list):
+                for v in node:
+                    walk(v)
+
+        walk(meta["manifest"])
+        return found
+
+    streamed, buffered = checksums("streamed"), checksums("buffered")
+    assert streamed and streamed == buffered
+
+    monkeypatch.delenv("TORCHSNAPSHOT_TPU_STREAM_WRITES", raising=False)
+    dst = {
+        "app": StateDict(
+            w=np.zeros((500, 1000), np.float32), small=np.zeros(16, np.float64)
+        )
+    }
+    Snapshot(str(tmp_path / "streamed")).restore(dst)  # verifies checksums
+    assert np.array_equal(dst["app"]["w"], state["app"]["w"])
+    assert np.array_equal(dst["app"]["small"], state["app"]["small"])
+
+
+def test_stream_kill_switch(tmp_path, monkeypatch) -> None:
+    _reset_counters()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_STREAM_WRITES", "0")
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(64 << 10))
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+
+    stager = ArrayBufferStager(np.ones(1 << 20, np.uint8))
+    assert not stager.can_stream(64 << 10)
+
+
+def test_stager_streamed_bytes_match_buffered(loop, monkeypatch) -> None:
+    """ArrayBufferStager.stage_stream concatenation == stage_buffer."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+    from torchsnapshot_tpu.manifest import ArrayEntry
+
+    arr = np.arange(200_000, dtype=np.int32).reshape(400, 500)
+
+    async def collect():
+        entry = ArrayEntry(
+            location="x",
+            serializer="buffer_protocol",
+            dtype="int32",
+            shape=list(arr.shape),
+            replicated=False,
+        )
+        stager = ArrayBufferStager(arr, entry)
+        assert stager.can_stream(100_000)
+        with ThreadPoolExecutor(2) as pool:
+            parts = []
+            async for chunk in stager.stage_stream(pool, 100_000):
+                parts.append(bytes(memoryview(chunk)))
+        return b"".join(parts), entry.checksum
+
+    streamed, checksum = loop.run_until_complete(collect())
+    assert streamed == arr.tobytes()
+    if checksum is not None:
+        from torchsnapshot_tpu.integrity import verify_checksum
+
+        verify_checksum(streamed, checksum, "x")  # must not raise
+
+
+def test_stager_consistency_copy_stream(loop) -> None:
+    """Outside zero-copy staging (copy_for_consistency=True) the stream
+    bounces through pooled slabs: mutating the source AFTER a chunk is
+    yielded must not corrupt already-yielded bytes."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferStager
+
+    arr = np.zeros(500_000, np.uint8)
+    expect = arr.tobytes()
+
+    async def collect():
+        stager = ArrayBufferStager(arr)
+        assert stager.copy_for_consistency
+        with ThreadPoolExecutor(2) as pool:
+            parts = []
+            async for chunk in stager.stage_stream(pool, 100_000):
+                parts.append(chunk)  # keep the buffer, not a copy
+                arr[:] = 255  # mutate source mid-stream
+        return parts
+
+    parts = loop.run_until_complete(collect())
+    first = bytes(memoryview(parts[0]))
+    assert first == expect[: len(first)]  # yielded bytes are snapshots
+
+
+# -------------------------------------------------------------- governor
+
+
+def test_governor_sub_chunk_adapts_within_bounds(monkeypatch) -> None:
+    monkeypatch.delenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", raising=False)
+    gov = IOGovernor()
+    assert gov.sub_chunk_bytes() == 64 << 20  # default, no measurements
+    gov.record_write("FSStoragePlugin", 10 << 30, 1.0)  # 10 GB/s
+    assert gov.sub_chunk_bytes("FSStoragePlugin") == 256 << 20  # clamped max
+    gov2 = IOGovernor()
+    gov2.record_write("S3StoragePlugin", 50 << 20, 1.0)  # 50 MB/s
+    assert gov2.sub_chunk_bytes("S3StoragePlugin") == 8 << 20  # clamped min
+
+
+def test_governor_env_pin_wins(monkeypatch) -> None:
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(32 << 20))
+    gov = IOGovernor()
+    gov.record_write("FSStoragePlugin", 10 << 30, 1.0)
+    assert gov.sub_chunk_bytes("FSStoragePlugin") == 32 << 20
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_IO_CONCURRENCY", "3")
+    assert gov.io_concurrency() == 3
+
+
+def test_governor_preverify_gate(monkeypatch) -> None:
+    monkeypatch.delenv("TORCHSNAPSHOT_TPU_PREVERIFY", raising=False)
+    gov = IOGovernor()
+    # No measurements: status-quo verify.
+    assert gov.should_preverify()
+    # Hash-bound regime (slow storage): verify.
+    gov.record_read("S3StoragePlugin", 50 << 20, 1.0)
+    gov.record_hash(2 << 30, 1.0)
+    assert gov.should_preverify()
+    # Read-bound regime (fast storage, slow hasher): skip.
+    gov2 = IOGovernor()
+    gov2.record_read("FSStoragePlugin", 6 << 30, 1.0)
+    gov2.record_hash(1 << 30, 1.0)
+    assert not gov2.should_preverify()
+    # Env overrides beat measurements both ways.
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_PREVERIFY", "always")
+    assert gov2.should_preverify()
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_PREVERIFY", "never")
+    assert not gov.should_preverify()
+
+
+def test_scheduler_records_rates(tmp_path, loop) -> None:
+    """Real writes/reads feed the process governor's EWMA tables."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    state = {"app": StateDict(w=np.ones(100_000, np.float32))}
+    Snapshot.take(str(tmp_path / "s"), state)
+    rates = io_governor().measured_rates()
+    assert rates["write_bps"].get("FSStoragePlugin", 0) > 0

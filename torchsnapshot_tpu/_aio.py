@@ -1,0 +1,97 @@
+"""Thread-pool async file I/O with the aiofiles surface the FS plugin uses.
+
+Hermetic containers ship without aiofiles; rather than gate the *local
+filesystem* plugin — the one backend that must always work — this shim
+provides the exact subset ``storage_plugins/fs.py`` consumes
+(``open`` as an async context manager with write/read/readinto/seek/
+flush/fileno, plus ``os.replace``/``os.remove``), implemented the same
+way aiofiles itself is: blocking calls delegated to the event loop's
+default thread pool, so file I/O still overlaps staging (file syscalls
+release the GIL). ``fs.py`` imports the real aiofiles when available and
+falls back to this module, so behavior is identical either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import builtins
+import functools
+import os as _os
+
+
+class _AsyncFile:
+    """Async facade over a blocking file object."""
+
+    def __init__(self, f) -> None:
+        self._f = f
+
+    async def _run(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, functools.partial(fn, *args))
+
+    async def write(self, data) -> int:
+        return await self._run(self._f.write, data)
+
+    async def read(self, n: int = -1):
+        return await self._run(self._f.read, n)
+
+    async def readinto(self, buf) -> int:
+        return await self._run(self._f.readinto, buf)
+
+    async def seek(self, pos: int, whence: int = 0) -> int:
+        return await self._run(self._f.seek, pos, whence)
+
+    async def flush(self) -> None:
+        return await self._run(self._f.flush)
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+    async def close(self) -> None:
+        return await self._run(self._f.close)
+
+
+class _OpenContext:
+    def __init__(self, *args, **kwargs) -> None:
+        self._args = args
+        self._kwargs = kwargs
+        self._af: _AsyncFile | None = None
+
+    async def __aenter__(self) -> _AsyncFile:
+        loop = asyncio.get_running_loop()
+        # builtins.open explicitly: this module's own ``open`` attribute
+        # is the async version (aiofiles surface parity).
+        f = await loop.run_in_executor(
+            None, functools.partial(builtins.open, *self._args, **self._kwargs)
+        )
+        self._af = _AsyncFile(f)
+        return self._af
+
+    async def __aexit__(self, *exc) -> None:
+        if self._af is not None:
+            await self._af.close()
+
+
+def aio_open(*args, **kwargs) -> _OpenContext:
+    return _OpenContext(*args, **kwargs)
+
+
+# Module-shaped so ``from .. import _aio as aiofiles`` is a drop-in:
+# ``aiofiles.open(...)`` and ``aiofiles.os.replace/remove``.
+open_ = aio_open
+globals()["open"] = aio_open
+
+
+class _AioOs:
+    @staticmethod
+    async def replace(src: str, dst: str) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, _os.replace, src, dst)
+
+    @staticmethod
+    async def remove(path: str) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, _os.remove, path)
+
+
+os = _AioOs()

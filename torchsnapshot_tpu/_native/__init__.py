@@ -71,7 +71,20 @@ def _load_locked() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
     if _load_attempted:  # raced another thread to the lock
         return _lib
-    _load_attempted = True
+    try:
+        _lib = _try_load()
+    finally:
+        # Published AFTER _lib: _load()'s unlocked fast path reads
+        # `_load_attempted` without the lock, so setting it first would
+        # let a concurrent caller observe attempted=True with a stale
+        # _lib=None and silently take the slow Python fallback for the
+        # rest of ITS call sites (observed as nondeterministic crc32-vs-
+        # crc32c checksums when streaming's first-touch raced staging).
+        _load_attempted = True
+    return _lib
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
     if os.environ.get(DISABLE_NATIVE_ENV_VAR, "0") not in ("0", "", "false"):
         return None
     fresh = os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
@@ -101,8 +114,7 @@ def _load_locked() -> Optional[ctypes.CDLL]:
     lib.ts_copy_crc32c.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
     ]
-    _lib = lib
-    return _lib
+    return lib
 
 
 def native_available() -> bool:
@@ -239,12 +251,16 @@ def gather_copy(dst, sources: Sequence[Tuple[int, Any]]) -> None:
 
 # ------------------------------------------------------- fused copy + crc
 
-def copy_crc32c(dst, src) -> Optional[int]:
+def copy_crc32c(dst, src, crc: int = 0) -> Optional[int]:
     """``dst[:] = src[:]`` and return the bytes' CRC32C, reading the source
     ONCE (async_take staging fuses its consistency copy with the integrity
     checksum — one memory pass instead of two). Returns None when the
     native extension is unavailable; callers fall back to copy-then-hash.
-    Both buffers must be contiguous and equal-sized."""
+    Both buffers must be contiguous and equal-sized.
+
+    Chainable like :func:`crc32c` via ``crc``: the streaming write path
+    fuses each sub-chunk's bounce copy with the running checksum —
+    ``copy_crc32c(d2, b, copy_crc32c(d1, a)) == crc32c(a + b)``."""
     lib = _load()
     if lib is None:
         return None
@@ -258,10 +274,10 @@ def copy_crc32c(dst, src) -> Optional[int]:
             f"src={src_arr.nbytes}B"
         )
     if src_arr.nbytes == 0:
-        return 0
+        return crc
     return lib.ts_copy_crc32c(
         ctypes.c_void_p(dst_addr),
         ctypes.c_void_p(src_addr),
         src_arr.nbytes,
-        ctypes.c_uint32(0),
+        ctypes.c_uint32(crc),
     )

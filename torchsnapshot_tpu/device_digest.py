@@ -286,6 +286,48 @@ def combine_partials(lane_groups, nbytes: int) -> str:
     return _fold_lanes(total, nbytes)
 
 
+_HASH_PROBE_BYTES = 16 << 20
+_hash_probe_done = False
+
+
+def probe_hash_throughput() -> Optional[float]:
+    """One-time on-device fingerprint throughput probe, recorded into the
+    scheduler's I/O governor. The restore-side preverify gate needs the
+    hash side of its hash-vs-read crossover even when no fingerprint
+    warmup ran in this process; a single ~16 MB fingerprint (dispatched
+    twice — the first pays the jit compile, the second is the measured
+    steady state) settles it for the process lifetime. Returns the
+    measured bytes/sec, or None when no device fingerprinting is
+    available (the gate then keeps the status-quo verify)."""
+    global _hash_probe_done
+    if _hash_probe_done:
+        from .scheduler import io_governor
+
+        return io_governor().hash_bps()
+    _hash_probe_done = True
+    try:
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        arr = jnp.zeros((_HASH_PROBE_BYTES // 4,), jnp.uint32)
+        jax.block_until_ready(arr)
+        pending = _dispatch(arr)  # compile pass, untimed
+        if pending is None:
+            return None
+        jax.block_until_ready(pending)
+        t0 = time.perf_counter()
+        jax.block_until_ready(_dispatch(arr))
+        dt = time.perf_counter() - t0
+        from .scheduler import io_governor
+
+        io_governor().record_hash(_HASH_PROBE_BYTES, dt)
+        return io_governor().hash_bps()
+    except Exception:  # pragma: no cover - probe must never break restore
+        return None
+
+
 def device_fingerprint(arr) -> Optional[str]:
     """128-bit fingerprint of a (fully addressable) jax array's content,
     computed on device; only 16 bytes cross to the host.
@@ -315,21 +357,27 @@ def fingerprints_match(
 ) -> bool:
     """Bounded-memory fingerprint comparison for restore-side skips.
 
-    ``items`` is an iterable of ``(nbytes, get_slice, expected)``:
-    ``nbytes`` the slice's byte size (callers know it from the manifest
-    geometry — shapes x dtype — without touching the device; it must
-    equal the materialized slice's size, since the digest folds the
-    length in), ``get_slice`` a thunk producing the device slice to
-    verify, ``expected`` the manifest-recorded digest. A window of
-    slices is dispatched together before the first 16-byte fetch — ~one
+    ``items`` is an iterable of ``(nbytes, get_slice, expected)`` or
+    ``(nbytes, get_slice, expected, cost_bytes)``: ``nbytes`` the
+    slice's byte size (callers know it from the manifest geometry —
+    shapes x dtype — without touching the device; it must equal the
+    materialized slice's size, since the digest folds the length in),
+    ``get_slice`` a thunk producing the device slice to verify,
+    ``expected`` the manifest-recorded digest, and ``cost_bytes`` the
+    item's TRANSIENT device footprint when it exceeds ``nbytes`` —
+    assembled pieces (see sharded._make_assembler) hold the zeroed
+    assembly target plus device copies of the overlapping parts, ~2x
+    their logical size, and must say so or a window of them would
+    transiently reach ~2x the documented bound. A window of slices is
+    dispatched together before the first 16-byte fetch — ~one
     host<->device roundtrip per window, not per slice (the roundtrip,
     not the hash, dominates for small/medium slices on tunneled links) —
     then the slice references are dropped before the next window
     materializes. A window closes at ``window`` slices or before the
-    slice that would push it past ``window_bytes`` (a single over-budget
-    slice still goes alone); the budget check runs BEFORE ``get_slice``,
-    so nothing is materialized twice and transient device memory never
-    exceeds ~window_bytes of copied slices — not the array's whole
+    slice that would push it past ``window_bytes`` of COST (a single
+    over-budget slice still goes alone); the budget check runs BEFORE
+    ``get_slice``, so nothing is materialized twice and transient device
+    memory never exceeds ~window_bytes — not the array's whole
     footprint. Returns False on the first mismatch or unfingerprintable
     slice (callers fall back to a normal read); remaining windows are
     never materialized.
@@ -347,18 +395,20 @@ def fingerprints_match(
         batch_bytes = 0
         while len(pendings) < window and batch_bytes < window_bytes:
             if carried is not None:
-                nbytes, get_slice, expected = carried
+                item = carried
                 carried = None
             else:
                 try:
-                    nbytes, get_slice, expected = next(it)
+                    item = next(it)
                 except StopIteration:
                     break
-            if pendings and batch_bytes + nbytes > window_bytes:
+            nbytes, get_slice, expected = item[0], item[1], item[2]
+            cost = item[3] if len(item) > 3 else nbytes
+            if pendings and batch_bytes + cost > window_bytes:
                 # Over budget with work already in flight: finalize the
                 # current window first. Nothing was materialized for this
                 # item yet — the size came from the manifest.
-                carried = (nbytes, get_slice, expected)
+                carried = item
                 break
             arr = get_slice()
             pending = _dispatch(arr)
@@ -367,7 +417,7 @@ def fingerprints_match(
             # Keep only (pending, nbytes): the slice buffer itself can be
             # freed as soon as the jit consumes it.
             pendings.append((pending, nbytes, expected))
-            batch_bytes += nbytes
+            batch_bytes += cost
             del arr
         if not pendings:
             return True

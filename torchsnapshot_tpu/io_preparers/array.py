@@ -75,6 +75,15 @@ def zero_copy_staging():
 STAGING_POOL_ENV_VAR = "TORCHSNAPSHOT_TPU_STAGING_POOL_BYTES"
 _DEFAULT_STAGING_POOL_BYTES = 4 << 30
 
+STREAM_WRITES_ENV_VAR = "TORCHSNAPSHOT_TPU_STREAM_WRITES"
+
+
+def streaming_enabled() -> bool:
+    """Kill switch for the sub-chunk streaming write path (default on).
+    The scheduler still gates streaming on the storage plugin's own
+    opt-in and on the caller blocking until I/O drains (sync take)."""
+    return os.environ.get(STREAM_WRITES_ENV_VAR, "1") not in ("0", "false", "")
+
 
 # Pure-Python buffer exporters (__buffer__) are honored from CPython 3.12
 # (PEP 688); earlier interpreters cannot express the holder pattern below,
@@ -575,6 +584,185 @@ class ArrayBufferStager(BufferStager):
                 # exactly what storage returns, before decompression.
                 self.entry.checksum = compute_checksum(buf)
         return buf
+
+    # ----------------------------------------------------- streaming path
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        """True when this payload can be produced as ordered sub-chunks
+        (the scheduler then fuses staging with the storage write).
+
+        Only the PLAIN path streams — the exact cases where the staged
+        bytes are a straight serialization of the array: no dedup context
+        (digest/skip decisions need the whole payload), no compression
+        (slab offsets and codecs are whole-buffer), no batcher byte-range
+        (the slab stager owns those), and a C-contiguous source (so
+        sub-chunks are contiguous byte ranges of the serialized stream).
+        Checksums DO stream: the CRC chains across sub-chunks
+        (integrity-identical to the buffered path)."""
+        if not streaming_enabled():
+            return False
+        if self.dedup is not None or self._active_codec() is not None:
+            return False
+        if self.entry is not None and self.entry.byte_range is not None:
+            return False
+        arr = self.arr
+        shape = getattr(arr, "shape", None)
+        if shape is None or 0 in tuple(shape):
+            return False
+        nbytes = array_nbytes(arr)
+        # A stream of one chunk is a buffered write with extra hops.
+        if nbytes < 2 * sub_chunk_bytes:
+            return False
+        if _is_jax_array(arr):
+            if not getattr(arr, "is_fully_addressable", True):
+                return False
+            if next(iter(arr.sharding.device_set)).platform != "cpu":
+                # Device-backed: sub-chunks are WHOLE-ROW slices along
+                # dim 0. A row wider than the sub-chunk would make each
+                # "sub-chunk" row-sized — far over the window the budget
+                # charges, with the pipeline degenerating toward serial
+                # — so such shapes stay on the buffered path.
+                if len(shape) < 1 or shape[0] < 2:
+                    return False
+                row_bytes = nbytes // shape[0]
+                return row_bytes <= sub_chunk_bytes
+            host = np.asarray(arr)
+            return host.flags["C_CONTIGUOUS"]
+        if isinstance(arr, np.ndarray):
+            return arr.flags["C_CONTIGUOUS"]
+        return False
+
+    def _stream_checksum_update(self, state: Optional[Tuple], chunk) -> Optional[Tuple]:
+        """Advance the running checksum with ``chunk``; ``state`` is
+        ``(algo, value)`` or None when checksums are off. Algorithm
+        choice mirrors integrity.compute_checksum so streamed and
+        buffered writes of the same bytes record identical checksums."""
+        if state is None:
+            return None
+        algo, value = state
+        if algo == "crc32c":
+            from .._native import crc32c
+
+            return (algo, crc32c(chunk, value))
+        import zlib
+
+        return (algo, zlib.crc32(memoryview(chunk).cast("B"), value))
+
+    def _stream_checksum_init(self) -> Optional[Tuple]:
+        if self.entry is None:
+            return None
+        from ..integrity import checksums_enabled
+
+        if not checksums_enabled():
+            return None
+        from .._native import native_available
+
+        return ("crc32c", 0) if native_available() else ("crc32", 0)
+
+    def _stream_checksum_finish(self, state: Optional[Tuple]) -> None:
+        if state is not None:
+            algo, value = state
+            self.entry.checksum = f"{algo}:{value & 0xFFFFFFFF:08x}"
+
+    def _host_sub_chunk(self, mv: memoryview, lo: int, hi: int, state):
+        """One host-backed sub-chunk: a zero-copy byte slice when staging
+        may alias caller memory (sync take), else a pooled-slab bounce
+        copy FUSED with the running CRC (one pass over the source — the
+        streaming analogue of _stage_fused). Returns (buffer, state)."""
+        chunk = mv[lo:hi]
+        if not self.copy_for_consistency:
+            return chunk, self._stream_checksum_update(state, chunk)
+        dst = _staging_pool.get(hi - lo)
+        if state is not None and state[0] == "crc32c":
+            from .._native import copy_crc32c
+
+            crc = copy_crc32c(dst, chunk, state[1])
+            if crc is not None:
+                return memoryview(dst), ("crc32c", crc)
+        np.copyto(dst, np.frombuffer(chunk, np.uint8))
+        return memoryview(dst), self._stream_checksum_update(state, chunk)
+
+    async def stage_stream(self, executor, sub_chunk_bytes: int):
+        """Ordered sub-chunk buffers; concatenation == the buffered
+        payload, and the entry records the identical checksum.
+
+        Staging runs ONE SUB-CHUNK AHEAD of the consumer: chunk N+1's
+        staging future is scheduled BEFORE chunk N is yielded (the
+        running CRC allows it — N's checksum state exists by then), so
+        while the plugin writes chunk N the executor stages N+1. That
+        lookahead is the entire overlap: an async generator is otherwise
+        strictly sequential with its consumer. Device-backed jax arrays
+        additionally kick ``copy_to_host_async`` for slice N+1 before
+        materializing slice N, so the DtoH DMA rides under the current
+        slice's checksum + write as well. In-flight memory is bounded by
+        the chunk being written plus the chunk being staged — the
+        _STREAM_DEPTH window the scheduler's budget charges. All byte
+        work runs in the executor, never on the event loop."""
+        arr = self.arr
+        loop = asyncio.get_running_loop()
+        state = self._stream_checksum_init()
+        device_backed = _is_jax_array(arr) and (
+            next(iter(arr.sharding.device_set)).platform != "cpu"
+        )
+        if not device_backed:
+            host = np.asarray(arr)
+            mv = array_as_memoryview(host)
+            total = mv.nbytes
+            bounds = list(range(0, total, sub_chunk_bytes)) + [total]
+            spans = list(zip(bounds[:-1], bounds[1:]))
+            fut = loop.run_in_executor(
+                executor, self._host_sub_chunk, mv, *spans[0], state
+            )
+            for nxt in spans[1:]:
+                chunk, state = await fut
+                # Lookahead: N+1 stages while the consumer writes N.
+                fut = loop.run_in_executor(
+                    executor, self._host_sub_chunk, mv, *nxt, state
+                )
+                yield chunk
+            chunk, state = await fut
+            yield chunk
+            self._stream_checksum_finish(state)
+            return
+
+        row_bytes = max(1, array_nbytes(arr) // arr.shape[0])
+        rows_per = max(1, sub_chunk_bytes // row_bytes)
+        ranges = [
+            (lo, min(lo + rows_per, arr.shape[0]))
+            for lo in range(0, arr.shape[0], rows_per)
+        ]
+
+        def _kick(lo: int, hi: int):
+            piece = arr[lo:hi]
+            try:
+                piece.copy_to_host_async()
+            except Exception:
+                pass
+            return piece
+
+        def _materialize(piece, st):
+            host = np.asarray(piece)
+            if not host.flags["C_CONTIGUOUS"]:
+                host = np.ascontiguousarray(host)
+            buf = array_as_memoryview(host)
+            return buf, self._stream_checksum_update(st, buf)
+
+        pieces = [_kick(*ranges[0])]
+        if len(ranges) > 1:
+            pieces.append(_kick(*ranges[1]))  # DMA one slice ahead
+        fut = loop.run_in_executor(executor, _materialize, pieces[0], state)
+        for i in range(1, len(ranges)):
+            if i + 1 < len(ranges):
+                pieces.append(_kick(*ranges[i + 1]))
+            buf, state = await fut
+            # Lookahead: slice i materializes while the consumer writes
+            # slice i-1 (its DMA was kicked one iteration earlier).
+            fut = loop.run_in_executor(executor, _materialize, pieces[i], state)
+            pieces[i - 1] = None  # drop the written slice's device ref
+            yield buf
+        buf, state = await fut
+        yield buf
+        self._stream_checksum_finish(state)
 
     async def stage_buffer(self, executor=None) -> BufferType:
         arr = self.arr

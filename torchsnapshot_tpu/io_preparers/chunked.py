@@ -7,6 +7,14 @@ pipeline through the budgeted scheduler instead of staging one giant buffer.
 
 Chunk layout is recorded as N-D offsets/sizes (same schema as shards), so
 restore is a region-fill of the destination and works for any chunk subset.
+
+WITHIN a chunk, writes stream: each chunk's WriteReq carries an
+ArrayBufferStager, whose sub-chunk streaming protocol
+(``can_stream``/``stage_stream``, io_preparers/array.py) the scheduler
+fuses with the storage write on sync takes — so even a single 512 MB
+chunk's DtoH copy, serialization, and write overlap instead of
+serializing (the chunk split bounds memory and enables striping; the
+sub-chunk stream bounds the intra-chunk critical path).
 """
 
 from __future__ import annotations

@@ -124,7 +124,14 @@ def _make_assembler(local: Dict[Box, Any], overlaps, piece_shape):
     digest check to verify a piece that no single addressable shard
     contains; called windowed by fingerprints_match, so at most a few
     assembled pieces are live at a time. The caller guarantees the
-    overlap regions exactly cover the piece."""
+    overlap regions exactly cover the piece.
+
+    Transient footprint is ~2x the piece's size, not 1x: the zeroed
+    assembly target coexists with the device_put copies of every
+    overlapping part until the last ``.at[].set`` lands. Window items
+    built from this thunk must account the 2x as their cost
+    (fingerprints_match's ``cost_bytes``) so a window of assembled
+    pieces stays under MATCH_WINDOW_BYTES of REAL device memory."""
 
     def assemble():
         import jax
@@ -439,11 +446,17 @@ class ShardedArrayIOPreparer:
             )
             if covered != piece_vol:
                 return False
+            piece_bytes = array_size_bytes(shard.sizes, entry.dtype)
             to_check.append(
                 (
-                    array_size_bytes(shard.sizes, entry.dtype),
+                    piece_bytes,
                     _make_assembler(local, overlaps, tuple(shard.sizes)),
                     shard.array.device_digest,
+                    # Assembly transiently holds the zeroed piece PLUS
+                    # device copies of the overlapping parts — ~2x the
+                    # piece — so the window budget is charged 2x
+                    # (ADVICE r5 low #2).
+                    2 * piece_bytes,
                 )
             )
         if not to_check:

@@ -11,9 +11,23 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass
-from typing import Awaitable, Callable, List, Optional, Tuple, Union
+from typing import (
+    AsyncIterator,
+    Awaitable,
+    Callable,
+    List,
+    Optional,
+    Tuple,
+    Union,
+)
 
 BufferType = Union[bytes, bytearray, memoryview]
+
+# In-flight sub-chunks per streamed entry on the STAGER side: one being
+# written plus one staging ahead (the stager's lookahead). The scheduler
+# charges at least this window for a streamed entry; plugins with extra
+# retention add theirs via ``StoragePlugin.stream_admission_cost``.
+STREAM_DEPTH = 2
 
 
 @dataclass
@@ -22,6 +36,31 @@ class WriteIO:
 
     path: str
     buf: BufferType
+
+
+@dataclass
+class WriteStream:
+    """An ORDERED stream of sub-chunk buffers for one storage path.
+
+    The streaming write path lets a single entry's DtoH copy,
+    serialization, and storage write overlap: the stager yields 32-64 MB
+    sub-chunks as they land on the host, and the plugin writes each one
+    while the next is still being staged — the entry's critical path
+    becomes ~max(stage, write) instead of stage + write.
+
+    ``nbytes`` is the exact total payload size, known before the first
+    chunk is produced (plugins use it to pick a protocol — e.g. S3
+    multipart vs single PUT — and to validate the stream on completion).
+    ``chunks`` yields buffers whose concatenation IS the payload; each
+    buffer stays valid for as long as the plugin holds a reference
+    (sub-chunk slabs are recycled by the GC, never in place), so cloud
+    plugins may retain consumed chunks for retry replay — at the cost of
+    holding that memory until the write commits.
+    """
+
+    path: str
+    nbytes: int
+    chunks: AsyncIterator[BufferType]
 
 
 @dataclass
@@ -56,6 +95,27 @@ class BufferStager(abc.ABC):
     def get_staging_cost_bytes(self) -> int:
         """Peak host memory the staged buffer will occupy."""
         ...
+
+    # Optional streaming protocol. A stager that can produce its payload
+    # as an ordered sequence of sub-chunk buffers (ArrayBufferStager for
+    # plain uncompressed arrays) overrides both methods; the scheduler
+    # then fuses staging and storage I/O for the entry — sub-chunk N
+    # writes while sub-chunk N+1 stages — and charges the memory budget
+    # only the in-flight sub-chunk window, not the whole entry.
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        """True when this stager can stream its payload in
+        ``sub_chunk_bytes`` pieces. Default: buffered staging only."""
+        return False
+
+    def stage_stream(
+        self, executor, sub_chunk_bytes: int
+    ) -> AsyncIterator[BufferType]:
+        """Ordered sub-chunk buffers whose concatenation is exactly the
+        payload ``stage_buffer`` would have produced (same bytes, same
+        recorded checksum). Only called when ``can_stream`` returned
+        True for the same ``sub_chunk_bytes``."""
+        raise NotImplementedError
 
 
 class BufferConsumer(abc.ABC):
@@ -95,9 +155,59 @@ class StoragePlugin(abc.ABC):
     on them. Implementations must be safe to drive from an asyncio event loop.
     """
 
+    # True only on plugins whose ``write_stream`` consumes chunks
+    # incrementally (fs/s3/gcs). The scheduler elects streaming — and
+    # charges the memory budget per sub-chunk — only when this is set:
+    # against the buffered fallback below, a "streamed" entry would
+    # occupy its full size while the budget charged a sub-chunk window.
+    supports_streaming: bool = False
+
+    def stream_admission_cost(self, nbytes: int, sub_chunk_bytes: int) -> int:
+        """Peak host memory ONE streamed entry of ``nbytes`` holds while
+        this plugin consumes its stream — what the scheduler charges the
+        memory budget instead of the entry's full size. The default is
+        the stager-side window (the chunk being written plus the chunk
+        staging ahead); plugins that RETAIN consumed chunks — cloud
+        retry replay, multipart part buffers — must override with their
+        real retention so the per-rank budget keeps bounding actual
+        memory."""
+        return min(nbytes, STREAM_DEPTH * sub_chunk_bytes)
+
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None:
         ...
+
+    async def write_stream(self, stream: WriteStream) -> None:
+        """Consume an ordered sub-chunk stream into one stored object.
+
+        Plugins that can overlap transport with staging override this
+        (fs: positional pwrites into the temp file; s3: multipart parts;
+        gcs: resumable-protocol chunks). This default is the BUFFERED
+        fallback — it accumulates the stream and delegates to ``write``,
+        so every plugin (including out-of-tree ones) keeps working when
+        the scheduler elects streaming; such plugins just don't get the
+        intra-entry overlap."""
+        parts: List[BufferType] = []
+        async for chunk in stream.chunks:
+            parts.append(chunk)
+        if len(parts) == 1:
+            buf: BufferType = parts[0]
+        else:
+            assembled = bytearray(stream.nbytes)
+            pos = 0
+            for part in parts:
+                mv = memoryview(part).cast("B")
+                assembled[pos : pos + mv.nbytes] = mv
+                pos += mv.nbytes
+            del parts
+            buf = assembled
+        got = memoryview(buf).nbytes
+        if got != stream.nbytes:
+            raise IOError(
+                f"short write stream for {stream.path!r}: produced {got} "
+                f"of {stream.nbytes} bytes"
+            )
+        await self.write(WriteIO(path=stream.path, buf=buf))
 
     @abc.abstractmethod
     async def read(self, read_io: ReadIO) -> None:

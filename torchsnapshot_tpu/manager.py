@@ -60,6 +60,8 @@ import os
 import re
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from .pg_wrapper import PGWrapper, ProcessGroup
 from .preemption import PreemptionWatcher
 from .snapshot import PendingSnapshot, Snapshot
@@ -223,10 +225,14 @@ class CheckpointManager:
         multi-device processes). Host numpy leaves are skipped: the save
         never fingerprints them (``_device_dedup_candidate`` requires a
         jax array)."""
+        import time as _time
+
         from .device_digest import _dispatch
         from .io_preparers.array import _is_jax_array, iter_staged_pieces
         from .serialization import string_to_dtype
 
+        pendings = []
+        last_piece = None
         for _, dtype_str, _, get_piece in iter_staged_pieces(
             app_state,
             pg=self.pg,
@@ -244,7 +250,31 @@ class CheckpointManager:
                 # save_dtype conversion happens on device before staging;
                 # compile for the converted aval (transient cast copy).
                 piece = piece.astype(string_to_dtype(dtype_str))
-            _dispatch(piece)
+            pending = _dispatch(piece)
+            if pending is not None:
+                pendings.append(pending)
+                last_piece = piece
+        # Record achieved hash throughput for the I/O governor: the
+        # restore-side preverify gate compares it against measured
+        # storage read bandwidth to decide whether zero-byte
+        # verification is cheaper than re-reading. Timed on a SECOND
+        # dispatch of an already-compiled piece — timing the loop above
+        # would fold XLA compiles (seconds per distinct shape) into the
+        # rate, understating steady-state hashing by orders of magnitude
+        # and biasing the gate toward expensive re-reads.
+        if pendings:
+            import jax
+
+            jax.block_until_ready(pendings)
+            from .scheduler import io_governor
+
+            nbytes = int(
+                np.dtype(last_piece.dtype).itemsize
+                * int(np.prod(last_piece.shape, dtype=np.int64))
+            )
+            t0 = _time.perf_counter()
+            jax.block_until_ready(_dispatch(last_piece))
+            io_governor().record_hash(nbytes, _time.perf_counter() - t0)
 
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0

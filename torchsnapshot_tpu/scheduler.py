@@ -25,6 +25,22 @@ Read pipeline:: read -> consume, with the same budget accounting
 The per-process budget is ``min(0.6 * available_memory / local_world_size,
 32 GiB)``, overridable via ``TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES``
 (scheduler.py:27-65).
+
+**Streaming writes** (``allow_streaming``, sync saves only): entries whose
+stager and storage plugin both opt in skip the stage-then-write two-step —
+one task streams 32-256 MB sub-chunks from the stager straight into the
+plugin, overlapping the DtoH copy/serialization of sub-chunk N+1 with the
+storage write of sub-chunk N, so a single large entry's wall is
+~max(stage, write) instead of stage + write. The budget charges streamed
+entries the plugin-declared retention (``stream_admission_cost`` — the
+stager's 2-chunk window for fs, part buffers for s3, the retained stream
+for gcs), not their full staging size.
+
+**I/O governor** (:class:`IOGovernor`): sub-chunk size, I/O concurrency,
+and the restore-side preverify gate adapt to rates this module measures on
+its own traffic (per-plugin write/read bandwidth) plus the fingerprint
+hash throughput recorded by warmup — static constants tuned for one host
+class are wrong on the next.
 """
 
 from __future__ import annotations
@@ -33,13 +49,21 @@ import asyncio
 import logging
 import os
 import socket
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 import psutil
 
-from .io_types import ReadReq, StoragePlugin, WriteIO, WriteReq, ReadIO
+from .io_types import (
+    ReadIO,
+    ReadReq,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+    WriteStream,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -61,20 +85,234 @@ except (AttributeError, OSError):  # pragma: no cover - non-Linux
     _CPU_COUNT = os.cpu_count() or 1
 IO_CONCURRENCY_ENV_VAR = "TORCHSNAPSHOT_TPU_IO_CONCURRENCY"
 CPU_CONCURRENCY_ENV_VAR = "TORCHSNAPSHOT_TPU_CPU_CONCURRENCY"
-# Scaled to the host rather than fixed: on few-core machines 16
-# concurrent 64 MB streams + 4 copy workers thrash the cache hierarchy —
-# measured 3.4x more CPU burned for the same 1 GiB restore on one core
-# (and the GIL convoy inflates every op's wall time). Floors keep enough
-# I/O parallelism to hide per-request latency on network storage.
-_MAX_PER_RANK_IO_CONCURRENCY = _env_int(
-    IO_CONCURRENCY_ENV_VAR, min(16, max(8, 2 * _CPU_COUNT))
-)
+# I/O concurrency lives in IOGovernor.io_concurrency (host-scaled
+# default, adapted to measured storage bandwidth, pinned by
+# IO_CONCURRENCY_ENV_VAR).
 _MAX_PER_RANK_CPU_CONCURRENCY = _env_int(
     CPU_CONCURRENCY_ENV_VAR, min(4, max(2, _CPU_COUNT // 2))
 )
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024**3
 _MEMORY_BUDGET_ENV_VAR = "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES"
+
+# ------------------------------------------------------------ I/O governor
+
+SUB_CHUNK_ENV_VAR = "TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES"
+SUB_CHUNK_MIN_ENV_VAR = "TORCHSNAPSHOT_TPU_SUB_CHUNK_MIN_BYTES"
+SUB_CHUNK_MAX_ENV_VAR = "TORCHSNAPSHOT_TPU_SUB_CHUNK_MAX_BYTES"
+PREVERIFY_ENV_VAR = "TORCHSNAPSHOT_TPU_PREVERIFY"
+
+_DEFAULT_SUB_CHUNK_BYTES = 64 << 20
+_DEFAULT_SUB_CHUNK_MIN_BYTES = 8 << 20
+_DEFAULT_SUB_CHUNK_MAX_BYTES = 256 << 20
+# Sub-chunks should take this long to write at the measured bandwidth:
+# long enough to amortize per-chunk dispatch (executor hops, pwrite
+# syscalls), short enough that the stage/write pipeline has several
+# stages in flight per entry.
+_SUB_CHUNK_TARGET_SECONDS = 0.05
+# Skip the preverify hash pass only when reading is CLEARLY cheaper:
+# the margin absorbs rate-measurement noise and the HtoD cost a read
+# still pays after the storage fetch.
+_PREVERIFY_READ_MARGIN = 1.25
+
+
+class IOGovernor:
+    """Process-wide adaptive tuner for the save/restore hot path.
+
+    Static constants tuned for one host class are wrong on the next
+    (1-core CI box vs 64-core pod host vs network storage): the governor
+    records ACHIEVED rates — per-plugin storage write/read bandwidth
+    (from the scheduler's own throughput meters) and on-device hash
+    throughput (from the fingerprint warmup / a one-time probe) — and
+    derives the tunables from them, within env-var bounds:
+
+    - ``sub_chunk_bytes``: streaming sub-chunk size, sized so one
+      sub-chunk takes ~``_SUB_CHUNK_TARGET_SECONDS`` to write at the
+      measured bandwidth (fast local storage gets big chunks that
+      amortize syscalls; slow network storage gets small chunks that
+      keep the pipeline busy). Pinned by ``TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES``.
+    - ``io_concurrency``: in-flight storage requests. Bandwidth-bound
+      local storage saturates with few streams (extra ones thrash the
+      cache hierarchy); latency-bound network storage needs many.
+      Pinned by ``TORCHSNAPSHOT_TPU_IO_CONCURRENCY``.
+    - ``should_preverify``: whether restore-time distributed digest
+      verification is cheaper than just re-reading (VERDICT round-5
+      item 6) — hashing wins on slow storage, reading wins on fast
+      local disk with a slow hasher. ``TORCHSNAPSHOT_TPU_PREVERIFY``
+      forces ``always``/``never``; default ``auto`` verifies unless
+      reading is provably cheaper (missing measurements keep the
+      status-quo verify).
+
+    Rates are exponentially smoothed (alpha 0.5): one anomalous save
+    (page-cache flush, noisy neighbor) moves a tunable halfway at most,
+    and the next clean measurement pulls it back.
+    """
+
+    _EWMA_ALPHA = 0.5
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._write_bps: Dict[str, float] = {}
+        self._read_bps: Dict[str, float] = {}
+        self._hash_bps: Optional[float] = None
+
+    # ------------------------------------------------------- recording
+
+    def _ewma(self, table: Dict[str, float], key: str, bps: float) -> None:
+        with self._lock:
+            prev = table.get(key)
+            table[key] = (
+                bps
+                if prev is None
+                else prev + self._EWMA_ALPHA * (bps - prev)
+            )
+
+    def record_write(self, plugin: str, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 1e-6:
+            return
+        self._ewma(self._write_bps, plugin, nbytes / seconds)
+
+    def record_read(self, plugin: str, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 1e-6:
+            return
+        self._ewma(self._read_bps, plugin, nbytes / seconds)
+
+    def record_hash(self, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 1e-6:
+            return
+        bps = nbytes / seconds
+        with self._lock:
+            self._hash_bps = (
+                bps
+                if self._hash_bps is None
+                else self._hash_bps + self._EWMA_ALPHA * (bps - self._hash_bps)
+            )
+
+    # ------------------------------------------------------- measured rates
+
+    def write_bps(self, plugin: Optional[str] = None) -> Optional[float]:
+        with self._lock:
+            if plugin is not None:
+                return self._write_bps.get(plugin)
+            return max(self._write_bps.values()) if self._write_bps else None
+
+    def read_bps(self, plugin: Optional[str] = None) -> Optional[float]:
+        with self._lock:
+            if plugin is not None:
+                return self._read_bps.get(plugin)
+            return max(self._read_bps.values()) if self._read_bps else None
+
+    def hash_bps(self) -> Optional[float]:
+        with self._lock:
+            return self._hash_bps
+
+    def measured_rates(self) -> Dict[str, object]:
+        """Snapshot of every measured rate, for logs and benchmarks."""
+        with self._lock:
+            return {
+                "write_bps": dict(self._write_bps),
+                "read_bps": dict(self._read_bps),
+                "hash_bps": self._hash_bps,
+            }
+
+    # ---------------------------------------------------------- tunables
+
+    def sub_chunk_bytes(self, plugin: Optional[str] = None) -> int:
+        pinned = os.environ.get(SUB_CHUNK_ENV_VAR, "").strip()
+        if pinned:
+            try:
+                # An explicit pin is honored as-is (tests pin tiny chunks
+                # to exercise many-sub-chunk streams on small payloads).
+                return max(1, int(pinned))
+            except ValueError:
+                logger.warning(
+                    "ignoring non-integer %s=%r", SUB_CHUNK_ENV_VAR, pinned
+                )
+        lo = _env_int(SUB_CHUNK_MIN_ENV_VAR, _DEFAULT_SUB_CHUNK_MIN_BYTES)
+        hi = _env_int(SUB_CHUNK_MAX_ENV_VAR, _DEFAULT_SUB_CHUNK_MAX_BYTES)
+        hi = max(lo, hi)
+        bps = self.write_bps(plugin)
+        if bps is None:
+            return min(max(_DEFAULT_SUB_CHUNK_BYTES, lo), hi)
+        target = int(bps * _SUB_CHUNK_TARGET_SECONDS)
+        # Round to a 1 MB multiple: exact-size staging-pool free lists
+        # recycle far better when sizes don't wander byte-by-byte.
+        target = max(1 << 20, (target >> 20) << 20)
+        return min(max(target, lo), hi)
+
+    def io_concurrency(
+        self, op: str = "write", plugin: Optional[str] = None
+    ) -> int:
+        """In-flight storage requests for ``op`` ("write"/"read") —
+        tuned from the MATCHING measured rate (a fast local save must
+        not clamp concurrency for a later latency-bound network
+        restore, and vice versa), for ``plugin`` when it has a recorded
+        rate."""
+        raw = os.environ.get(IO_CONCURRENCY_ENV_VAR, "").strip()
+        if raw:
+            try:
+                return max(1, int(raw))
+            except ValueError:
+                pass  # warned at import time by _env_int
+        default = min(16, max(8, 2 * _CPU_COUNT))
+        table = self.read_bps if op == "read" else self.write_bps
+        bps = table(plugin)
+        if bps is None and plugin is not None:
+            bps = table(None)  # best-known rate for this op
+        if bps is None:
+            return default
+        if bps >= 1e9:
+            # Bandwidth-bound (local SSD/tmpfs): a couple of streams per
+            # core saturate the bus; more just thrash caches.
+            return min(default, max(4, 2 * _CPU_COUNT))
+        if bps <= 1e8:
+            # Latency-bound (network storage): hide per-request latency
+            # with every stream the cap allows.
+            return 16
+        return default
+
+    def should_preverify(self, plugin: Optional[str] = None) -> bool:
+        """``plugin``: the storage plugin the CURRENT restore reads
+        from. The crossover must use THAT backend's measured read rate —
+        a fast local read recorded earlier in the process must not talk
+        a later object-store restore out of its near-free verify skip.
+        No recorded rate for this plugin means no evidence: verify."""
+        mode = preverify_mode()
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        hash_bps = self.hash_bps()
+        read_bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
+        if hash_bps is None or read_bps is None:
+            return True  # no evidence: keep the zero-byte verify path
+        return read_bps <= hash_bps * _PREVERIFY_READ_MARGIN
+
+
+def preverify_mode() -> str:
+    """THE parser for ``TORCHSNAPSHOT_TPU_PREVERIFY`` — every consumer
+    (the governor's gate, snapshot's explicit-instruction guard) goes
+    through here so the recognized spellings can never drift between
+    them. Unrecognized values fall back to ``auto``."""
+    raw = os.environ.get(PREVERIFY_ENV_VAR, "auto").strip().lower()
+    if raw in ("1", "always", "on", "true", "yes"):
+        return "always"
+    if raw in ("0", "never", "off", "false", "no"):
+        return "never"
+    return "auto"
+
+
+_governor: Optional[IOGovernor] = None
+_governor_lock = threading.Lock()
+
+
+def io_governor() -> IOGovernor:
+    global _governor
+    if _governor is None:
+        with _governor_lock:
+            if _governor is None:
+                _governor = IOGovernor()
+    return _governor
 
 
 def get_local_world_size(pg=None) -> int:
@@ -103,7 +341,12 @@ def get_process_memory_budget_bytes(pg=None) -> int:
 
 
 class _WritePipeline:
-    def __init__(self, write_req: WriteReq) -> None:
+    def __init__(
+        self,
+        write_req: WriteReq,
+        sub_chunk_bytes: Optional[int] = None,
+        storage: Optional[StoragePlugin] = None,
+    ) -> None:
         self.write_req = write_req
         self.staging_cost_bytes: int = (
             write_req.buffer_stager.get_staging_cost_bytes()
@@ -111,6 +354,25 @@ class _WritePipeline:
         self.buf = None
         self.buf_size_bytes: Optional[int] = None
         self.io_skipped = False
+        # Streaming election happens at construction: the stager opts in
+        # for THIS sub-chunk size, and the budget then charges the
+        # PLUGIN-declared retention (stager window for fs; part buffers
+        # for s3; full retained stream for gcs) instead of the whole
+        # entry's staging cost.
+        self.sub_chunk_bytes = sub_chunk_bytes
+        self.streamed = False
+        if sub_chunk_bytes is not None and write_req.buffer_stager.can_stream(
+            sub_chunk_bytes
+        ):
+            self.admission_cost_bytes: int = min(
+                self.staging_cost_bytes,
+                storage.stream_admission_cost(
+                    self.staging_cost_bytes, sub_chunk_bytes
+                ),
+            )
+            self.streamed = True
+        else:
+            self.admission_cost_bytes = self.staging_cost_bytes
 
     async def stage_buffer(self, executor) -> "_WritePipeline":
         self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
@@ -121,6 +383,32 @@ class _WritePipeline:
             self.io_skipped = True
             self.buf = None
             self.buf_size_bytes = 0
+        return self
+
+    async def stream_write(
+        self, storage: StoragePlugin, executor
+    ) -> "_WritePipeline":
+        """Fused stage+write: the stager yields sub-chunks as they land
+        on the host and the plugin writes each while the next stages —
+        the entry's wall becomes ~max(stage, write) instead of
+        stage + write. Runs as ONE task occupying one I/O slot; by the
+        time it completes the entry is both staged and durably written,
+        so it never enters ready_for_io."""
+        stager = self.write_req.buffer_stager
+        chunks = stager.stage_stream(executor, self.sub_chunk_bytes)
+        try:
+            await storage.write_stream(
+                WriteStream(
+                    path=self.write_req.path,
+                    nbytes=self.staging_cost_bytes,
+                    chunks=chunks,
+                )
+            )
+        finally:
+            aclose = getattr(chunks, "aclose", None)
+            if aclose is not None:
+                await aclose()
+        self.buf_size_bytes = self.staging_cost_bytes
         return self
 
     async def write_buffer(self, storage: StoragePlugin) -> "_WritePipeline":
@@ -239,8 +527,11 @@ class _Throughput:
     def add(self, nbytes: int) -> None:
         self.total_bytes += nbytes
 
+    def elapsed(self) -> float:
+        return max(time.monotonic() - self.begin, 1e-9)
+
     def log_summary(self) -> None:
-        elapsed = max(time.monotonic() - self.begin, 1e-9)
+        elapsed = self.elapsed()
         logger.info(
             "[rank %d] %s %.1f MB in %.2fs (%.1f MB/s)",
             self.rank,
@@ -312,11 +603,22 @@ class PendingIOWork:
                 reporter.stop()
         self._executor.shutdown(wait=True)
         self._throughput.log_summary()
+        # Feed the governor the ACHIEVED end-to-end write bandwidth (the
+        # meter spans staging + I/O — exactly the rate the next save's
+        # sub-chunk sizing and concurrency should be tuned for).
+        io_governor().record_write(
+            type(self._storage).__name__,
+            self._throughput.total_bytes,
+            self._throughput.elapsed(),
+        )
 
     def _dispatch_io(self) -> None:
         while (
             self._ready_for_io
-            and len(self._io_tasks) < _MAX_PER_RANK_IO_CONCURRENCY
+            and len(self._io_tasks)
+            < io_governor().io_concurrency(
+                "write", type(self._storage).__name__
+            )
         ):
             pipeline = self._ready_for_io.pop(0)
             self._io_tasks.add(
@@ -363,6 +665,7 @@ async def execute_write_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    allow_streaming: bool = False,
 ) -> PendingIOWork:
     event_loop = asyncio.get_running_loop()
     executor = ThreadPoolExecutor(max_workers=_MAX_PER_RANK_CPU_CONCURRENCY)
@@ -371,31 +674,83 @@ async def execute_write_reqs(
     reporter = _ProgressReporter("write", rank, len(write_reqs), budget)
     reporter.start()
 
-    ready_for_staging = [_WritePipeline(req) for req in write_reqs]
+    governor = io_governor()
+    plugin_key = type(storage).__name__
+    # Streaming fuses staging with storage I/O, so a streamed entry's
+    # write completes before this function returns — callers that rely on
+    # the staging-complete consistency point RETURNING EARLY (async_take)
+    # must not enable it. Only plugins that consume chunks incrementally
+    # are eligible (the buffered write_stream fallback would hold a full
+    # entry while the budget charged a sub-chunk window). Sub-chunk size
+    # comes from measured bandwidth.
+    sub_chunk = (
+        governor.sub_chunk_bytes(plugin_key)
+        if allow_streaming and getattr(storage, "supports_streaming", False)
+        else None
+    )
+    io_concurrency = governor.io_concurrency("write", plugin_key)
+
+    ready_for_staging = [
+        _WritePipeline(req, sub_chunk_bytes=sub_chunk, storage=storage)
+        for req in write_reqs
+    ]
     # Stage large requests first: improves budget packing and overlaps the
     # slowest DtoH copies with I/O of everything else.
     ready_for_staging.sort(key=lambda p: p.staging_cost_bytes, reverse=True)
+    n_streamed = sum(1 for p in ready_for_staging if p.streamed)
+    if n_streamed:
+        logger.debug(
+            "[rank %d] streaming %d/%d write(s) in %d MB sub-chunks",
+            rank,
+            n_streamed,
+            len(ready_for_staging),
+            (sub_chunk or 0) >> 20,
+        )
     staging_tasks: Set[asyncio.Task] = set()
     io_tasks: Set[asyncio.Task] = set()
     ready_for_io: List[_WritePipeline] = []
+    inflight_streams = 0
 
     def dispatch_staging() -> None:
+        nonlocal inflight_streams
+        deferred: List[_WritePipeline] = []
         while ready_for_staging:
-            cost = ready_for_staging[0].staging_cost_bytes
+            head = ready_for_staging[0]
+            # A streamed entry occupies a storage stream for its whole
+            # lifetime, so streams and buffered writes share ONE
+            # io_concurrency cap — counting them separately would let a
+            # mixed workload run 2x the intended concurrent requests.
+            if head.streamed and (
+                inflight_streams + len(io_tasks) >= io_concurrency
+            ):
+                deferred.append(ready_for_staging.pop(0))
+                continue
+            cost = head.admission_cost_bytes
             if cost > budget.available:
                 # Starvation escape: if nothing is in flight, admit the
                 # over-budget request — otherwise it would never run.
-                if staging_tasks or io_tasks or ready_for_io:
+                if staging_tasks or io_tasks or ready_for_io or deferred:
                     break
             pipeline = ready_for_staging.pop(0)
-            budget.acquire(pipeline.staging_cost_bytes)
-            staging_tasks.add(
-                event_loop.create_task(pipeline.stage_buffer(executor))
-            )
+            budget.acquire(pipeline.admission_cost_bytes)
+            if pipeline.streamed:
+                inflight_streams += 1
+                staging_tasks.add(
+                    event_loop.create_task(
+                        pipeline.stream_write(storage, executor)
+                    )
+                )
+            else:
+                staging_tasks.add(
+                    event_loop.create_task(pipeline.stage_buffer(executor))
+                )
             reporter.inflight_staging += 1
+        # Stream-slot-deferred entries keep their order at the head.
+        ready_for_staging[:0] = deferred
 
     def dispatch_io() -> None:
-        while ready_for_io and len(io_tasks) < _MAX_PER_RANK_IO_CONCURRENCY:
+        # Streams count against the same cap (see dispatch_staging).
+        while ready_for_io and len(io_tasks) + inflight_streams < io_concurrency:
             pipeline = ready_for_io.pop(0)
             io_tasks.add(event_loop.create_task(pipeline.write_buffer(storage)))
             reporter.inflight_io += 1
@@ -410,6 +765,19 @@ async def execute_write_reqs(
                 if task in staging_tasks:
                     staging_tasks.discard(task)
                     pipeline = task.result()
+                    reporter.inflight_staging -= 1
+                    reporter.staged_count += 1
+                    reporter.staged_bytes += pipeline.buf_size_bytes
+                    if pipeline.streamed:
+                        # Fused stage+write: the entry is already on
+                        # storage. Release the sub-chunk window charge and
+                        # account the write here.
+                        inflight_streams -= 1
+                        budget.release(pipeline.admission_cost_bytes)
+                        throughput.add(pipeline.buf_size_bytes)
+                        reporter.completed_count += 1
+                        reporter.completed_bytes += pipeline.buf_size_bytes
+                        continue
                     # The staged buffer may be smaller than the staging cost
                     # (e.g. a strided view); release the difference now.
                     budget.release(
@@ -417,9 +785,6 @@ async def execute_write_reqs(
                     )
                     if not pipeline.io_skipped:
                         ready_for_io.append(pipeline)
-                    reporter.inflight_staging -= 1
-                    reporter.staged_count += 1
-                    reporter.staged_bytes += pipeline.buf_size_bytes
                 elif task in io_tasks:
                     io_tasks.discard(task)
                     pipeline = task.result()
@@ -462,9 +827,18 @@ def sync_execute_write_reqs(
     memory_budget_bytes: int,
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
+    allow_streaming: bool = True,
 ) -> None:
+    # Synchronous callers block until I/O drains, so fusing staging with
+    # storage writes (streaming) costs them nothing semantically.
     pending = event_loop.run_until_complete(
-        execute_write_reqs(write_reqs, storage, memory_budget_bytes, rank)
+        execute_write_reqs(
+            write_reqs,
+            storage,
+            memory_budget_bytes,
+            rank,
+            allow_streaming=allow_streaming,
+        )
     )
     pending.sync_complete(event_loop)
 
@@ -512,9 +886,12 @@ async def execute_read_reqs(
     pending = [_ReadPipeline(req) for req in read_reqs]
     pending.sort(key=lambda p: p.consuming_cost_bytes, reverse=True)
     inflight: Set[asyncio.Task] = set()
+    io_concurrency = io_governor().io_concurrency(
+        "read", type(storage).__name__
+    )
 
     def dispatch() -> None:
-        while pending and len(inflight) < _MAX_PER_RANK_IO_CONCURRENCY:
+        while pending and len(inflight) < io_concurrency:
             cost = pending[0].consuming_cost_bytes
             if cost > budget.available and inflight:
                 break
@@ -553,6 +930,11 @@ async def execute_read_reqs(
 
     executor.shutdown(wait=True)
     throughput.log_summary()
+    # Achieved read bandwidth feeds the restore-side preverify economics
+    # (hash vs re-read) and concurrency tuning.
+    io_governor().record_read(
+        type(storage).__name__, throughput.total_bytes, throughput.elapsed()
+    )
 
 
 def sync_execute_read_reqs(

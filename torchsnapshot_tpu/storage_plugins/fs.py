@@ -22,10 +22,16 @@ import itertools
 import os
 from typing import Set
 
-import aiofiles
-import aiofiles.os
+try:
+    import aiofiles
+    import aiofiles.os
+except ImportError:
+    # Hermetic environments ship without aiofiles; the shim delegates to
+    # the loop's thread pool with the same surface (see _aio.py). The
+    # local-FS plugin must never be the backend that import-fails.
+    from .. import _aio as aiofiles
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, WriteStream
 
 FSYNC_ENV_VAR = "TORCHSNAPSHOT_TPU_FSYNC"
 MMAP_ENV_VAR = "TORCHSNAPSHOT_TPU_MMAP_READS"
@@ -56,6 +62,8 @@ def _fsync_path(path: str) -> None:
 
 
 class FSStoragePlugin(StoragePlugin):
+    supports_streaming = True
+
     def __init__(self, root: str, storage_options=None) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
@@ -89,6 +97,59 @@ class FSStoragePlugin(StoragePlugin):
             if self._fsync:
                 # The rename itself must reach disk for the commit to be
                 # power-loss durable: fsync the parent directory entry.
+                await loop.run_in_executor(
+                    None, _fsync_path, os.path.dirname(path) or "."
+                )
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    @staticmethod
+    def _pwrite_all(fd: int, buf, offset: int) -> int:
+        """Positional write of the whole buffer at ``offset`` (blocking;
+        runs in an executor thread). Returns bytes written. pwrite never
+        moves a shared file offset, so sub-chunk writes need no seek
+        bookkeeping and tolerate future out-of-order producers."""
+        mv = memoryview(buf).cast("B")
+        written = 0
+        while written < mv.nbytes:
+            written += os.pwrite(fd, mv[written:], offset + written)
+        return written
+
+    async def write_stream(self, stream: WriteStream) -> None:
+        """Streaming variant of ``write``: sub-chunks land via positional
+        pwrites into the SAME temp file, published atomically with
+        ``os.replace`` only after the final chunk — a crash or mid-stream
+        failure can never leave a partial payload at the final path, and
+        the fsync contract matches the buffered path exactly."""
+        path = os.path.join(self.root, stream.path)
+        await self._ensure_parent(path)
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
+        loop = asyncio.get_running_loop()
+        fd = await loop.run_in_executor(
+            None, lambda: os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        )
+        try:
+            offset = 0
+            try:
+                async for chunk in stream.chunks:
+                    offset += await loop.run_in_executor(
+                        None, self._pwrite_all, fd, chunk, offset
+                    )
+                if offset != stream.nbytes:
+                    raise IOError(
+                        f"short write stream for {stream.path!r}: produced "
+                        f"{offset} of {stream.nbytes} bytes"
+                    )
+                if self._fsync:
+                    await loop.run_in_executor(None, os.fsync, fd)
+            finally:
+                os.close(fd)
+            await aiofiles.os.replace(tmp, path)
+            if self._fsync:
                 await loop.run_in_executor(
                     None, _fsync_path, os.path.dirname(path) or "."
                 )

@@ -26,7 +26,7 @@ import contextlib
 import time
 from typing import Any, Callable, Dict, Optional
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, WriteStream
 from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_error
 
 # S3 hard limit for single-request PUTs is 5 GiB (and 5 TiB per object via
@@ -43,6 +43,8 @@ _RANGED_READ_CONCURRENCY = 4
 
 
 class S3StoragePlugin(StoragePlugin):
+    supports_streaming = True
+
     def __init__(self, root: str, storage_options: Optional[Dict[str, Any]] = None):
         options = storage_options or {}
         self.bucket, _, self.prefix = root.partition("/")
@@ -155,13 +157,21 @@ class S3StoragePlugin(StoragePlugin):
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
-            with contextlib.suppress(Exception):
-                await self._retrying(
-                    lambda: self.client.abort_multipart_upload(
-                        Bucket=self.bucket, Key=key, UploadId=upload_id
-                    )
-                )
+            await self._abort_multipart(key, upload_id)
             raise
+        await self._complete_multipart(key, upload_id, parts, mv.nbytes)
+
+    async def _abort_multipart(self, key: str, upload_id: str) -> None:
+        with contextlib.suppress(Exception):
+            await self._retrying(
+                lambda: self.client.abort_multipart_upload(
+                    Bucket=self.bucket, Key=key, UploadId=upload_id
+                )
+            )
+
+    async def _complete_multipart(
+        self, key: str, upload_id: str, parts: list, total_nbytes: int
+    ) -> None:
         # CompleteMultipartUpload is not idempotent: a transient failure
         # AFTER the server committed (e.g. connection reset while reading
         # the response) makes the retry hit a dead upload id. Before each
@@ -179,7 +189,7 @@ class S3StoragePlugin(StoragePlugin):
                     # Size-check before declaring success: a STALE object
                     # at this key (snapshot re-taken to the same URL) must
                     # not be mistaken for this upload's commit.
-                    if head.get("ContentLength") == mv.nbytes:
+                    if head.get("ContentLength") == total_nbytes:
                         return  # a prior attempt committed server-side
                 except Exception:
                     pass
@@ -188,10 +198,115 @@ class S3StoragePlugin(StoragePlugin):
                 Bucket=self.bucket,
                 Key=key,
                 UploadId=upload_id,
-                MultipartUpload={"Parts": parts},
+                MultipartUpload={"Parts": sorted(parts, key=lambda p: p["PartNumber"])},
             )
 
         await self._retrying(complete)
+
+    def stream_admission_cost(self, nbytes: int, sub_chunk_bytes: int) -> int:
+        """Real retention of a streamed entry: sub-threshold payloads
+        fall back to the buffered PUT (full size held), larger ones hold
+        at most the bounded in-flight part window (write_stream applies
+        backpressure to enforce exactly this) plus the part being
+        accumulated and the stager's lookahead chunk."""
+        if nbytes < self.multipart_threshold:
+            return nbytes
+        window = (_MULTIPART_CONCURRENCY + 1) * MULTIPART_PART_BYTES
+        return min(nbytes, window + MULTIPART_PART_BYTES + sub_chunk_bytes)
+
+    async def write_stream(self, stream: WriteStream) -> None:
+        """Streaming write: sub-chunks accumulate into multipart parts
+        that upload WHILE later sub-chunks are still being staged — the
+        intra-entry overlap the buffered path only gets across entries.
+        Each part is retained only until its upload succeeds (per-part
+        retry needs its bytes), and the producer loop applies
+        BACKPRESSURE: it stops pulling sub-chunks while more than
+        ``_MULTIPART_CONCURRENCY + 1`` part payloads are in flight, so
+        retained memory matches ``stream_admission_cost`` instead of
+        racing ahead of a slow link toward the full entry. Payloads
+        under the multipart threshold fall back to the buffered single
+        PUT — S3 parts below 5 MiB are rejected, and a sub-threshold
+        object gains nothing from the protocol's extra round trips."""
+        if stream.nbytes < self.multipart_threshold:
+            await super().write_stream(stream)
+            return
+        from ..memoryview_stream import MemoryviewStream
+
+        key = self._key(stream.path)
+        create = await self._retrying(
+            lambda: self.client.create_multipart_upload(Bucket=self.bucket, Key=key)
+        )
+        upload_id = create["UploadId"]
+        sem = asyncio.Semaphore(_MULTIPART_CONCURRENCY)
+        tasks = []
+
+        async def put_part(number: int, payload) -> Dict[str, Any]:
+            def put() -> Dict[str, Any]:
+                return self.client.upload_part(
+                    Bucket=self.bucket,
+                    Key=key,
+                    UploadId=upload_id,
+                    PartNumber=number,
+                    Body=MemoryviewStream(memoryview(payload)),
+                )
+
+            async with sem:
+                resp = await self._retrying(put)
+            return {"ETag": resp["ETag"], "PartNumber": number}
+
+        def flush(acc: list, acc_bytes: int, number: int):
+            if len(acc) == 1:
+                payload = acc[0]
+            else:
+                payload = bytearray(acc_bytes)
+                pos = 0
+                for piece in acc:
+                    piece_mv = memoryview(piece).cast("B")
+                    payload[pos : pos + piece_mv.nbytes] = piece_mv
+                    pos += piece_mv.nbytes
+            tasks.append(asyncio.ensure_future(put_part(number, payload)))
+
+        try:
+            acc: list = []
+            acc_bytes = 0
+            total = 0
+            number = 1
+            async for chunk in stream.chunks:
+                mv = memoryview(chunk).cast("B")
+                acc.append(mv)
+                acc_bytes += mv.nbytes
+                total += mv.nbytes
+                if acc_bytes >= MULTIPART_PART_BYTES:
+                    # Backpressure BEFORE buffering another part: wait
+                    # until the in-flight payload window has room, so a
+                    # fast stager can't pile the whole entry into queued
+                    # part tasks ahead of a slow link.
+                    while (
+                        sum(1 for t in tasks if not t.done())
+                        > _MULTIPART_CONCURRENCY
+                    ):
+                        await asyncio.wait(
+                            [t for t in tasks if not t.done()],
+                            return_when=asyncio.FIRST_COMPLETED,
+                        )
+                    flush(acc, acc_bytes, number)
+                    number += 1
+                    acc, acc_bytes = [], 0
+            if acc:
+                flush(acc, acc_bytes, number)
+            if total != stream.nbytes:
+                raise IOError(
+                    f"short write stream for {stream.path!r}: produced "
+                    f"{total} of {stream.nbytes} bytes"
+                )
+            parts = list(await asyncio.gather(*tasks))
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await self._abort_multipart(key, upload_id)
+            raise
+        await self._complete_multipart(key, upload_id, parts, stream.nbytes)
 
     async def read(self, read_io: ReadIO) -> None:
         key = self._key(read_io.path)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import queue as pyqueue
 import sys
 import traceback
 from typing import Any, Callable, Dict, Tuple
@@ -242,12 +243,16 @@ def run_with_subprocesses(
             # Doomed ranks are dead and every survivor reported: drain
             # whatever a doomed rank enqueued before dying, then stop
             # (the documented "a dead rank that DID report is included"
-            # contract must not race the kill).
+            # contract must not race the kill). ONLY queue.Empty ends the
+            # drain — any other error (a payload that fails to unpickle,
+            # a record() bug) must propagate, not silently drop a report
+            # the contract says is included.
             while True:
                 try:
-                    record(*result_queue.get_nowait())
-                except Exception:
+                    item = result_queue.get_nowait()
+                except pyqueue.Empty:
                     break
+                record(*item)
             break
         try:
             rank, status, payload = result_queue.get(timeout=1.0)
