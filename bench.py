@@ -521,6 +521,76 @@ def main() -> None:
         dt = min(save_times)
         p50 = statistics.median(save_times)
 
+        # Telemetry leg: one-two takes with the telemetry bus enabled so
+        # (a) the per-take summary JSON lands alongside the BENCH_*
+        # artifacts — bench trajectory and traces now come from the SAME
+        # instrumentation as production saves — and (b) the enabled-vs-
+        # disabled overhead is measured and bounded (<3% best-vs-best;
+        # the subsystem's contract is near-zero cost). Runs before the
+        # restores so they read the final (telemetry-written) snapshot —
+        # bit-identical payloads either way.
+        from torchsnapshot_tpu import telemetry as _telemetry
+
+        max_overhead = float(os.environ.get("BENCH_TELEMETRY_MAX_PCT", "3.0"))
+        # Relative budget with a small absolute floor: persisting the
+        # summary + trace costs a fixed few ms, which dominates any
+        # percentage on debug-size invocations (~40 ms saves) while
+        # vanishing at real sizes (measured +0.65% at 1 GiB).
+        overhead_budget_s = max(max_overhead / 100.0 * dt, 0.05)
+        tele_times = []
+        _telemetry.set_enabled(True)
+        try:
+            # Up to 6 trials, stopping early once one lands within the
+            # overhead budget: this host's lazily-backed VM throws
+            # bimodal trials (documented above for the main leg — the
+            # disabled trials show the same 2x spread), so a fixed
+            # best-of-2 vs the main leg's best-of-6 would measure
+            # sampling luck, not the subsystem.
+            for tele_trial in range(6):
+                shutil.rmtree(f"{tmp}/snap", ignore_errors=True)
+                t0 = time.perf_counter()
+                Snapshot.take(f"{tmp}/snap", app_state)
+                tele_times.append(time.perf_counter() - t0)
+                _log(
+                    f"telemetry-enabled save {tele_trial}: "
+                    f"{tele_times[-1]:.2f}s "
+                    f"({nbytes / 1e9 / tele_times[-1]:.2f} GB/s)"
+                )
+                if tele_trial >= 1 and (min(tele_times) - dt) < overhead_budget_s:
+                    break
+        finally:
+            _telemetry.set_enabled(False)
+        tele_summary = _telemetry.last_summary()
+        tele_fleet = _telemetry.last_fleet()
+        telemetry_overhead_pct = round((min(tele_times) - dt) / dt * 100, 2)
+        tele_out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_TELEMETRY.json"
+        )
+        with open(tele_out, "w") as f:
+            json.dump(
+                {
+                    "telemetry_trials_s": [round(t, 3) for t in tele_times],
+                    "baseline_best_s": round(dt, 3),
+                    "overhead_pct": telemetry_overhead_pct,
+                    "summary": tele_summary,
+                    "fleet": tele_fleet,
+                },
+                f,
+                indent=1,
+            )
+        _log(
+            f"telemetry leg: overhead {telemetry_overhead_pct:+.2f}% "
+            f"(best-vs-best); summary written to {tele_out}"
+        )
+        if not calibration["contaminated"]:
+            assert (min(tele_times) - dt) < overhead_budget_s, (
+                f"telemetry-enabled save overhead {telemetry_overhead_pct:.2f}% "
+                f">= {max_overhead}% budget (disabled best {dt:.3f}s vs "
+                f"enabled best {min(tele_times):.3f}s)"
+            )
+        else:
+            _log("host contaminated: telemetry overhead assert skipped")
+
         # Timed restores into a device-resident destination (mmap read
         # path + zero-copy device_put).
         dst = {"model": StateDict({k: jnp.zeros_like(v) for k, v in state.items()})}
@@ -555,6 +625,9 @@ def main() -> None:
         "restore_gbps": round((nbytes / 1e9) / min(restore_times), 3),
         "platform": jax.default_backend(),
         "host_calibration": calibration,
+        # Enabled-vs-disabled cost of the telemetry subsystem (full
+        # per-take summary + trace in BENCH_TELEMETRY.json).
+        "telemetry_overhead_pct": telemetry_overhead_pct,
     }
     if discarded_trials:
         # Trials where the post-trial memcpy probe showed the host was

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Lint: no ad-hoc ``time.monotonic()`` / ``time.perf_counter()`` timing
+in ``torchsnapshot_tpu/`` outside the telemetry package.
+
+The telemetry subsystem (torchsnapshot_tpu/telemetry/) is the ONE
+measurement mechanism for the pipeline — spans, counters, rates, and the
+blessed ``telemetry.monotonic`` clock. Before it existed, measurements
+forked into private meters (scheduler throughput tables, governor EWMA
+feeds, phase timers) that could silently disagree; this check keeps new
+code from regrowing them. Wall-clock DEADLINE logic (store RPC timeouts,
+the test launcher's subprocess deadline) is not measurement and stays on
+raw ``time.monotonic`` via the explicit allowlist below.
+
+Run: ``python scripts/check_timing_lint.py`` — exits 0 when clean,
+1 with a per-violation report otherwise. Enforced in tier-1 via
+tests/test_timing_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
+
+# Paths (relative to the package) allowed to call time.monotonic/
+# perf_counter directly. Deadline/timeout bookkeeping only — add a file
+# here ONLY for wall-deadline logic, never for measurement (measurement
+# belongs on the telemetry bus).
+ALLOWLIST = {
+    "dist_store.py",  # store RPC / barrier deadline arithmetic
+    "test_utils.py",  # multi-process launcher subprocess deadline
+}
+
+_BANNED_ATTRS = {"monotonic", "perf_counter", "monotonic_ns", "perf_counter_ns"}
+
+
+def _violations_in(path: str) -> list:
+    with open(path, "r") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:  # pragma: no cover - package must parse
+        return [(e.lineno or 0, f"syntax error: {e}")]
+    out = []
+    # Names bound by `from time import monotonic/perf_counter [as alias]`.
+    from_time_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _BANNED_ATTRS:
+                    from_time_aliases.add(alias.asname or alias.name)
+                    out.append(
+                        (node.lineno, f"from time import {alias.name}")
+                    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _BANNED_ATTRS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("time", "_time")
+        ):
+            out.append((node.lineno, f"{fn.value.id}.{fn.attr}()"))
+        elif isinstance(fn, ast.Name) and fn.id in from_time_aliases:
+            out.append((node.lineno, f"{fn.id}()"))
+    return out
+
+
+def main() -> int:
+    failures = []
+    for dirpath, dirnames, filenames in os.walk(PACKAGE):
+        rel_dir = os.path.relpath(dirpath, PACKAGE)
+        if rel_dir.split(os.sep)[0] == "telemetry":
+            continue  # the one place the raw clock belongs
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.normpath(os.path.join(rel_dir, name))
+            if rel in ALLOWLIST:
+                continue
+            for lineno, what in _violations_in(os.path.join(dirpath, name)):
+                failures.append((rel, lineno, what))
+    if failures:
+        print(
+            "ad-hoc timing outside torchsnapshot_tpu/telemetry/ "
+            "(use telemetry.span()/record_rate()/telemetry.monotonic, or "
+            "add a DEADLINE-logic file to the allowlist in "
+            "scripts/check_timing_lint.py):",
+            file=sys.stderr,
+        )
+        for rel, lineno, what in sorted(failures):
+            print(f"  torchsnapshot_tpu/{rel}:{lineno}: {what}", file=sys.stderr)
+        return 1
+    print("timing lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Telemetry is read ONCE at package import: pin it off before any test
+# module imports torchsnapshot_tpu so an ambient TORCHSNAPSHOT_TPU_TELEMETRY=1
+# can't scatter .snapshot_telemetry/.telemetry artifacts through tests
+# that assert exact snapshot directory layouts. Telemetry tests opt back
+# in with telemetry.set_enabled(True).
+os.environ["TORCHSNAPSHOT_TPU_TELEMETRY"] = "0"
 
 import jax  # noqa: E402
 

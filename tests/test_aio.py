@@ -1,0 +1,185 @@
+"""Direct unit tests for the thread-pool aiofiles shim (_aio.py).
+
+The shim is the local-FS plugin's fallback when aiofiles is absent
+(hermetic containers), so its surface must behave exactly like the real
+thing: async open as a context manager, write/read/readinto/seek/flush/
+fileno, os.replace/os.remove, exception propagation, and clean behavior
+around event-loop teardown.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from torchsnapshot_tpu import _aio
+
+
+def test_write_then_read_roundtrip(tmp_path):
+    path = str(tmp_path / "f.bin")
+    payload = os.urandom(1 << 16)
+
+    async def main():
+        async with _aio.open(path, "wb") as f:
+            n = await f.write(payload)
+            await f.flush()
+            assert n == len(payload)
+            assert isinstance(f.fileno(), int)
+        async with _aio.open(path, "rb") as f:
+            return await f.read()
+
+    assert asyncio.run(main()) == payload
+
+
+def test_readinto_and_seek(tmp_path):
+    path = str(tmp_path / "f.bin")
+    payload = bytes(range(256)) * 16
+
+    async def main():
+        async with _aio.open(path, "wb") as f:
+            await f.write(payload)
+        async with _aio.open(path, "rb") as f:
+            pos = await f.seek(100)
+            assert pos == 100
+            buf = bytearray(32)
+            got = await f.readinto(memoryview(buf))
+            assert got == 32
+            return bytes(buf)
+
+    assert asyncio.run(main()) == payload[100:132]
+
+
+def test_concurrent_writes_and_reads(tmp_path):
+    """Many files written concurrently through the shared executor, then
+    read back concurrently — no interleaving corruption, no lost writes."""
+    n_files = 16
+    payloads = {i: bytes([i]) * (4096 + i) for i in range(n_files)}
+
+    async def write_one(i):
+        async with _aio.open(str(tmp_path / f"f{i}"), "wb") as f:
+            await f.write(payloads[i])
+
+    async def read_one(i):
+        async with _aio.open(str(tmp_path / f"f{i}"), "rb") as f:
+            return i, await f.read()
+
+    async def main():
+        await asyncio.gather(*(write_one(i) for i in range(n_files)))
+        results = await asyncio.gather(*(read_one(i) for i in range(n_files)))
+        return dict(results)
+
+    assert asyncio.run(main()) == payloads
+
+
+def test_exception_propagation(tmp_path):
+    async def read_missing():
+        async with _aio.open(str(tmp_path / "nope"), "rb") as f:
+            await f.read()
+
+    with pytest.raises(FileNotFoundError):
+        asyncio.run(read_missing())
+
+    async def write_into_missing_dir():
+        async with _aio.open(str(tmp_path / "no" / "dir" / "f"), "wb") as f:
+            await f.write(b"x")
+
+    with pytest.raises(FileNotFoundError):
+        asyncio.run(write_into_missing_dir())
+
+    async def bad_mode_op():
+        # Writing to a read-mode handle: the underlying io error must
+        # surface through the executor hop, not vanish.
+        p = str(tmp_path / "ro")
+        with open(p, "wb") as f:
+            f.write(b"x")
+        async with _aio.open(p, "rb") as f:
+            await f.write(b"y")
+
+    # io.UnsupportedOperation subclasses both OSError and ValueError.
+    with pytest.raises((OSError, ValueError)):
+        asyncio.run(bad_mode_op())
+
+
+def test_aio_os_replace_and_remove(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+
+    async def main():
+        async with _aio.open(src, "wb") as f:
+            await f.write(b"payload")
+        await _aio.os.replace(src, dst)
+        assert not os.path.exists(src)
+        with open(dst, "rb") as f:
+            assert f.read() == b"payload"
+        await _aio.os.remove(dst)
+        assert not os.path.exists(dst)
+        with pytest.raises(FileNotFoundError):
+            await _aio.os.remove(dst)
+
+    asyncio.run(main())
+
+
+def test_context_exit_closes_file_even_on_error(tmp_path):
+    path = str(tmp_path / "f")
+    holder = {}
+
+    async def main():
+        try:
+            async with _aio.open(path, "wb") as f:
+                holder["f"] = f
+                await f.write(b"x")
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+
+    asyncio.run(main())
+    # The underlying file object must be closed by __aexit__ despite the
+    # in-body exception (fd leak otherwise).
+    assert holder["f"]._f.closed
+
+
+def test_executor_shutdown_on_loop_close(tmp_path):
+    """asyncio.run closes the loop AND shuts down its default executor;
+    the shim must not cache anything loop-bound — a fresh loop after a
+    closed one keeps working, and ops on the CLOSED loop fail cleanly."""
+    path = str(tmp_path / "f")
+
+    async def write(data):
+        async with _aio.open(path, "wb") as f:
+            await f.write(data)
+
+    # Loop 1: use and close (asyncio.run shuts down the default executor).
+    asyncio.run(write(b"first"))
+    # Loop 2: the shim rebinds to the running loop's executor each call.
+    asyncio.run(write(b"second"))
+    with open(path, "rb") as f:
+        assert f.read() == b"second"
+    # Driving the coroutine on a closed loop raises, not hangs.
+    loop = asyncio.new_event_loop()
+    loop.close()
+    coro = write(b"third")
+    with pytest.raises(RuntimeError):
+        loop.run_until_complete(coro)
+    coro.close()  # never started; close it so no un-awaited warning
+
+
+def test_fs_plugin_uses_shim_surface(tmp_path):
+    """The exact subset fs.py consumes exists and composes: write via the
+    plugin code path with the shim forced in place of aiofiles."""
+    import torchsnapshot_tpu.storage_plugins.fs as fs_mod
+    from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+
+    orig = fs_mod.aiofiles
+    fs_mod.aiofiles = _aio
+    try:
+        plugin = fs_mod.FSStoragePlugin(str(tmp_path / "root"))
+
+        async def main():
+            await plugin.write(WriteIO(path="a/b.bin", buf=b"shimmed"))
+            read_io = ReadIO(path="a/b.bin")
+            await plugin.read(read_io)
+            return bytes(read_io.buf)
+
+        assert asyncio.run(main()) == b"shimmed"
+    finally:
+        fs_mod.aiofiles = orig

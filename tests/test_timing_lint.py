@@ -1,0 +1,58 @@
+"""Tier-1 enforcement of the ad-hoc-timing lint (scripts/check_timing_lint.py):
+the telemetry package owns pipeline timing; raw time.monotonic()/
+perf_counter() measurement anywhere else in torchsnapshot_tpu/ fails CI.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_timing_lint.py")
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location("check_timing_lint", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_is_clean():
+    r = subprocess.run(
+        [sys.executable, SCRIPT], capture_output=True, text=True, timeout=120
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_lint_detects_violations(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "import time as _time\n"
+        "from time import perf_counter\n"
+        "from time import monotonic as mono\n"
+        "t0 = time.monotonic()\n"
+        "t1 = _time.perf_counter()\n"
+        "t2 = perf_counter()\n"
+        "t3 = mono()\n"
+    )
+    found = lint._violations_in(str(bad))
+    # Two from-imports + four call sites.
+    assert len(found) == 6
+    whats = {w for _, w in found}
+    assert "time.monotonic()" in whats
+    assert "_time.perf_counter()" in whats
+    assert "perf_counter()" in whats
+    assert "mono()" in whats
+
+
+def test_lint_ignores_deadline_allowlist_and_telemetry():
+    lint = _load_lint()
+    assert "dist_store.py" in lint.ALLOWLIST
+    # The telemetry package itself is exempt by construction: the walk
+    # skips it; its own clock IS time.monotonic.
+    tele = os.path.join(REPO, "torchsnapshot_tpu", "telemetry", "core.py")
+    assert os.path.exists(tele)

@@ -2,6 +2,7 @@ from .version import __version__  # noqa: F401
 
 # Populated progressively as layers land; the full public surface mirrors the
 # reference's __init__ (Snapshot, Stateful, StateDict, RNGState, __version__).
+from . import telemetry  # noqa: F401
 from .manifest import SnapshotMetadata  # noqa: F401
 
 try:

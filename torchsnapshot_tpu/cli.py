@@ -24,6 +24,10 @@ on-call asks, so they get first-class commands here:
 - ``prune``    — retention: keep the newest N snapshots in a directory,
   delete the rest EXCEPT bases that kept snapshots still reference.
   Prints the plan; ``--yes`` executes it (local filesystem only).
+- ``stats``    — render the telemetry summary a take persisted next to
+  ``.snapshot_metadata`` (phase walls, per-rank counters, fleet skew;
+  see telemetry/ and docs/source/telemetry.rst). Answers "why was this
+  take slow?" after the process is gone.
 
 The inspection commands (``info``/``ls``/``cat``/``verify``) and
 ``consolidate`` work over any registered storage backend (fs://, s3://,
@@ -123,14 +127,9 @@ def _entry_desc(entry: Entry) -> str:
     return ""
 
 
-def _fmt_bytes(n: Optional[int]) -> str:
-    if n is None:
-        return "?"
-    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
-        if n < 1024 or unit == "TiB":
-            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
-        n /= 1024
-    return f"{n}B"
+# Shared with the telemetry stats rendering so sizes read identically
+# across info/ls/stats.
+from .telemetry.export import fmt_bytes as _fmt_bytes  # noqa: E402
 
 
 def _load_metadata(path: str) -> SnapshotMetadata:
@@ -606,6 +605,58 @@ def cmd_prune(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_stats(args: argparse.Namespace) -> int:
+    """Render the telemetry summary a take persisted next to its
+    metadata (telemetry/export.py) — "why was this take slow?" answered
+    after the fact, from any registered storage backend."""
+    import json
+
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+    from .telemetry import (
+        TELEMETRY_SUMMARY_FNAME,
+        merge_summaries,
+        render_summary_document,
+    )
+
+    event_loop = asyncio.new_event_loop()
+    storage = url_to_storage_plugin_in_event_loop(args.path, event_loop, None)
+    try:
+        read_io = ReadIO(path=TELEMETRY_SUMMARY_FNAME)
+        try:
+            event_loop.run_until_complete(storage.read(read_io))
+        except Exception as e:  # noqa: BLE001
+            # Broad on purpose: a missing object surfaces as OSError on
+            # fs but as botocore ClientError (NoSuchKey) / google-api
+            # NotFound on the cloud plugins — the friendly hint must work
+            # on every registered backend. The original error is included
+            # so genuine transport problems stay diagnosable.
+            print(
+                f"error: could not read {TELEMETRY_SUMMARY_FNAME} from "
+                f"{args.path} ({type(e).__name__}: {e}). If the snapshot "
+                "exists, it was likely taken without telemetry — save "
+                "with TORCHSNAPSHOT_TPU_TELEMETRY=1 to record a summary.",
+                file=sys.stderr,
+            )
+            return 2
+    finally:
+        storage.sync_close(event_loop)
+        event_loop.close()
+    try:
+        doc = json.loads(bytes(read_io.buf).decode("utf-8"))
+    except ValueError as e:
+        print(f"error: malformed telemetry summary: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    if not doc.get("fleet"):
+        # Documents written by future/foreign producers may omit the
+        # merged view; re-derive it so the rendering stays complete.
+        doc["fleet"] = merge_summaries(doc.get("ranks") or [])
+    print(render_summary_document(doc, verbose=args.verbose))
+    return 0
+
+
 def cmd_consolidate(args: argparse.Namespace) -> int:
     from .dedup import consolidate
 
@@ -642,6 +693,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("path")
     p.add_argument("-v", "--verbose", action="store_true")
     p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser(
+        "stats",
+        help="render the persisted telemetry summary of a take "
+             "(requires TORCHSNAPSHOT_TPU_TELEMETRY=1 at save time)",
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true", help="dump the raw document")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="include all spans and measured rates")
+    p.set_defaults(fn=cmd_stats)
 
     p = sub.add_parser(
         "migrate", help="convert a reference-format snapshot to native format"

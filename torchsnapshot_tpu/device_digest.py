@@ -306,10 +306,10 @@ def probe_hash_throughput() -> Optional[float]:
         return io_governor().hash_bps()
     _hash_probe_done = True
     try:
-        import time
-
         import jax
         import jax.numpy as jnp
+
+        from . import telemetry
 
         arr = jnp.zeros((_HASH_PROBE_BYTES // 4,), jnp.uint32)
         jax.block_until_ready(arr)
@@ -317,13 +317,16 @@ def probe_hash_throughput() -> Optional[float]:
         if pending is None:
             return None
         jax.block_until_ready(pending)
-        t0 = time.perf_counter()
+        t0 = telemetry.monotonic()
         jax.block_until_ready(_dispatch(arr))
-        dt = time.perf_counter() - t0
+        dt = telemetry.monotonic() - t0
+        # Importing the scheduler registers the governor's bus listener
+        # before the rate is published.
         from .scheduler import io_governor
 
-        io_governor().record_hash(_HASH_PROBE_BYTES, dt)
-        return io_governor().hash_bps()
+        governor = io_governor()
+        telemetry.record_rate("hash", None, _HASH_PROBE_BYTES, dt)
+        return governor.hash_bps()
     except Exception:  # pragma: no cover - probe must never break restore
         return None
 

@@ -26,6 +26,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..compression import MIN_COMPRESS_BYTES
 from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry
@@ -531,6 +532,12 @@ class ArrayBufferStager(BufferStager):
         """Runs in an executor thread: DtoH + serialize + (optional)
         compress + hash — keeping GB-scale byte work off the event-loop
         thread."""
+        with telemetry.span(
+            "stage_hash", cat="stager", bytes=array_nbytes(arr)
+        ):
+            return self._stage_and_sum_impl(arr)
+
+    def _stage_and_sum_impl(self, arr) -> BufferType:
         codec = self._active_codec()
         if self.entry is not None and self.dedup is None and codec is None:
             from ..integrity import checksums_enabled
@@ -669,18 +676,19 @@ class ArrayBufferStager(BufferStager):
         may alias caller memory (sync take), else a pooled-slab bounce
         copy FUSED with the running CRC (one pass over the source — the
         streaming analogue of _stage_fused). Returns (buffer, state)."""
-        chunk = mv[lo:hi]
-        if not self.copy_for_consistency:
-            return chunk, self._stream_checksum_update(state, chunk)
-        dst = _staging_pool.get(hi - lo)
-        if state is not None and state[0] == "crc32c":
-            from .._native import copy_crc32c
+        with telemetry.span("sub_chunk_stage", cat="stager", bytes=hi - lo):
+            chunk = mv[lo:hi]
+            if not self.copy_for_consistency:
+                return chunk, self._stream_checksum_update(state, chunk)
+            dst = _staging_pool.get(hi - lo)
+            if state is not None and state[0] == "crc32c":
+                from .._native import copy_crc32c
 
-            crc = copy_crc32c(dst, chunk, state[1])
-            if crc is not None:
-                return memoryview(dst), ("crc32c", crc)
-        np.copyto(dst, np.frombuffer(chunk, np.uint8))
-        return memoryview(dst), self._stream_checksum_update(state, chunk)
+                crc = copy_crc32c(dst, chunk, state[1])
+                if crc is not None:
+                    return memoryview(dst), ("crc32c", crc)
+            np.copyto(dst, np.frombuffer(chunk, np.uint8))
+            return memoryview(dst), self._stream_checksum_update(state, chunk)
 
     async def stage_stream(self, executor, sub_chunk_bytes: int):
         """Ordered sub-chunk buffers; concatenation == the buffered
@@ -741,11 +749,14 @@ class ArrayBufferStager(BufferStager):
             return piece
 
         def _materialize(piece, st):
-            host = np.asarray(piece)
-            if not host.flags["C_CONTIGUOUS"]:
-                host = np.ascontiguousarray(host)
-            buf = array_as_memoryview(host)
-            return buf, self._stream_checksum_update(st, buf)
+            # The DtoH landing + running CRC for one device sub-chunk
+            # (the DMA itself was kicked asynchronously by _kick).
+            with telemetry.span("sub_chunk_dtoh", cat="stager"):
+                host = np.asarray(piece)
+                if not host.flags["C_CONTIGUOUS"]:
+                    host = np.ascontiguousarray(host)
+                buf = array_as_memoryview(host)
+                return buf, self._stream_checksum_update(st, buf)
 
         pieces = [_kick(*ranges[0])]
         if len(ranges) > 1:

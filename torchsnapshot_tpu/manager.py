@@ -225,8 +225,6 @@ class CheckpointManager:
         multi-device processes). Host numpy leaves are skipped: the save
         never fingerprints them (``_device_dedup_candidate`` requires a
         jax array)."""
-        import time as _time
-
         from .device_digest import _dispatch
         from .io_preparers.array import _is_jax_array, iter_staged_pieces
         from .serialization import string_to_dtype
@@ -266,15 +264,19 @@ class CheckpointManager:
             import jax
 
             jax.block_until_ready(pendings)
-            from .scheduler import io_governor
+            from . import telemetry
 
             nbytes = int(
                 np.dtype(last_piece.dtype).itemsize
                 * int(np.prod(last_piece.shape, dtype=np.int64))
             )
-            t0 = _time.perf_counter()
+            t0 = telemetry.monotonic()
             jax.block_until_ready(_dispatch(last_piece))
-            io_governor().record_hash(nbytes, _time.perf_counter() - t0)
+            # Published on the bus; the governor's rate listener feeds
+            # its hash-vs-read preverify economics from there.
+            telemetry.record_rate(
+                "hash", None, nbytes, telemetry.monotonic() - t0
+            )
 
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0
@@ -376,6 +378,18 @@ class CheckpointManager:
             device_digests=self.device_digests,
             compression=self.compression,
             save_dtype=self.save_dtype,
+        )
+        from . import telemetry
+
+        # Queued, not an event: the take's OpRecorder begins inside
+        # Snapshot.take, AFTER this point — an instant event emitted here
+        # would precede the op mark and never reach the persisted
+        # summary/trace. annotate_next_op folds the manager context into
+        # the take's own summary instead.
+        telemetry.annotate_next_op(
+            step=step,
+            mode="emergency" if emergency else ("async" if use_async else "sync"),
+            incremental_base=base,
         )
         if use_async:
             self._pending = Snapshot.async_take(path, app_state, **kwargs)

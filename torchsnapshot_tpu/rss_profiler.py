@@ -18,6 +18,8 @@ from typing import Generator, List
 
 import psutil
 
+from . import telemetry
+
 _DEFAULT_INTERVAL_S = 0.1
 
 
@@ -50,7 +52,12 @@ class RSSProfiler:
     def _sample_loop(self) -> None:
         proc = psutil.Process()
         while True:
-            self.rss_deltas.append(proc.memory_info().rss - self._baseline)
+            delta = proc.memory_info().rss - self._baseline
+            self.rss_deltas.append(delta)
+            # Samples also land on the telemetry bus (a gauge track in the
+            # exported trace) — callers keep their list, the trace shows
+            # RSS against the pipeline spans on the same timeline.
+            telemetry.gauge_set("rss_delta_bytes", delta)
             if self._stop.wait(self.interval_s):
                 # One final sample so the peak inside the region isn't missed
                 # between the last tick and __exit__.

@@ -50,12 +50,12 @@ import logging
 import os
 import socket
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set
 
 import psutil
 
+from . import telemetry
 from .io_types import (
     ReadIO,
     ReadReq,
@@ -315,6 +315,26 @@ def io_governor() -> IOGovernor:
     return _governor
 
 
+def _feed_governor_rates(
+    kind: str, key: Optional[str], nbytes: int, seconds: float
+) -> None:
+    """Telemetry-bus rate listener: achieved write/read/hash rates are
+    published to the bus (telemetry.record_rate) by whoever measured
+    them; the governor's EWMA tables consume them here, keeping
+    ``measured_rates()`` a VIEW over bus-fed data rather than a second
+    measurement mechanism."""
+    governor = io_governor()
+    if kind == "write":
+        governor.record_write(key or "", nbytes, seconds)
+    elif kind == "read":
+        governor.record_read(key or "", nbytes, seconds)
+    elif kind == "hash":
+        governor.record_hash(nbytes, seconds)
+
+
+telemetry.register_rate_listener(_feed_governor_rates)
+
+
 def get_local_world_size(pg=None) -> int:
     """Number of processes on this host, via hostname all-gather
     (reference: scheduler.py:33-42)."""
@@ -375,14 +395,20 @@ class _WritePipeline:
             self.admission_cost_bytes = self.staging_cost_bytes
 
     async def stage_buffer(self, executor) -> "_WritePipeline":
-        self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
-        self.buf_size_bytes = memoryview(self.buf).nbytes
+        with telemetry.span(
+            "stage", path=self.write_req.path, bytes=self.staging_cost_bytes
+        ):
+            self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
+            self.buf_size_bytes = memoryview(self.buf).nbytes
         # Incremental snapshots: the stager found the payload unchanged in a
         # base snapshot — drop the buffer instead of writing it.
         if getattr(self.write_req.buffer_stager, "io_skipped", False):
             self.io_skipped = True
             self.buf = None
             self.buf_size_bytes = 0
+            telemetry.counter_add("bytes_deduped", self.staging_cost_bytes)
+        else:
+            telemetry.counter_add("bytes_staged", self.buf_size_bytes)
         return self
 
     async def stream_write(
@@ -397,23 +423,34 @@ class _WritePipeline:
         stager = self.write_req.buffer_stager
         chunks = stager.stage_stream(executor, self.sub_chunk_bytes)
         try:
-            await storage.write_stream(
-                WriteStream(
-                    path=self.write_req.path,
-                    nbytes=self.staging_cost_bytes,
-                    chunks=chunks,
+            with telemetry.span(
+                "stream_write",
+                path=self.write_req.path,
+                bytes=self.staging_cost_bytes,
+                sub_chunk_bytes=self.sub_chunk_bytes,
+            ):
+                await storage.write_stream(
+                    WriteStream(
+                        path=self.write_req.path,
+                        nbytes=self.staging_cost_bytes,
+                        chunks=chunks,
+                    )
                 )
-            )
         finally:
             aclose = getattr(chunks, "aclose", None)
             if aclose is not None:
                 await aclose()
         self.buf_size_bytes = self.staging_cost_bytes
+        telemetry.counter_add("bytes_staged", self.staging_cost_bytes)
+        telemetry.counter_add("entries_streamed", 1)
         return self
 
     async def write_buffer(self, storage: StoragePlugin) -> "_WritePipeline":
         assert self.buf is not None
-        await storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        with telemetry.span(
+            "storage_write", path=self.write_req.path, bytes=self.buf_size_bytes
+        ):
+            await storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
         self.buf = None  # release the staged buffer eagerly
         return self
 
@@ -447,7 +484,7 @@ class _ProgressReporter:
         self.completed_bytes = 0
         self.inflight_staging = 0
         self.inflight_io = 0
-        self._begin = time.monotonic()
+        self._begin = telemetry.monotonic()
         try:
             self._rss_begin = psutil.Process().memory_info().rss
         except Exception:  # pragma: no cover
@@ -475,7 +512,12 @@ class _ProgressReporter:
             rss_delta = psutil.Process().memory_info().rss - self._rss_begin
         except Exception:  # pragma: no cover
             rss_delta = 0
-        elapsed = time.monotonic() - self._begin
+        elapsed = telemetry.monotonic() - self._begin
+        # The periodic table doubles as the bus's queue-depth sampler:
+        # gauges render as counter tracks in the exported trace.
+        telemetry.gauge_set(f"{self.op}_inflight_staging", self.inflight_staging)
+        telemetry.gauge_set(f"{self.op}_inflight_io", self.inflight_io)
+        telemetry.gauge_set("budget_free_bytes", self.budget.available)
         if self.op == "read":
             # The read pipeline has no staging phase: report in-flight and
             # consumed counts with read-appropriate wording.
@@ -521,14 +563,14 @@ class _Throughput:
     def __init__(self, op: str, rank: int) -> None:
         self.op = op
         self.rank = rank
-        self.begin = time.monotonic()
+        self.begin = telemetry.monotonic()
         self.total_bytes = 0
 
     def add(self, nbytes: int) -> None:
         self.total_bytes += nbytes
 
     def elapsed(self) -> float:
-        return max(time.monotonic() - self.begin, 1e-9)
+        return max(telemetry.monotonic() - self.begin, 1e-9)
 
     def log_summary(self) -> None:
         elapsed = self.elapsed()
@@ -569,6 +611,8 @@ class PendingIOWork:
         reporter = self._reporter
         if reporter is not None:
             reporter.start()
+        drain_span = telemetry.span("io_drain")
+        drain_span.__enter__()
         try:
             while self._io_tasks or self._ready_for_io:
                 self._dispatch_io()
@@ -582,6 +626,8 @@ class PendingIOWork:
                     pipeline = task.result()
                     self._budget.release(pipeline.buf_size_bytes)
                     self._throughput.add(pipeline.buf_size_bytes)
+                    telemetry.counter_add("bytes_written", pipeline.buf_size_bytes)
+                    telemetry.counter_add("entries_written", 1)
                     if reporter is not None:
                         reporter.inflight_io -= 1
                         reporter.completed_count += 1
@@ -599,14 +645,17 @@ class PendingIOWork:
             self._executor.shutdown(wait=True)
             raise
         finally:
+            drain_span.__exit__(None, None, None)
             if reporter is not None:
                 reporter.stop()
         self._executor.shutdown(wait=True)
         self._throughput.log_summary()
-        # Feed the governor the ACHIEVED end-to-end write bandwidth (the
+        # Publish the ACHIEVED end-to-end write bandwidth on the bus (the
         # meter spans staging + I/O — exactly the rate the next save's
-        # sub-chunk sizing and concurrency should be tuned for).
-        io_governor().record_write(
+        # sub-chunk sizing and concurrency should be tuned for); the
+        # governor consumes it via its registered rate listener.
+        telemetry.record_rate(
+            "write",
             type(self._storage).__name__,
             self._throughput.total_bytes,
             self._throughput.elapsed(),
@@ -730,6 +779,7 @@ async def execute_write_reqs(
                 # Starvation escape: if nothing is in flight, admit the
                 # over-budget request — otherwise it would never run.
                 if staging_tasks or io_tasks or ready_for_io or deferred:
+                    telemetry.counter_add("budget_defers", 1)
                     break
             pipeline = ready_for_staging.pop(0)
             budget.acquire(pipeline.admission_cost_bytes)
@@ -775,6 +825,10 @@ async def execute_write_reqs(
                         inflight_streams -= 1
                         budget.release(pipeline.admission_cost_bytes)
                         throughput.add(pipeline.buf_size_bytes)
+                        telemetry.counter_add(
+                            "bytes_written", pipeline.buf_size_bytes
+                        )
+                        telemetry.counter_add("entries_written", 1)
                         reporter.completed_count += 1
                         reporter.completed_bytes += pipeline.buf_size_bytes
                         continue
@@ -790,6 +844,8 @@ async def execute_write_reqs(
                     pipeline = task.result()
                     budget.release(pipeline.buf_size_bytes)
                     throughput.add(pipeline.buf_size_bytes)
+                    telemetry.counter_add("bytes_written", pipeline.buf_size_bytes)
+                    telemetry.counter_add("entries_written", 1)
                     reporter.inflight_io -= 1
                     reporter.completed_count += 1
                     reporter.completed_bytes += pipeline.buf_size_bytes
@@ -863,10 +919,15 @@ class _ReadPipeline:
             # empty Range headers (S3 ignores them, GCS returns 416).
             read_io.buf = bytearray()
         else:
-            await storage.read(read_io)
+            with telemetry.span("storage_read", path=self.read_req.path) as sp:
+                await storage.read(read_io)
+                sp.set(bytes=memoryview(read_io.buf).nbytes)
         buf = read_io.buf
         throughput.add(len(buf))
-        await self.read_req.buffer_consumer.consume_buffer(buf, executor)
+        telemetry.counter_add("bytes_read", len(buf))
+        telemetry.counter_add("entries_read", 1)
+        with telemetry.span("consume", path=self.read_req.path, bytes=len(buf)):
+            await self.read_req.buffer_consumer.consume_buffer(buf, executor)
         return self
 
 
@@ -931,9 +992,10 @@ async def execute_read_reqs(
     executor.shutdown(wait=True)
     throughput.log_summary()
     # Achieved read bandwidth feeds the restore-side preverify economics
-    # (hash vs re-read) and concurrency tuning.
-    io_governor().record_read(
-        type(storage).__name__, throughput.total_bytes, throughput.elapsed()
+    # (hash vs re-read) and concurrency tuning, via the bus's governor
+    # listener.
+    telemetry.record_rate(
+        "read", type(storage).__name__, throughput.total_bytes, throughput.elapsed()
     )
 
 

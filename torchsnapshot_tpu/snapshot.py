@@ -38,15 +38,17 @@ from __future__ import annotations
 
 import asyncio
 import fnmatch
+import json
 import logging
 import os
 import sys
 import threading
-import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
+
+from . import telemetry
 
 from .batcher import batch_read_requests, batch_write_requests, batching_enabled
 from .dist_store import DEFAULT_BARRIER_TIMEOUT_S, LinearBarrier
@@ -103,11 +105,14 @@ class _PhaseTimer:
     def __init__(self, op: str) -> None:
         self.op = op
         self.phases: List[Tuple[str, float]] = []
-        self._t = time.perf_counter()
+        self._t = telemetry.monotonic()
 
     def mark(self, name: str) -> None:
-        now = time.perf_counter()
+        now = telemetry.monotonic()
         self.phases.append((name, now - self._t))
+        # Phase boundaries double as trace markers: the exported Chrome
+        # trace shows where materialize/plan/stage/commit begin and end.
+        telemetry.event(f"phase:{name}", cat="phase", op=self.op, dur_s=now - self._t)
         self._t = now
 
     def log(self) -> None:
@@ -203,6 +208,7 @@ class Snapshot:
             path, event_loop, storage_options
         )
         timer = _PhaseTimer("Snapshot.take")
+        recorder = telemetry.begin_op("take", pg_wrapper.get_rank())
         body_ok = False
         try:
             # Synchronous take blocks the caller until I/O drains, so staged
@@ -237,6 +243,14 @@ class Snapshot:
             pg_wrapper.barrier()
             timer.mark("commit")
             timer.log()
+            # AFTER the commit barrier: a telemetry failure can degrade
+            # observability but never un-commit a snapshot. The gather
+            # inside is unconditional (disabled ranks contribute None) so
+            # env skew can never desync the collective order.
+            cls._publish_telemetry(
+                "take", recorder, timer, pg_wrapper, storage, event_loop,
+                persist=True,
+            )
             body_ok = True
         finally:
             # A success flag, NOT sys.exc_info(): in a finally block
@@ -297,6 +311,7 @@ class Snapshot:
             path, event_loop, storage_options
         )
         timer = _PhaseTimer("Snapshot.async_take")
+        recorder = telemetry.begin_op("take", pg_wrapper.get_rank())
         pending_io_work, metadata = cls._take_impl(
             path=path,
             app_state=app_state,
@@ -322,6 +337,7 @@ class Snapshot:
             event_loop=event_loop,
             storage_options=storage_options,
             timer=timer,
+            recorder=recorder,
         )
 
     @classmethod
@@ -668,6 +684,7 @@ class Snapshot:
             self.path, event_loop, self._storage_options
         )
         timer = _PhaseTimer("Snapshot.restore")
+        recorder = telemetry.begin_op("restore", rank)
         try:
             metadata = self._read_metadata(storage, event_loop)
             available = get_manifest_for_rank(metadata, rank)
@@ -774,6 +791,16 @@ class Snapshot:
                             exc = e
                 pg_wrapper.barrier()
             timer.mark("load")
+            # BEFORE the raise: every rank reaches this point (per-key
+            # failures are captured, the loop always completes), so the
+            # unconditional telemetry gather stays symmetric even when
+            # this rank is about to raise. Restores never write into the
+            # snapshot directory — the fleet view is logged and exposed
+            # via telemetry.last_fleet() only.
+            self._publish_telemetry(
+                "restore", recorder, timer, pg_wrapper, storage, event_loop,
+                persist=False,
+            )
             if exc is not None:
                 raise exc
             timer.log()
@@ -1302,6 +1329,111 @@ class Snapshot:
             )
         )
 
+    # ------------------------------------------------------------- telemetry
+
+    @classmethod
+    def _publish_telemetry(
+        cls,
+        op: str,
+        recorder: "telemetry.OpRecorder",
+        timer: Optional[_PhaseTimer],
+        pg_wrapper: PGWrapper,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        persist: bool,
+    ) -> None:
+        """Finish this rank's per-op telemetry summary, gather every
+        rank's over the KV store, merge the fleet view, and (takes only)
+        persist the document + per-rank Chrome traces into the snapshot.
+
+        COLLECTIVE CONTRACT: when world_size > 1 the gather runs
+        UNCONDITIONALLY — a telemetry-disabled rank contributes None — so
+        ``TORCHSNAPSHOT_TPU_TELEMETRY`` skew between ranks degrades to a
+        partial fleet view, never a hang (the same flag-agreement lesson
+        the preverify gate learned, see _restore_impl). Summary building
+        and persistence are individually guarded: after the commit
+        barrier nothing here may fail the operation.
+        """
+        summary = None
+        try:
+            extra: Dict[str, Any] = {}
+            if timer is not None:
+                extra["phases"] = {n: round(dt, 6) for n, dt in timer.phases}
+            from .scheduler import io_governor
+
+            extra["rates"] = io_governor().measured_rates()
+            summary = recorder.finish(extra=extra)
+        except Exception:
+            logger.exception("telemetry summary failed; continuing without it")
+            summary = None
+        world_size = pg_wrapper.get_world_size()
+        try:
+            # The gather can only fail for store-level reasons (connection
+            # loss, peer death) that surface on EVERY rank's collective —
+            # swallowing locally cannot strand a healthy peer mid-gather.
+            # Summaries themselves are plain JSON-able dicts by
+            # construction, so per-rank payload failures don't exist.
+            if world_size > 1:
+                gathered = pg_wrapper.all_gather_object(summary)
+            else:
+                gathered = [summary]
+            fleet = telemetry.merge_summaries(gathered)
+            telemetry.set_last_fleet(fleet)
+            if fleet is None:
+                return  # telemetry off everywhere: zero residue
+            agg = fleet.get("aggregate") or {}
+            logger.info(
+                "telemetry[%s]: fleet wall %.3fs (slowest rank %s, skew "
+                "%.3fs), %.2f GB written aggregate%s",
+                op,
+                fleet.get("wall_s_max", 0.0),
+                fleet.get("slowest_rank"),
+                fleet.get("skew_s", 0.0),
+                (agg.get("bytes_written") or 0) / 1e9,
+                f" ({agg['write_gbps']:.2f} GB/s fleet)"
+                if agg.get("write_gbps")
+                else "",
+            )
+        except Exception:
+            # Post-commit (takes) / pre-raise (restores): a telemetry
+            # gather failure must neither fail a committed snapshot nor
+            # mask the restore error about to propagate.
+            logger.exception(
+                "telemetry cross-rank gather failed; continuing without "
+                "the fleet view"
+            )
+            return
+        if not persist:
+            return
+        rank = pg_wrapper.get_rank()
+        try:
+            if summary is not None:
+                trace = telemetry.chrome_trace_json(recorder.events(), pid=rank)
+                event_loop.run_until_complete(
+                    storage.write(
+                        WriteIO(
+                            path=telemetry.trace_path_for_rank(rank),
+                            buf=trace.encode("utf-8"),
+                        )
+                    )
+                )
+            if rank == 0:
+                doc = telemetry.build_summary_document(
+                    op, world_size, gathered, fleet
+                )
+                event_loop.run_until_complete(
+                    storage.write(
+                        WriteIO(
+                            path=telemetry.TELEMETRY_SUMMARY_FNAME,
+                            buf=json.dumps(doc, indent=1).encode("utf-8"),
+                        )
+                    )
+                )
+        except Exception:
+            logger.exception(
+                "telemetry persistence failed; the snapshot is unaffected"
+            )
+
     # --------------------------------------------------------------- helpers
 
     @staticmethod
@@ -1629,10 +1761,12 @@ class PendingSnapshot:
         storage_options: Optional[Dict[str, Any]] = None,
         barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
         timer: Optional[_PhaseTimer] = None,
+        recorder: Optional["telemetry.OpRecorder"] = None,
     ) -> None:
         self.path = path
         self.pg = pg_wrapper.pg
         self._timer = timer
+        self._recorder = recorder
         self._storage_options = storage_options
         self._done_event = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -1699,6 +1833,14 @@ class PendingSnapshot:
             if self._timer is not None:
                 self._timer.mark("commit")
                 self._timer.log()
+            if self._recorder is not None:
+                # Post-commit, on the background thread: the KV-store
+                # collectives are thread-safe by design, and this wrapper
+                # runs no further collectives after async_take returned.
+                Snapshot._publish_telemetry(
+                    "take", self._recorder, self._timer, pg_wrapper,
+                    storage, event_loop, persist=True,
+                )
             snapshot = Snapshot(self.path, self.pg, self._storage_options)
             snapshot._metadata = metadata
             self._snapshot = snapshot

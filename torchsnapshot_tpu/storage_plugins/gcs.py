@@ -28,9 +28,9 @@ import asyncio
 import io
 import logging
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO, WriteStream
 from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_error
 
@@ -173,8 +173,10 @@ class GCSStoragePlugin(StoragePlugin):
         progress (see retry.cloud_io_executor)."""
         loop = asyncio.get_running_loop()
         attempt = 0
+        slept_s = 0.0
+        op = getattr(fn, "__name__", None)
         while True:
-            started = time.monotonic()
+            started = telemetry.monotonic()
             try:
                 result = await loop.run_in_executor(cloud_io_executor(), fn)
                 self.retry_strategy.report_progress()
@@ -182,8 +184,12 @@ class GCSStoragePlugin(StoragePlugin):
             except BaseException as e:  # noqa: B036
                 if not _is_transient(e):
                     raise
-                await self.retry_strategy.backoff_or_raise(
-                    e, attempt, op_started_at=started
+                slept_s += await self.retry_strategy.backoff_or_raise(
+                    e,
+                    attempt,
+                    op_started_at=started,
+                    op=op,
+                    backoff_slept_s=slept_s,
                 )
                 attempt += 1
 

@@ -13,8 +13,9 @@ import asyncio
 import logging
 import random
 import threading
-import time
 from typing import Any, Callable, Optional
+
+from .. import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -86,6 +87,84 @@ def is_transient_error(exc: BaseException) -> bool:
     return isinstance(exc, (ConnectionError, TimeoutError))
 
 
+def classify_error(exc: BaseException) -> str:
+    """Coarse error-kind label for telemetry and failure reports:
+    ``throttle`` (429/SlowDown), ``server`` (5xx-style service faults),
+    ``timeout``, ``connection``, or ``other``. Classification is by
+    exception TYPE NAME and embedded status codes so it needs none of
+    the optional cloud SDKs installed to run."""
+    names = {t.__name__ for t in type(exc).__mro__}
+    if "TooManyRequests" in names:
+        return "throttle"
+    code = None
+    response = getattr(exc, "response", None)
+    if isinstance(response, dict):
+        code = response.get("ResponseMetadata", {}).get("HTTPStatusCode")
+        err = response.get("Error", {}).get("Code")
+        if code == 429 or err == "SlowDown":
+            return "throttle"
+        if err in ("RequestTimeout",):
+            return "timeout"
+        if err in ("InternalError", "ServiceUnavailable"):
+            return "server"
+    if code is not None and 500 <= int(code) < 600:
+        return "server"
+    if any(
+        n in names
+        for n in (
+            "InternalServerError",
+            "BadGateway",
+            "ServiceUnavailable",
+            "GatewayTimeout",
+        )
+    ):
+        return "server"
+    if "DeadlineExceeded" in names:
+        return "timeout"
+    if any("Timeout" in n for n in names) or isinstance(exc, TimeoutError):
+        return "timeout"
+    if "ChunkedEncodingError" in names:
+        return "connection"
+    if any("Connection" in n for n in names) or isinstance(exc, ConnectionError):
+        return "connection"
+    return "other"
+
+
+def attach_retry_history(
+    exc: BaseException,
+    attempts: int,
+    kind: str,
+    backoff_slept_s: float,
+    fleet_attempts: int,
+    fleet_backoff_s: float,
+) -> BaseException:
+    """Record the retry history ON the exception about to propagate.
+
+    The original exception object (and type) is preserved — callers
+    catching transport-specific exceptions keep working — with the
+    history attached as attributes and (Python 3.11+) a ``__notes__``
+    line, so a post-mortem shows how hard the fleet tried before the
+    shared deadline gave up."""
+    exc.retry_attempts = attempts
+    exc.retry_error_kind = kind
+    exc.retry_backoff_slept_s = round(backoff_slept_s, 3)
+    exc.retry_fleet_attempts = fleet_attempts
+    exc.retry_fleet_backoff_s = round(fleet_backoff_s, 3)
+    note = (
+        f"[torchsnapshot_tpu retry] gave up after {attempts} attempt(s) on "
+        f"this transfer ({backoff_slept_s:.1f}s backoff slept; error kind: "
+        f"{kind}); fleet totals this operation: {fleet_attempts} retry "
+        f"attempt(s), {fleet_backoff_s:.1f}s backoff"
+    )
+    add_note = getattr(exc, "add_note", None)
+    if callable(add_note):
+        try:
+            add_note(note)
+        except TypeError:  # pragma: no cover - exotic BaseException subclass
+            pass
+    return exc
+
+
 class CollectiveRetryStrategy:
     """Shared-deadline retry for a fleet of concurrent transfer coroutines.
 
@@ -108,7 +187,7 @@ class CollectiveRetryStrategy:
         stall_timeout_s: float = STALL_TIMEOUT_S,
         base_backoff_s: float = BASE_BACKOFF_S,
         max_backoff_s: float = MAX_BACKOFF_S,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = telemetry.monotonic,
         sleep: Optional[Callable[[float], Any]] = None,
     ) -> None:
         self._stall_timeout_s = stall_timeout_s
@@ -121,6 +200,12 @@ class CollectiveRetryStrategy:
         # the stall budget and fail the first transient error with zero
         # retries.
         self._deadline: Optional[float] = None
+        # Fleet-wide retry bookkeeping for this strategy instance (one
+        # instance per snapshot operation's transfer fleet): surfaced as
+        # telemetry events per attempt and attached to the exception on
+        # final failure — the attempt history used to vanish here.
+        self.fleet_attempts = 0
+        self.fleet_backoff_s = 0.0
 
     def report_progress(self) -> None:
         self._deadline = self._clock() + self._stall_timeout_s
@@ -133,6 +218,8 @@ class CollectiveRetryStrategy:
         longer than the stall timeout, the first transient error of the next
         snapshot would raise with zero retries."""
         self._deadline = None
+        self.fleet_attempts = 0
+        self.fleet_backoff_s = 0.0
 
     def backoff_s(self, attempt: int) -> float:
         # Cap the exponent before exponentiating: 2**attempt overflows
@@ -145,10 +232,18 @@ class CollectiveRetryStrategy:
         exc: BaseException,
         attempt: int,
         op_started_at: Optional[float] = None,
-    ) -> None:
+        op: Optional[str] = None,
+        backoff_slept_s: float = 0.0,
+    ) -> float:
         """``op_started_at``: when this attempt began. An attempt that
         *started* before the deadline lapsed gets one more retry even if it
-        ran long — time spent inside an active transfer is not a stall."""
+        ran long — time spent inside an active transfer is not a stall.
+
+        ``op``: a short label for the transfer unit (e.g. "put", "get")
+        carried on the telemetry events. ``backoff_slept_s``: total
+        backoff THIS coroutine already slept for the current transfer —
+        attached to the exception on final failure."""
+        kind = classify_error(exc)
         if self._deadline is None:
             self._deadline = self._clock() + self._stall_timeout_s
         elif self._clock() > self._deadline and (
@@ -159,10 +254,41 @@ class CollectiveRetryStrategy:
                 self._stall_timeout_s,
                 exc,
             )
-            raise exc
+            telemetry.event(
+                "storage_retry_exhausted",
+                cat="retry",
+                kind=kind,
+                op=op,
+                attempts=attempt + 1,
+                fleet_attempts=self.fleet_attempts,
+                fleet_backoff_s=round(self.fleet_backoff_s, 3),
+            )
+            raise attach_retry_history(
+                exc,
+                attempts=attempt + 1,
+                kind=kind,
+                backoff_slept_s=backoff_slept_s,
+                fleet_attempts=self.fleet_attempts,
+                fleet_backoff_s=self.fleet_backoff_s,
+            )
         backoff = self.backoff_s(attempt)
+        self.fleet_attempts += 1
+        self.fleet_backoff_s += backoff
+        telemetry.counter_add("retry_attempts", 1)
+        telemetry.counter_add("retry_backoff_s", backoff)
+        telemetry.event(
+            "storage_retry",
+            cat="retry",
+            kind=kind,
+            op=op,
+            attempt=attempt,
+            backoff_s=round(backoff, 3),
+        )
         logger.warning("Transient storage error (%s); retrying in %.1fs", exc, backoff)
         await self._sleep(backoff)
+        # The slept backoff, so callers can accumulate this coroutine's
+        # total and pass it back in via ``backoff_slept_s``.
+        return backoff
 
 
 # ---------------------------------------------------------------- executor

@@ -23,9 +23,9 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
-import time
 from typing import Any, Callable, Dict, Optional
 
+from .. import telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO, WriteStream
 from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_error
 
@@ -82,8 +82,10 @@ class S3StoragePlugin(StoragePlugin):
         shrink with the host's core count.)"""
         loop = asyncio.get_running_loop()
         attempt = 0
+        slept_s = 0.0
+        op = getattr(fn, "__name__", None)
         while True:
-            started = time.monotonic()
+            started = telemetry.monotonic()
             try:
                 result = await loop.run_in_executor(cloud_io_executor(), fn)
                 self.retry_strategy.report_progress()
@@ -91,8 +93,12 @@ class S3StoragePlugin(StoragePlugin):
             except BaseException as e:  # noqa: B036
                 if not is_transient_error(e):
                     raise
-                await self.retry_strategy.backoff_or_raise(
-                    e, attempt, op_started_at=started
+                slept_s += await self.retry_strategy.backoff_or_raise(
+                    e,
+                    attempt,
+                    op_started_at=started,
+                    op=op,
+                    backoff_slept_s=slept_s,
                 )
                 attempt += 1
 

@@ -1,0 +1,53 @@
+"""Checkpoint telemetry: one observability subsystem for the pipeline.
+
+Enable with ``TORCHSNAPSHOT_TPU_TELEMETRY=1``. See core.py for the event
+bus (spans/counters/gauges/rates), export.py for the Chrome-trace and
+persisted-summary formats, aggregate.py for the cross-rank fleet merge,
+and docs/source/telemetry.rst for the operator guide.
+
+Typical programmatic use::
+
+    from torchsnapshot_tpu import telemetry
+    telemetry.set_enabled(True)
+    Snapshot.take(path, app_state)
+    summary = telemetry.last_summary()       # plain dict
+    telemetry.write_chrome_trace("take.json")  # load in Perfetto
+"""
+
+from .core import (  # noqa: F401
+    TELEMETRY_ENV_VAR,
+    OpRecorder,
+    Span,
+    annotate_next_op,
+    begin_op,
+    counter_add,
+    counters,
+    dropped_events,
+    enabled,
+    event,
+    events,
+    gauge_set,
+    gauges,
+    last_fleet,
+    last_summary,
+    monotonic,
+    record_rate,
+    refresh_from_env,
+    register_rate_listener,
+    reset,
+    set_enabled,
+    set_last_fleet,
+    span,
+)
+from .export import (  # noqa: F401
+    TELEMETRY_SUMMARY_FNAME,
+    TRACE_DIR,
+    build_summary_document,
+    chrome_trace,
+    chrome_trace_json,
+    fmt_bytes,
+    render_summary_document,
+    trace_path_for_rank,
+    write_chrome_trace,
+)
+from .aggregate import merge_summaries  # noqa: F401

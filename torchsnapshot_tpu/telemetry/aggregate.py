@@ -1,0 +1,75 @@
+"""Cross-rank telemetry aggregation: per-rank summaries -> fleet view.
+
+On distributed takes/restores the per-rank summary dicts (core.OpRecorder
+.finish) are gathered over the existing KV-store collective plane
+(pg_wrapper.all_gather_object — the same channel the manifest gather
+uses; telemetry never touches device collectives) and merged here into
+one fleet view: who was slowest, how skewed the ranks were, and the
+aggregate byte counters. The merge is pure dict math so it can run
+anywhere — rank 0 at commit time, the ``stats`` CLI re-deriving a view
+from a persisted document, or a test constructing synthetic summaries.
+
+A rank whose telemetry was disabled contributes ``None`` (the gather is
+unconditional so env skew can never desync the collective order); the
+merge simply reports how many ranks contributed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# Counters that sum meaningfully across ranks. Everything else (gauges,
+# span stats) stays per-rank in the persisted document.
+_SUMMED_COUNTERS = (
+    "bytes_written",
+    "bytes_read",
+    "bytes_staged",
+    "bytes_deduped",
+    "entries_written",
+    "entries_streamed",
+    "entries_read",
+    "retry_attempts",
+    "retry_backoff_s",
+    "budget_defers",
+)
+
+
+def merge_summaries(
+    rank_summaries: List[Optional[Dict[str, Any]]]
+) -> Optional[Dict[str, Any]]:
+    """Merge gathered per-rank summaries into the fleet view.
+
+    Returns None when no rank contributed (telemetry off everywhere).
+    """
+    present = [
+        (i, s) for i, s in enumerate(rank_summaries) if isinstance(s, dict)
+    ]
+    if not present:
+        return None
+    walls = [(s.get("wall_s", 0.0), i) for i, s in present]
+    wall_max, slowest = max(walls)
+    wall_min, fastest = min(walls)
+    aggregate: Dict[str, float] = {}
+    for _, s in present:
+        for key in _SUMMED_COUNTERS:
+            val = (s.get("counters") or {}).get(key)
+            if val:
+                aggregate[key] = aggregate.get(key, 0) + val
+    if aggregate.get("bytes_written") and wall_max > 0:
+        # Fleet bandwidth over the op's critical path: everyone's bytes
+        # over the slowest rank's wall (the time the TRAINING LOOP paid).
+        # Unrounded: tiny test payloads would round to 0.
+        aggregate["write_gbps"] = aggregate["bytes_written"] / wall_max / 1e9
+    if aggregate.get("bytes_read") and wall_max > 0:
+        aggregate["read_gbps"] = aggregate["bytes_read"] / wall_max / 1e9
+    return {
+        "world_size": len(rank_summaries),
+        "reporting": len(present),
+        "op": present[0][1].get("op"),
+        "wall_s_max": round(wall_max, 6),
+        "wall_s_min": round(wall_min, 6),
+        "skew_s": round(wall_max - wall_min, 6),
+        "slowest_rank": slowest,
+        "fastest_rank": fastest,
+        "aggregate": aggregate,
+    }

@@ -1,0 +1,230 @@
+"""Telemetry exporters: Chrome/Perfetto trace JSON and the persisted
+per-take summary.
+
+Three consumers, three formats:
+
+- :func:`chrome_trace` — the raw event list as Chrome's Trace Event
+  format (``{"traceEvents": [...]}``), loadable in Perfetto /
+  ``chrome://tracing``. Spans become ``ph: "X"`` complete events on the
+  thread (tid) that ran them — executor lanes, the event loop, and the
+  background commit thread render as separate tracks; counters/gauges
+  become ``ph: "C"`` counter tracks.
+- the persisted summary — ``Snapshot.take`` writes the cross-rank
+  gathered per-op summaries plus the merged fleet view (aggregate.py)
+  to :data:`TELEMETRY_SUMMARY_FNAME` next to ``.snapshot_metadata``, so
+  ``python -m torchsnapshot_tpu stats <path>`` can answer "why was this
+  take slow?" long after the process is gone.
+- the plain-dict API — ``telemetry.last_summary()`` /
+  ``telemetry.last_fleet()`` (core.py) for programmatic scraping
+  (bench.py embeds them into its artifact).
+
+Timestamps: events carry raw ``time.monotonic()`` seconds; the trace
+exporter rebases to the earliest event and converts to the microseconds
+Chrome expects, so ``ts`` is always >= 0 and mutually consistent within
+one process's trace. Cross-rank traces are per-rank files — monotonic
+clocks are not comparable across hosts, and Perfetto renders each file's
+pid lane independently.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from . import core
+
+# Persisted next to .snapshot_metadata by rank 0 after the commit.
+TELEMETRY_SUMMARY_FNAME = ".snapshot_telemetry"
+# Per-rank Chrome traces, written by each telemetry-enabled rank.
+TRACE_DIR = ".telemetry"
+
+
+def trace_path_for_rank(rank: int) -> str:
+    return f"{TRACE_DIR}/rank_{rank}.trace.json"
+
+
+def chrome_trace(
+    events: Optional[List[Dict[str, Any]]] = None,
+    pid: int = 0,
+    process_name: str = "torchsnapshot_tpu",
+) -> Dict[str, Any]:
+    """Convert recorded events to Chrome Trace Event format.
+
+    ``events`` defaults to everything recorded in this process;
+    ``pid`` labels the process lane (use the rank on distributed ops).
+    """
+    if events is None:
+        events = core.events()
+    trace: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"{process_name} (rank {pid})"},
+        }
+    ]
+    if not events:
+        return {"traceEvents": trace, "displayTimeUnit": "ms"}
+    t0 = min(e["ts"] for e in events)
+
+    def us(seconds: float) -> int:
+        return int(round((seconds - t0) * 1e6))
+
+    for ev in sorted(events, key=lambda e: e["ts"]):
+        ph = ev.get("ph")
+        # tids are Python thread idents (large); compact them for the UI.
+        tid = ev.get("tid", 0) % 100_000
+        if ph == "span":
+            out = {
+                "ph": "X",
+                "name": ev["name"],
+                "cat": ev.get("cat", "pipeline"),
+                "pid": pid,
+                "tid": tid,
+                "ts": us(ev["ts"]),
+                "dur": max(0, int(round(ev["dur"] * 1e6))),
+            }
+            args = dict(ev.get("args") or {})
+            if ev.get("parent") is not None:
+                args["parent"] = ev["parent"]
+            if args:
+                out["args"] = args
+            trace.append(out)
+        elif ph == "counter":
+            trace.append(
+                {
+                    "ph": "C",
+                    "name": ev["name"],
+                    "cat": ev.get("cat", "counter"),
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(ev["ts"]),
+                    "args": {ev["name"]: ev.get("value", 0)},
+                }
+            )
+        else:  # instant
+            trace.append(
+                {
+                    "ph": "i",
+                    "s": "t",
+                    "name": ev["name"],
+                    "cat": ev.get("cat", "event"),
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": us(ev["ts"]),
+                    "args": ev.get("args") or {},
+                }
+            )
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def chrome_trace_json(
+    events: Optional[List[Dict[str, Any]]] = None, pid: int = 0
+) -> str:
+    return json.dumps(chrome_trace(events, pid=pid))
+
+
+def write_chrome_trace(
+    path: str, events: Optional[List[Dict[str, Any]]] = None, pid: int = 0
+) -> None:
+    """Write a Chrome trace of ``events`` to a local file."""
+    with open(path, "w") as f:
+        f.write(chrome_trace_json(events, pid=pid))
+
+
+# ------------------------------------------------------------ summary file
+
+
+def build_summary_document(
+    op: str,
+    world_size: int,
+    rank_summaries: List[Optional[Dict[str, Any]]],
+    fleet: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
+    return {
+        "version": 1,
+        "op": op,
+        "world_size": world_size,
+        "ranks": rank_summaries,
+        "fleet": fleet,
+    }
+
+
+def fmt_bytes(n: Optional[float]) -> str:
+    """THE byte formatter for operator-facing output (cli.py info/ls and
+    the stats rendering below share it, so sizes read identically across
+    commands)."""
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:g}B"
+        n /= 1024
+    return f"{n}B"
+
+
+_fmt_bytes = fmt_bytes
+
+
+def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
+    """Human-readable rendering of a persisted summary document (the
+    ``stats`` CLI command's output)."""
+    lines: List[str] = []
+    lines.append(f"op:          {doc.get('op')}")
+    lines.append(f"world_size:  {doc.get('world_size')}")
+    fleet = doc.get("fleet")
+    ranks = [r for r in (doc.get("ranks") or []) if r]
+    if fleet:
+        lines.append(f"fleet wall:  {fleet.get('wall_s_max', 0):.3f}s "
+                     f"(slowest rank {fleet.get('slowest_rank')}, "
+                     f"skew {fleet.get('skew_s', 0):.3f}s)")
+        agg = fleet.get("aggregate") or {}
+        if agg.get("bytes_written"):
+            lines.append(
+                f"written:     {_fmt_bytes(agg['bytes_written'])} aggregate"
+                + (
+                    f" ({agg['write_gbps']:.2f} GB/s fleet)"
+                    if agg.get("write_gbps")
+                    else ""
+                )
+            )
+        if agg.get("bytes_read"):
+            lines.append(f"read:        {_fmt_bytes(agg['bytes_read'])} aggregate")
+        if agg.get("bytes_deduped"):
+            lines.append(f"deduped:     {_fmt_bytes(agg['bytes_deduped'])} skipped")
+        if agg.get("retry_attempts"):
+            lines.append(f"retries:     {agg['retry_attempts']:.0f} attempts")
+    for summary in ranks:
+        lines.append("")
+        lines.append(
+            f"rank {summary.get('rank')}: {summary.get('op')} "
+            f"{summary.get('wall_s', 0):.3f}s"
+        )
+        phases = summary.get("phases") or {}
+        if phases:
+            lines.append(
+                "  phases:   "
+                + ", ".join(f"{n}={dt:.3f}s" for n, dt in phases.items())
+            )
+        counters = summary.get("counters") or {}
+        for key in sorted(counters):
+            val = counters[key]
+            shown = _fmt_bytes(val) if key.startswith("bytes_") else f"{val:g}"
+            lines.append(f"  {key}: {shown}")
+        spans = summary.get("spans") or {}
+        order = sorted(
+            spans.items(), key=lambda kv: kv[1].get("total_s", 0), reverse=True
+        )
+        if not verbose:
+            order = order[:8]
+        for name, agg in order:
+            lines.append(
+                f"  span {name}: x{agg['count']} total {agg['total_s']:.3f}s "
+                f"max {agg['max_s']:.3f}s"
+            )
+        if verbose and summary.get("rates"):
+            lines.append(f"  rates: {summary['rates']}")
+        if summary.get("dropped_events"):
+            lines.append(f"  dropped_events: {summary['dropped_events']}")
+    return "\n".join(lines)
