@@ -11,6 +11,13 @@ code from regrowing them. Wall-clock DEADLINE logic (store RPC timeouts,
 the test launcher's subprocess deadline) is not measurement and stays on
 raw ``time.monotonic`` via the explicit allowlist below.
 
+``benchmarks/`` is walked too: wall-clock measurement IS a benchmark's
+job, but only deliberately — files registered in ``BENCHMARK_ALLOWLIST``
+may call the raw clocks; anything else under benchmarks/ should go
+through the telemetry bus (or be registered here when it genuinely
+measures wall time), so a new benchmark can't accidentally grow a
+private timing idiom.
+
 Run: ``python scripts/check_timing_lint.py`` — exits 0 when clean,
 1 with a per-violation report otherwise. Enforced in tier-1 via
 tests/test_timing_lint.py.
@@ -24,6 +31,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
+BENCH_DIR = os.path.join(REPO, "benchmarks")
 
 # Paths (relative to the package) allowed to call time.monotonic/
 # perf_counter directly. Deadline/timeout bookkeeping only — add a file
@@ -32,6 +40,26 @@ PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
 ALLOWLIST = {
     "dist_store.py",  # store RPC / barrier deadline arithmetic
     "test_utils.py",  # multi-process launcher subprocess deadline
+}
+
+# Benchmark files (relative to benchmarks/) that measure wall clock
+# deliberately — the registration is the point: a benchmark timing the
+# pipeline from outside NEEDS raw perf_counter, and listing it here
+# records that the choice was deliberate rather than drift.
+BENCHMARK_ALLOWLIST = {
+    "async_stall.py",
+    "attention_bench.py",
+    "bench_utils.py",
+    "device_dedup.py",
+    "dist_verify.py",
+    "dma_overlap.py",
+    "embedding_save.py",
+    "manifest_scale.py",
+    "restore_overlap.py",  # read/consume overlap legs time wall clock
+    "sharded_save.py",
+    "store_scale.py",
+    "stream_overlap.py",
+    "vs_orbax.py",
 }
 
 _BANNED_ATTRS = {"monotonic", "perf_counter", "monotonic_ns", "perf_counter_ns"}
@@ -85,6 +113,12 @@ def main() -> int:
                 continue
             for lineno, what in _violations_in(os.path.join(dirpath, name)):
                 failures.append((rel, lineno, what))
+    if os.path.isdir(BENCH_DIR):
+        for name in sorted(os.listdir(BENCH_DIR)):
+            if not name.endswith(".py") or name in BENCHMARK_ALLOWLIST:
+                continue
+            for lineno, what in _violations_in(os.path.join(BENCH_DIR, name)):
+                failures.append((os.path.join("..", "benchmarks", name), lineno, what))
     if failures:
         print(
             "ad-hoc timing outside torchsnapshot_tpu/telemetry/ "

@@ -49,6 +49,17 @@ def test_lint_detects_violations(tmp_path):
     assert "mono()" in whats
 
 
+def test_benchmark_allowlist_covers_wall_clock_benchmarks():
+    """benchmarks/ is linted too: raw-clock benchmarks must be
+    registered deliberately, and the registry must not list files that
+    no longer exist (stale entries would mask a future rename)."""
+    lint = _load_lint()
+    assert "stream_overlap.py" in lint.BENCHMARK_ALLOWLIST
+    assert "restore_overlap.py" in lint.BENCHMARK_ALLOWLIST
+    for name in lint.BENCHMARK_ALLOWLIST:
+        assert os.path.exists(os.path.join(lint.BENCH_DIR, name)), name
+
+
 def test_lint_ignores_deadline_allowlist_and_telemetry():
     lint = _load_lint()
     assert "dist_store.py" in lint.ALLOWLIST

@@ -22,7 +22,15 @@ import os
 import uuid
 from typing import Dict, List, Optional, Tuple
 
-from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .io_types import (
+    STREAM_DEPTH,
+    BufferConsumer,
+    BufferStager,
+    BufferType,
+    ReadReq,
+    ReadStream,
+    WriteReq,
+)
 from .manifest import (
     ArrayEntry,
     ChunkedArrayEntry,
@@ -175,6 +183,122 @@ class BatchedBufferConsumer(BufferConsumer):
         # The spanning read materializes the whole merged range, gaps
         # included — charge the span, not just the consumed sub-ranges.
         return max(hi for _, hi in self.sub_ranges)
+
+    # ----------------------------------------------------- streaming path
+
+    def _ordered(self) -> List[Tuple[BufferConsumer, Tuple[int, int]]]:
+        return sorted(
+            zip(self.sub_consumers, self.sub_ranges), key=lambda t: t[1][0]
+        )
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        """The coalesced slab read streams whenever its sub-ranges are
+        disjoint (batch_read_requests emits them sorted and slab offsets
+        never overlap — this guards direct users): the ONE sequential
+        stream is cut at each entry's boundary and sliced to that
+        entry's consumer, which streams in turn when it can and
+        accumulates just its own slice when it can't. Turning the
+        many-small-ranged-GET restore pattern into a few large
+        sequential reads is the point — the spanning payload itself is
+        never materialized."""
+        prev_hi = 0
+        for _, (lo, hi) in self._ordered():
+            if lo < prev_hi:
+                return False
+            prev_hi = hi
+        return self.get_consuming_cost_bytes() >= 2 * sub_chunk_bytes
+
+    def stream_admission_cost(self, sub_chunk_bytes: int) -> int:
+        # Sub-consumers run one at a time off the sequential stream:
+        # peak is the costliest single slice (a streaming sub-consumer's
+        # declared window, a buffered one's whole slice) plus the
+        # in-flight chunks. Far below the spanning cost whenever the
+        # slab holds many entries.
+        worst = 0
+        for c, (lo, hi) in zip(self.sub_consumers, self.sub_ranges):
+            if c.can_stream(sub_chunk_bytes):
+                worst = max(worst, c.stream_admission_cost(sub_chunk_bytes))
+            else:
+                worst = max(worst, hi - lo)
+        return min(
+            self.get_consuming_cost_bytes(),
+            worst + STREAM_DEPTH * sub_chunk_bytes,
+        )
+
+    async def consume_stream(self, stream: ReadStream, executor=None) -> None:
+        cursor = _StreamCursor(stream.chunks)
+        for consumer, (lo, hi) in self._ordered():
+            await cursor.skip(lo - cursor.pos)  # gap bytes between entries
+            nbytes = hi - lo
+            # can_stream needs a sub-chunk size; the incoming chunks ARE
+            # the stream's sub-chunks, so probe with the slice size the
+            # consumer would otherwise buffer whole.
+            if consumer.can_stream(max(1, min(nbytes // 2, _READ_MERGE_GAP_BYTES))):
+                await consumer.consume_stream(
+                    ReadStream(
+                        path=stream.path,
+                        nbytes=nbytes,
+                        chunks=cursor.slice_stream(nbytes),
+                    ),
+                    executor,
+                )
+            else:
+                buf = bytearray(nbytes)
+                pos = 0
+                async for piece in cursor.slice_stream(nbytes):
+                    mv = memoryview(piece).cast("B")
+                    buf[pos : pos + mv.nbytes] = mv
+                    pos += mv.nbytes
+                await consumer.consume_buffer(buf, executor)
+
+
+class _StreamCursor:
+    """Sequential byte cursor over an ordered chunk stream: the batched
+    consumer cuts one spanning read into per-entry slices without ever
+    holding more than the chunk in flight."""
+
+    def __init__(self, chunks) -> None:
+        self._it = chunks.__aiter__()
+        self._cur: Optional[memoryview] = None
+        self._off = 0
+        self.pos = 0  # absolute offset within the spanning stream
+
+    async def _next_piece(self, limit: int) -> Optional[memoryview]:
+        while self._cur is None or self._off >= self._cur.nbytes:
+            try:
+                chunk = await self._it.__anext__()
+            except StopAsyncIteration:
+                return None
+            self._cur = memoryview(chunk).cast("B")
+            self._off = 0
+        take = min(limit, self._cur.nbytes - self._off)
+        piece = self._cur[self._off : self._off + take]
+        self._off += take
+        self.pos += take
+        return piece
+
+    async def skip(self, nbytes: int) -> None:
+        remaining = nbytes
+        while remaining > 0:
+            piece = await self._next_piece(remaining)
+            if piece is None:
+                raise IOError(
+                    f"short coalesced read stream: ran out {remaining} "
+                    f"bytes into a {nbytes}-byte gap"
+                )
+            remaining -= piece.nbytes
+
+    async def slice_stream(self, nbytes: int):
+        remaining = nbytes
+        while remaining > 0:
+            piece = await self._next_piece(remaining)
+            if piece is None:
+                raise IOError(
+                    f"short coalesced read stream: missing {remaining} of "
+                    f"{nbytes} bytes for the current entry"
+                )
+            remaining -= piece.nbytes
+            yield piece
 
 
 def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:

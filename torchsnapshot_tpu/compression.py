@@ -183,6 +183,141 @@ def decompress(codec: str, buf, expected_size: Optional[int] = None):
     return out
 
 
+class StreamingDecompressor:
+    """Incremental decompression for a STREAMED consume.
+
+    ``feed`` decodes one stored sub-chunk and returns whatever raw bytes
+    it produced (possibly none — codecs buffer internally); ``finish``
+    flushes the tail and enforces the same bomb bound and exact-size
+    checks as the buffered :func:`decompress`, so streamed and buffered
+    consumes of the same stored bytes accept/reject identically.
+
+    Bomb bound: zlib output is capped at ``expected_size`` per feed (one
+    byte of probe past the budget, never a chunk of overshoot). zstd has
+    no streaming output cap, so when ``expected_size`` is known the
+    frame header — buffered across feeds until it parses, since a
+    coalesced slab slice can split it — MUST declare exactly that size
+    before any byte is decompressed; our compressor always embeds it, so
+    only corrupt/foreign frames are rejected (the buffered path bounds
+    those via ``max_output_size`` instead)."""
+
+    def __init__(self, codec: str, expected_size: Optional[int] = None) -> None:
+        self._codec = codec
+        self._expected = expected_size
+        self._produced = 0
+        self._header = bytearray()  # zstd: stored bytes held until parsed
+        self._header_done = False
+        name, _, _ = codec.partition(":")
+        if name == "zstd":
+            zstd = _zstd()
+            if zstd is None:
+                raise UnknownCodecError(
+                    f"snapshot payload is compressed with {codec!r} but "
+                    "zstandard is not installed on this host"
+                )
+            self._zstd = zstd
+            self._obj = zstd.ZstdDecompressor().decompressobj()
+        elif name == "zlib":
+            self._zstd = None
+            self._obj = zlib.decompressobj()
+        else:
+            raise UnknownCodecError(
+                f"snapshot payload records unknown codec {codec!r}; upgrade "
+                "torchsnapshot_tpu or restore on a build that supports it"
+            )
+
+    @staticmethod
+    def available(codec: Optional[str]) -> bool:
+        """True when ``codec`` can be decoded incrementally on this host
+        (consumers gate ``can_stream`` on this — an unavailable codec
+        falls back to the buffered path, which raises the same
+        UnknownCodecError the user would see either way)."""
+        if codec is None:
+            return True
+        name = codec.partition(":")[0]
+        if name == "zlib":
+            return True
+        if name == "zstd":
+            return _zstd() is not None
+        return False
+
+    def _check_bound(self) -> None:
+        if self._expected is not None and self._produced > self._expected:
+            raise RuntimeError(
+                f"decompressed payload exceeds expected "
+                f"{self._expected} bytes ({self._codec})"
+            )
+
+    def feed(self, chunk) -> bytes:
+        view = chunk if isinstance(chunk, memoryview) else memoryview(chunk)
+        view = view.cast("B")
+        if (
+            self._zstd is not None
+            and self._expected is not None
+            and not self._header_done
+        ):
+            # Hold stored bytes until the frame header parses — nothing
+            # is decompressed before the declared size is checked, so a
+            # crafted frame can never demand an unbounded allocation.
+            self._header += view
+            try:
+                params = self._zstd.get_frame_parameters(
+                    memoryview(self._header)
+                )
+            except Exception:
+                return b""  # header still split across feeds
+            if params.content_size != self._expected:
+                raise RuntimeError(
+                    f"compressed payload declares {params.content_size} "
+                    f"bytes, expected {self._expected} ({self._codec})"
+                )
+            self._header_done = True
+            view = memoryview(bytes(self._header))
+            self._header = bytearray()
+        if self._zstd is None and self._expected is not None:
+            # Cap zlib output at one byte past the remaining budget: an
+            # overshooting stream is rejected without ever allocating
+            # beyond it.
+            out = self._obj.decompress(view, self._expected - self._produced + 1)
+        else:
+            out = self._obj.decompress(view)
+        self._produced += len(out)
+        self._check_bound()
+        return out
+
+    def finish(self) -> bytes:
+        if self._zstd is None:
+            if self._expected is not None:
+                # Mirror the buffered bound checks: capped feeds leave any
+                # overshoot as unconsumed input, and a probe decompress
+                # surfaces withheld output — flush() is never called here
+                # because it would decode past the bound uncapped.
+                if self._obj.unconsumed_tail or self._obj.decompress(b"", 1):
+                    raise RuntimeError(
+                        f"decompressed payload exceeds expected "
+                        f"{self._expected} bytes ({self._codec})"
+                    )
+                if self._obj.eof and self._obj.unused_data:
+                    raise RuntimeError(
+                        f"{len(self._obj.unused_data)} trailing bytes after "
+                        "zlib stream end; stored payload is corrupt"
+                    )
+                tail = b""
+            else:
+                tail = self._obj.flush()
+                self._produced += len(tail)
+        else:
+            tail = self._obj.flush()
+            self._produced += len(tail)
+            self._check_bound()
+        if self._expected is not None and self._produced != self._expected:
+            raise RuntimeError(
+                f"decompressed payload is {self._produced} bytes, expected "
+                f"{self._expected} ({self._codec})"
+            )
+        return tail
+
+
 # Stagers capture the active codec at prepare time (same pattern as
 # zero_copy_staging / dedup_staging).
 _active_codec: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(

@@ -94,3 +94,61 @@ def verify_checksum(buf, expected: str, path: str) -> None:
             f"snapshot data is corrupt (truncated, bit-rotted, or "
             f"overwritten since save)."
         )
+
+
+class IncrementalVerifier:
+    """Chained-checksum verification for a STREAMED consume.
+
+    ``update`` advances the running CRC over each stored sub-chunk as it
+    arrives (CRC32C/CRC32 chain over concatenated windows — identical to
+    hashing the whole buffer, so streamed and buffered consumes of the
+    same bytes accept/reject identically); ``finish`` compares against
+    the manifest and raises :class:`IntegrityError` on mismatch. The
+    skip semantics mirror :func:`verify_checksum` exactly: verification
+    disabled, no recorded checksum, an unknown algorithm, or crc32c
+    without the native extension (same one-time warning) all verify
+    nothing."""
+
+    __slots__ = ("_algo", "_value", "_digest", "_path")
+
+    def __init__(self, expected, path: str) -> None:
+        self._algo = None
+        self._value = 0
+        self._digest = ""
+        self._path = path
+        if expected is None or not verification_enabled():
+            return
+        algo, _, digest = expected.partition(":")
+        if algo == "crc32c":
+            if not native_available():
+                global _warned_slow_crc32c
+                if not _warned_slow_crc32c:
+                    _warned_slow_crc32c = True
+                    logger.warning(
+                        "Snapshot records crc32c checksums but the native "
+                        "extension is unavailable on this host; skipping "
+                        "verification (pure-Python CRC32C is too slow for "
+                        "checkpoint-sized data)."
+                    )
+                return
+            self._algo, self._digest = "crc32c", digest
+        elif algo == "crc32":
+            self._algo, self._digest = "crc32", digest
+
+    def update(self, chunk) -> None:
+        if self._algo == "crc32c":
+            self._value = crc32c(chunk, self._value)
+        elif self._algo == "crc32":
+            self._value = zlib.crc32(memoryview(chunk).cast("B"), self._value)
+
+    def finish(self) -> None:
+        if self._algo is None:
+            return
+        actual = f"{self._value & 0xFFFFFFFF:08x}"
+        if actual != self._digest:
+            raise IntegrityError(
+                f"checksum mismatch reading {self._path!r}: manifest records "
+                f"{self._algo}:{self._digest}, stream hashes to "
+                f"{self._algo}:{actual} — the snapshot data is corrupt "
+                f"(truncated, bit-rotted, or overwritten since save)."
+            )

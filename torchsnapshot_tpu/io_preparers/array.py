@@ -22,6 +22,7 @@ import os
 import sys
 import threading
 import weakref
+from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -822,6 +823,181 @@ class ArrayBufferStager(BufferStager):
         return array_nbytes(self.arr)
 
 
+@dataclass
+class DeviceMaterializer:
+    """How a restored array lands on device, captured at prepare time
+    (prepare.py's jax-destination branch). The buffered path keeps using
+    the host-array callback (one ``device_put`` of the whole payload);
+    the STREAMED path uses this instead: each sub-chunk is ``device_put``
+    as it arrives, so HtoD of chunk N rides under the read of chunk N+1
+    and the host never holds more than the in-flight window."""
+
+    sharding: object
+    dst_dtype: object
+    needs_cast: bool
+    callback: Optional[Callable]
+
+
+class _ScratchSink:
+    """Raw-byte sink for verify-before-commit streamed consumes: bytes
+    accumulate in a scratch buffer and NOTHING touches the destination
+    until the chained checksum validated — the buffered path's
+    verify-then-copy safety, kept under streaming at the cost of holding
+    the payload (which is why consumers using this sink declare the FULL
+    consuming cost to the budget, not the window)."""
+
+    def __init__(self, nbytes: int) -> None:
+        # Pooled slab, not a fresh allocation: on lazily-backed VMs the
+        # first touch of never-used memory costs several x a normal
+        # fault, and a training loop restores repeatedly — the pool's
+        # GC-driven recycling (see _StagingPool) hands back pre-faulted
+        # slabs, and any view a consumer keeps pins the slab until it
+        # dies.
+        self.buf = _staging_pool.get(nbytes) if nbytes else np.empty(0, np.uint8)
+        self.pos = 0
+
+    def add(self, data) -> None:
+        mv = data if isinstance(data, memoryview) else memoryview(data)
+        mv = mv.cast("B")
+        if self.pos + mv.nbytes > self.buf.nbytes:
+            raise IOError(
+                f"read stream produced more than the expected "
+                f"{self.buf.nbytes} bytes"
+            )
+        self.buf[self.pos : self.pos + mv.nbytes] = np.frombuffer(mv, np.uint8)
+        self.pos += mv.nbytes
+
+    def finish(self) -> memoryview:
+        if self.pos != self.buf.nbytes:
+            raise IOError(
+                f"short read stream: produced {self.pos} of "
+                f"{self.buf.nbytes} bytes"
+            )
+        return memoryview(self.buf)
+
+
+class _DeviceRowSink:
+    """Per-sub-chunk HtoD sink: whole-row blocks of the decoded payload
+    are ``device_put`` as they land, assembled on device at the end
+    (concatenate along dim 0, then placed under the destination
+    sharding). The host holds only the carry of a partial row plus the
+    chunk in flight — the window the scheduler's budget charges — and
+    the destination array is untouched until the checksum validated and
+    the callback fires."""
+
+    def __init__(self, entry: "ArrayEntry", dest: DeviceMaterializer) -> None:
+        self.shape = tuple(entry.shape)
+        self.np_dtype = string_to_dtype(entry.dtype)
+        raw = array_size_bytes(self.shape, entry.dtype)
+        self.row_bytes = max(1, raw // self.shape[0])
+        self.row_elems = self.row_bytes // self.np_dtype.itemsize
+        self.dest = dest
+        self.carry = bytearray()
+        self.blocks: list = []
+        self.rows = 0
+        self._device = None
+
+    def add(self, data) -> None:
+        import jax
+
+        mv = data if isinstance(data, memoryview) else memoryview(data)
+        self.carry += mv.cast("B")
+        whole = (len(self.carry) // self.row_bytes) * self.row_bytes
+        if not whole:
+            return
+        src = self.carry
+        self.carry = bytearray(memoryview(src)[whole:])
+        rows = whole // self.row_bytes
+        block = np.frombuffer(
+            src, dtype=self.np_dtype, count=rows * self.row_elems
+        ).reshape((rows,) + self.shape[1:])
+        if self._device is None:
+            self._device = next(iter(self.dest.sharding.device_set))
+        # device_put returns immediately (transfer proceeds in the
+        # background) and `src` stays alive through the block's buffer
+        # reference — and is never mutated again, so a zero-copy CPU
+        # device_put is safe too.
+        with telemetry.span("sub_chunk_htod", cat="consumer", bytes=whole):
+            self.blocks.append(jax.device_put(block, self._device))
+        self.rows += rows
+
+    def finish(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self.carry:
+            raise IOError(
+                f"read stream ended mid-row: {len(self.carry)} trailing "
+                f"bytes do not fill a {self.row_bytes}-byte row"
+            )
+        if self.rows != self.shape[0]:
+            raise IOError(
+                f"short read stream: produced {self.rows} of "
+                f"{self.shape[0]} rows"
+            )
+        full = self.blocks[0] if len(self.blocks) == 1 else jnp.concatenate(
+            self.blocks, axis=0
+        )
+        self.blocks = []
+        restored = jax.device_put(full, self.dest.sharding)
+        if self.dest.needs_cast:
+            restored = restored.astype(self.dest.dst_dtype)
+        if self.dest.callback is not None:
+            self.dest.callback(restored)
+
+
+class _IncrementalEntryDecoder:
+    """Per-sub-chunk verify + decompress for one entry's streamed
+    payload: the chained CRC advances over the STORED bytes exactly as
+    the buffered `verify_checksum` would hash them, decompression (when
+    the entry records a codec) feeds the same chunk through a streaming
+    decompressor, and decoded raw bytes flow to ``sink_add``. ``finish``
+    flushes the codec tail and raises on checksum mismatch BEFORE the
+    caller commits anything."""
+
+    def __init__(self, entry: "ArrayEntry", sink_add: Callable) -> None:
+        from ..compression import StreamingDecompressor
+        from ..integrity import IncrementalVerifier
+
+        self.verifier = IncrementalVerifier(entry.checksum, entry.location)
+        self.decomp = (
+            StreamingDecompressor(
+                entry.codec,
+                expected_size=array_size_bytes(entry.shape, entry.dtype),
+            )
+            if entry.codec is not None
+            else None
+        )
+        self.sink_add = sink_add
+
+    def add(self, chunk) -> None:
+        with telemetry.span(
+            "consume_chunk", cat="consumer", bytes=memoryview(chunk).nbytes
+        ):
+            self.verifier.update(chunk)
+            data = self.decomp.feed(chunk) if self.decomp is not None else chunk
+            if memoryview(data).nbytes:
+                self.sink_add(data)
+
+    def finish(self) -> None:
+        if self.decomp is not None:
+            tail = self.decomp.finish()
+            if tail:
+                self.sink_add(tail)
+        self.verifier.finish()
+
+
+def _entry_stored_size(entry: "ArrayEntry") -> int:
+    """Bytes storage will deliver for ``entry`` — the byte range for
+    slab-packed payloads, the serialized size otherwise (compressed
+    payloads' stored size isn't recorded; the raw size is the proxy the
+    streaming election uses)."""
+    if entry.byte_range is not None:
+        lo, hi = entry.byte_range
+        return max(0, hi - lo)
+    return array_size_bytes(entry.shape, entry.dtype)
+
+
 class ArrayBufferConsumer(BufferConsumer):
     """Deserializes into ``dst_view`` (if given) and invokes ``callback`` with
     the host array. Exactly one of the two is typically used."""
@@ -832,6 +1008,7 @@ class ArrayBufferConsumer(BufferConsumer):
         dst_view: Optional[np.ndarray] = None,
         callback: Optional[Callable[[np.ndarray], None]] = None,
         ensure_writable: bool = True,
+        device_dest: Optional[DeviceMaterializer] = None,
     ) -> None:
         self.entry = entry
         self.dst_view = dst_view
@@ -841,6 +1018,29 @@ class ArrayBufferConsumer(BufferConsumer):
         # (S3/GCS); device-materialize callbacks opt out — device_put never
         # needs a writable source and the copy would be pure waste.
         self.ensure_writable = ensure_writable
+        # Streamed consumes of jax destinations device_put per sub-chunk
+        # through this instead of the host-array callback (which is the
+        # buffered path's one-shot device_put).
+        self.device_dest = device_dest
+
+    def _deliver(self, buf: BufferType) -> None:
+        """Commit a VERIFIED, DECOMPRESSED raw payload to the
+        destination — the tail both the buffered and the streamed
+        scratch path share."""
+        arr = array_from_buffer(buf, self.entry.dtype, self.entry.shape)
+        if (
+            self.dst_view is None
+            and self.callback is not None
+            and self.ensure_writable
+            and not arr.flags["WRITEABLE"]
+        ):
+            arr = np.array(arr)
+        if self.dst_view is not None:
+            fast_copyto(self.dst_view, arr)
+            if self.callback is not None:
+                self.callback(self.dst_view)
+        elif self.callback is not None:
+            self.callback(arr)
 
     def _consume_sync(self, buf: BufferType) -> None:
         if self.entry.checksum is not None:
@@ -861,20 +1061,7 @@ class ArrayBufferConsumer(BufferConsumer):
                     self.entry.shape, self.entry.dtype
                 ),
             )
-        arr = array_from_buffer(buf, self.entry.dtype, self.entry.shape)
-        if (
-            self.dst_view is None
-            and self.callback is not None
-            and self.ensure_writable
-            and not arr.flags["WRITEABLE"]
-        ):
-            arr = np.array(arr)
-        if self.dst_view is not None:
-            fast_copyto(self.dst_view, arr)
-            if self.callback is not None:
-                self.callback(self.dst_view)
-        elif self.callback is not None:
-            self.callback(arr)
+        self._deliver(buf)
 
     async def consume_buffer(self, buf: BufferType, executor=None) -> None:
         if executor is not None:
@@ -885,6 +1072,98 @@ class ArrayBufferConsumer(BufferConsumer):
 
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.entry.shape, self.entry.dtype)
+
+    # ----------------------------------------------------- streaming path
+
+    def _device_sink_ok(self) -> bool:
+        """The per-sub-chunk device sink applies to SINGLE-DEVICE
+        destinations only: the sink assembles row blocks on one device
+        (transiently ~2x the entry there — bounded, since entries
+        reaching this consumer are <=512 MB by the chunking policy), and
+        for a replicated multi-device destination that assembly would
+        add a pointless extra broadcast hop over the buffered path's
+        direct sharded device_put — those stream through the scratch
+        path instead."""
+        if self.dst_view is not None or self.device_dest is None:
+            return False
+        shape = tuple(self.entry.shape)
+        if len(shape) < 1 or shape[0] < 1:
+            return False
+        try:
+            if len(self.device_dest.sharding.device_set) != 1:
+                return False
+        except AttributeError:
+            return False
+        return True
+
+    def _device_mode_ok(self, sub_chunk_bytes: int) -> bool:
+        """Device sink AND rows no wider than the sub-chunk: wider rows
+        would grow the carry past the window the budget charges — such
+        shapes still use the device sink but declare full cost."""
+        if not self._device_sink_ok():
+            return False
+        shape = tuple(self.entry.shape)
+        raw = array_size_bytes(shape, self.entry.dtype)
+        row_bytes = raw // shape[0]
+        return 0 < row_bytes <= sub_chunk_bytes
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        """This consumer streams whenever the payload spans several
+        sub-chunks and its codec (if any) decompresses incrementally.
+        Checksums never block: the chained CRC is bit-identical to the
+        whole-buffer hash, and the skip rules (unknown algorithm, crc32c
+        without the native extension, verification disabled) mirror the
+        buffered path's."""
+        from ..compression import StreamingDecompressor
+
+        if _entry_stored_size(self.entry) < 2 * sub_chunk_bytes:
+            return False
+        return StreamingDecompressor.available(self.entry.codec)
+
+    def stream_admission_cost(self, sub_chunk_bytes: int) -> int:
+        cost = self.get_consuming_cost_bytes()
+        if self._device_mode_ok(sub_chunk_bytes):
+            # Chunk being decoded + the plugin's read-ahead + the row
+            # carry: the window the device sink actually holds.
+            from ..io_types import STREAM_DEPTH
+
+            return min(cost, (STREAM_DEPTH + 1) * sub_chunk_bytes)
+        # Scratch assembly (verify-before-commit into host memory) holds
+        # the full payload — declare it honestly.
+        return cost
+
+    async def consume_stream(self, stream, executor=None) -> None:
+        # Sink choice is shape-driven, not size-driven: eligible jax
+        # destinations take the windowed device sink regardless of the
+        # row/sub-chunk ratio (the budget already charged whichever cost
+        # stream_admission_cost declared).
+        if self._device_sink_ok():
+            sink = _DeviceRowSink(self.entry, self.device_dest)
+            scratch = None
+        else:
+            scratch = _ScratchSink(
+                array_size_bytes(self.entry.shape, self.entry.dtype)
+            )
+            sink = scratch
+        decoder = _IncrementalEntryDecoder(self.entry, sink.add)
+        loop = asyncio.get_running_loop() if executor is not None else None
+
+        def finish() -> None:
+            decoder.finish()  # checksum mismatch raises BEFORE any commit
+            if scratch is not None:
+                self._deliver(scratch.finish())
+            else:
+                sink.finish()
+
+        async for chunk in stream.chunks:
+            if loop is not None:
+                await loop.run_in_executor(executor, decoder.add, chunk)
+            else:
+                decoder.add(chunk)
+        if loop is not None:
+            await loop.run_in_executor(executor, finish)
+        else:
+            finish()
 
 
 class ArrayIOPreparer:
@@ -910,6 +1189,7 @@ class ArrayIOPreparer:
         callback: Optional[Callable[[np.ndarray], None]] = None,
         buffer_size_limit_bytes: Optional[int] = None,
         ensure_writable: bool = True,
+        device_dest: Optional[DeviceMaterializer] = None,
     ) -> List[ReadReq]:
         # Compressed payloads can't be read by byte sub-ranges (no random
         # access into the stream): whole-entry read, budget or not.
@@ -921,6 +1201,7 @@ class ArrayIOPreparer:
                 dst_view=dst_view,
                 callback=callback,
                 ensure_writable=ensure_writable,
+                device_dest=device_dest,
             )
             byte_range = (
                 tuple(entry.byte_range) if entry.byte_range is not None else None
@@ -969,6 +1250,71 @@ class _SlicedArrayConsumer(BufferConsumer):
     def get_consuming_cost_bytes(self) -> int:
         itemsize = array_size_bytes((1,), self.entry.dtype)
         return (self.elem_hi - self.elem_lo) * itemsize
+
+    # ----------------------------------------------------- streaming path
+
+    def _direct_flat_bytes(self) -> Optional[np.ndarray]:
+        """The destination's raw-byte view for direct incremental fills,
+        or None when bytes can't land verbatim (a same-kind dtype cast is
+        pending — the buffered path's element-wise copy handles that)."""
+        flat = self.assembler._flat
+        if flat.dtype != string_to_dtype(self.entry.dtype):
+            return None
+        if not flat.flags["C_CONTIGUOUS"]:
+            return None
+        return flat.view(np.uint8)
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        # Budget-split sub-range reads carry no checksum or codec (the
+        # whole-entry consumer owns those), so streaming is a plain
+        # incremental byte fill of pre-existing assembler memory — the
+        # same partial-fill-on-failure semantics a buffered failure
+        # between this entry's sub-reads already has.
+        if self.get_consuming_cost_bytes() < 2 * sub_chunk_bytes:
+            return False
+        return self._direct_flat_bytes() is not None
+
+    def stream_admission_cost(self, sub_chunk_bytes: int) -> int:
+        from ..io_types import STREAM_DEPTH
+
+        # The destination is assembler memory that pre-exists this read;
+        # only the in-flight chunks are new.
+        return min(
+            self.get_consuming_cost_bytes(), STREAM_DEPTH * sub_chunk_bytes
+        )
+
+    async def consume_stream(self, stream, executor=None) -> None:
+        itemsize = array_size_bytes((1,), self.entry.dtype)
+        dst = self._direct_flat_bytes()
+        base = self.elem_lo * itemsize
+        total = (self.elem_hi - self.elem_lo) * itemsize
+        pos = 0
+
+        def fill(chunk) -> int:
+            mv = memoryview(chunk).cast("B")
+            with telemetry.span("consume_chunk", cat="consumer", bytes=mv.nbytes):
+                if pos + mv.nbytes > total:
+                    raise IOError(
+                        f"read stream produced more than the expected "
+                        f"{total} bytes for {self.entry.location}"
+                    )
+                dst[base + pos : base + pos + mv.nbytes] = np.frombuffer(
+                    mv, np.uint8
+                )
+            return mv.nbytes
+
+        loop = asyncio.get_running_loop() if executor is not None else None
+        async for chunk in stream.chunks:
+            if loop is not None:
+                pos += await loop.run_in_executor(executor, fill, chunk)
+            else:
+                pos += fill(chunk)
+        if pos != total:
+            raise IOError(
+                f"short read stream for {self.entry.location}: produced "
+                f"{pos} of {total} bytes"
+            )
+        self.assembler.part_done()
 
 
 class ArrayAssembler:

@@ -137,6 +137,7 @@ class ChunkedArrayIOPreparer:
         callback: Optional[Callable[[np.ndarray], None]] = None,
         buffer_size_limit_bytes: Optional[int] = None,
         ensure_writable: bool = True,
+        device_dest=None,
     ) -> List[ReadReq]:
         if len(entry.chunks) == 1 and list(entry.chunks[0].sizes) == list(
             entry.shape
@@ -147,13 +148,16 @@ class ChunkedArrayIOPreparer:
             # destinations the device_put can consume a zero-copy view
             # over the read buffer directly). Semantics match the
             # assembler path: dst_view is filled in place, the callback
-            # fires once with the complete array.
+            # fires once with the complete array. device_dest forwards
+            # only here — the multi-chunk path assembles regions on the
+            # host and device_puts once via the completion callback.
             return ArrayIOPreparer.prepare_read(
                 entry.chunks[0].array,
                 dst_view=dst_view,
                 callback=callback,
                 buffer_size_limit_bytes=buffer_size_limit_bytes,
                 ensure_writable=ensure_writable,
+                device_dest=device_dest,
             )
         if dst_view is None:
             dst_view = np.empty(

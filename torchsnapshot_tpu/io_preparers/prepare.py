@@ -228,6 +228,7 @@ def prepare_read(
     # writable arrays; the device-materialize path below opts out —
     # device_put never needs a writable source.
     ensure_writable = True
+    device_dest = None
 
     if isinstance(obj_out, np.ndarray) and obj_out.flags["WRITEABLE"]:
         if list(obj_out.shape) != list(entry.shape):
@@ -268,6 +269,18 @@ def prepare_read(
 
         final_callback = _materialize
         ensure_writable = False
+        # STREAMED reads bypass the host-array callback: the consumer
+        # device_puts each sub-chunk as it lands (HtoD of chunk N rides
+        # under the read of chunk N+1) and materializes under the same
+        # sharding/cast rules this callback applies buffered.
+        from .array import DeviceMaterializer
+
+        device_dest = DeviceMaterializer(
+            sharding=sharding,
+            dst_dtype=dst_dtype,
+            needs_cast=needs_cast,
+            callback=callback,
+        )
     # else: no usable destination — allocate inside the preparer and report
     # the host value via callback.
 
@@ -278,6 +291,7 @@ def prepare_read(
             callback=final_callback,
             buffer_size_limit_bytes=buffer_size_limit_bytes,
             ensure_writable=ensure_writable,
+            device_dest=device_dest,
         )
     else:
         return ArrayIOPreparer.prepare_read(
@@ -286,6 +300,7 @@ def prepare_read(
             callback=final_callback,
             buffer_size_limit_bytes=buffer_size_limit_bytes,
             ensure_writable=ensure_writable,
+            device_dest=device_dest,
         )
 
 

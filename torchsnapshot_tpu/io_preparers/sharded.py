@@ -202,6 +202,48 @@ class _ShardScatterConsumer(BufferConsumer):
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.shard.array.shape, self.shard.array.dtype)
 
+    # ----------------------------------------------------- streaming path
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        """Streamed shard consumes verify the chained CRC and feed
+        decompression per sub-chunk WHILE later sub-chunks are still on
+        the wire; the scatter into destination boxes happens only after
+        the checksum validated (verify-before-commit, like the buffered
+        path), so the full shard scratch is retained and the declared
+        admission cost stays the default full consuming cost."""
+        from ..compression import StreamingDecompressor
+        from .array import _entry_stored_size
+
+        if _entry_stored_size(self.shard.array) < 2 * sub_chunk_bytes:
+            return False
+        return StreamingDecompressor.available(self.shard.array.codec)
+
+    async def consume_stream(self, stream, executor=None) -> None:
+        from .array import _IncrementalEntryDecoder, _ScratchSink
+
+        entry = self.shard.array
+        scratch = _ScratchSink(array_size_bytes(entry.shape, entry.dtype))
+        decoder = _IncrementalEntryDecoder(entry, scratch.add)
+
+        def finish() -> None:
+            decoder.finish()  # checksum mismatch raises BEFORE the scatter
+            arr = array_from_buffer(scratch.finish(), entry.dtype, entry.shape)
+            for dst_buf, src_slices, dst_slices in self.targets:
+                target = dst_buf[dst_slices] if dst_slices else dst_buf
+                fast_copyto(target, arr[src_slices] if src_slices else arr)
+
+        loop = asyncio.get_running_loop() if executor is not None else None
+        async for chunk in stream.chunks:
+            if loop is not None:
+                await loop.run_in_executor(executor, decoder.add, chunk)
+            else:
+                decoder.add(chunk)
+        if loop is not None:
+            await loop.run_in_executor(executor, finish)
+        else:
+            finish()
+        self.completion.part_done()
+
 
 class _Completion:
     def __init__(self, num_parts: int, finalize: Callable[[], None]) -> None:

@@ -77,6 +77,42 @@ class ReadIO:
     byte_range: Optional[Tuple[int, int]] = None
 
 
+@dataclass
+class ReadStream:
+    """An ORDERED stream of sub-chunk buffers for one storage read.
+
+    The read-side mirror of :class:`WriteStream`: instead of the whole
+    payload landing in memory before the first byte is hashed,
+    decompressed, or copied to device, the plugin yields 8-256 MB
+    sub-chunks as the transport produces them and the consumer verifies/
+    decodes each while the next is still being fetched — the entry's
+    restore wall becomes ~max(read, consume) instead of read + consume,
+    and in-flight host memory is the sub-chunk window, not the payload.
+
+    ``nbytes`` is the exact payload size, known before the first chunk
+    is produced (fs: stat; s3: HEAD or the byte range; gcs: metadata
+    reload or the byte range). ``chunks`` yields buffers whose
+    concatenation IS the payload ``StoragePlugin.read`` would have
+    returned for the same request; each buffer stays valid for as long
+    as the consumer holds a reference and is never mutated in place by
+    the plugin after being yielded.
+    """
+
+    path: str
+    nbytes: int
+    chunks: AsyncIterator[BufferType]
+
+
+class StreamRestartRequired(IOError):
+    """A streamed read failed mid-stream but the payload IS retrievable
+    from offset 0 (e.g. a mirrored plugin's primary died after yielding
+    bytes — replica bytes must never be spliced after primary bytes, so
+    the whole entry has to be re-consumed from the start). The scheduler
+    catches this and retries the entry through the buffered read path;
+    consumers guarantee a failed ``consume_stream`` left no partial
+    commit behind, which is what makes the restart safe."""
+
+
 class BufferStager(abc.ABC):
     # Stagers may set ``io_skipped = True`` during stage_buffer to tell the
     # scheduler the staged payload must NOT be written (incremental
@@ -130,6 +166,44 @@ class BufferConsumer(abc.ABC):
         """Peak host memory needed while consuming the buffer."""
         ...
 
+    # Optional streaming protocol — the read-side mirror of the stager's
+    # can_stream/stage_stream. A consumer that can process its payload as
+    # an ordered sequence of sub-chunks (incremental chained CRC,
+    # incremental decompression, per-sub-chunk device_put) overrides
+    # these; the scheduler then fuses the storage read with consumption
+    # for the entry and charges the memory budget
+    # ``stream_admission_cost`` instead of the whole payload.
+
+    def can_stream(self, sub_chunk_bytes: int) -> bool:
+        """True when this consumer can process its payload in
+        ``sub_chunk_bytes`` pieces. Default: buffered consumption only."""
+        return False
+
+    def stream_admission_cost(self, sub_chunk_bytes: int) -> int:
+        """Peak host memory a STREAMED consume of this request holds —
+        what the scheduler charges the budget instead of
+        ``get_consuming_cost_bytes``. The default is the full consuming
+        cost (honest for consumers that assemble the payload in a
+        scratch buffer before committing); consumers that genuinely hold
+        only the in-flight window (per-sub-chunk device_put, direct
+        fills of pre-existing destination memory) override with the
+        window so large single-entry restores stop serializing behind
+        the budget."""
+        return self.get_consuming_cost_bytes()
+
+    async def consume_stream(self, stream: ReadStream, executor=None) -> None:
+        """Consume an ordered sub-chunk stream of this request's payload.
+
+        Only called when ``can_stream`` returned True for the sub-chunk
+        size the stream was produced with. On ANY mid-stream exception
+        the consumer must leave its destination exactly as it found it
+        when a buffered consume would have (verify-before-commit
+        consumers: unmodified; pre-existing-destination fills: no worse
+        than a failure between this entry's buffered sub-reads), so the
+        scheduler may retry the entry buffered after a
+        :class:`StreamRestartRequired`."""
+        raise NotImplementedError
+
 
 @dataclass
 class WriteReq:
@@ -161,6 +235,15 @@ class StoragePlugin(abc.ABC):
     # against the buffered fallback below, a "streamed" entry would
     # occupy its full size while the budget charged a sub-chunk window.
     supports_streaming: bool = False
+
+    # Read-side twin: True only on plugins whose ``read_stream``
+    # produces chunks incrementally as the transport delivers them
+    # (fs: pread windows; s3/gcs: ordered prefetching ranged GETs;
+    # mirror: composes over its primary). The scheduler elects streamed
+    # reads only when this is set — the buffered fallback below fetches
+    # the whole payload before the first chunk, so a "streamed" entry
+    # would hold its full size while the budget charged a window.
+    supports_streaming_reads: bool = False
 
     def stream_admission_cost(self, nbytes: int, sub_chunk_bytes: int) -> int:
         """Peak host memory ONE streamed entry of ``nbytes`` holds while
@@ -212,6 +295,29 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def read(self, read_io: ReadIO) -> None:
         ...
+
+    async def read_stream(
+        self, read_io: ReadIO, sub_chunk_bytes: int
+    ) -> ReadStream:
+        """Produce an ordered sub-chunk stream for one read request.
+
+        Plugins that can overlap transport with consumption override
+        this (fs: positional pread windows with one-chunk read-ahead;
+        s3/gcs: a bounded window of in-flight ranged GETs yielded in
+        order). This default is the BUFFERED fallback — it performs the
+        whole ``read`` up front and slices the result — so every plugin
+        (including out-of-tree ones) keeps working when a caller asks
+        for a stream; such plugins just don't get the intra-entry
+        overlap, and the scheduler never elects streaming for them
+        (``supports_streaming_reads`` is False)."""
+        await self.read(read_io)
+        mv = memoryview(read_io.buf).cast("B")
+
+        async def chunks() -> AsyncIterator[BufferType]:
+            for lo in range(0, mv.nbytes, sub_chunk_bytes):
+                yield mv[lo : lo + sub_chunk_bytes]
+
+        return ReadStream(path=read_io.path, nbytes=mv.nbytes, chunks=chunks())
 
     @abc.abstractmethod
     async def delete(self, path: str) -> None:

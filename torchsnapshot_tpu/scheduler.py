@@ -22,6 +22,20 @@ continues in the background (reference: scheduler.py:297-337).
 Read pipeline:: read -> consume, with the same budget accounting
 (scheduler.py:384-444).
 
+**Streaming reads** (``TORCHSNAPSHOT_TPU_STREAM_READS``, default on):
+entries whose consumer and storage plugin both opt in skip the
+read-everything-then-consume two-step — the plugin yields sub-chunks as
+the transport delivers them (fs: pread windows with read-ahead; s3/gcs:
+a bounded window of in-flight ranged GETs yielded in order) and the
+consumer verifies chained CRC32C incrementally, feeds decompression
+incrementally, and issues per-sub-chunk ``jax.device_put`` — HtoD of
+chunk N overlaps the read of chunk N+1, collapsing a large entry's
+restore wall toward ~max(read, consume). The budget charges streamed
+entries the consumer-declared retention (``stream_admission_cost`` —
+the in-flight window for device-bound and direct-fill consumers), not
+their full consuming cost, so large single-entry restores stop
+serializing behind the budget.
+
 The per-process budget is ``min(0.6 * available_memory / local_world_size,
 32 GiB)``, overridable via ``TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES``
 (scheduler.py:27-65).
@@ -59,7 +73,9 @@ from . import telemetry
 from .io_types import (
     ReadIO,
     ReadReq,
+    ReadStream,
     StoragePlugin,
+    StreamRestartRequired,
     WriteIO,
     WriteReq,
     WriteStream,
@@ -101,6 +117,34 @@ SUB_CHUNK_ENV_VAR = "TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES"
 SUB_CHUNK_MIN_ENV_VAR = "TORCHSNAPSHOT_TPU_SUB_CHUNK_MIN_BYTES"
 SUB_CHUNK_MAX_ENV_VAR = "TORCHSNAPSHOT_TPU_SUB_CHUNK_MAX_BYTES"
 PREVERIFY_ENV_VAR = "TORCHSNAPSHOT_TPU_PREVERIFY"
+STREAM_READS_ENV_VAR = "TORCHSNAPSHOT_TPU_STREAM_READS"
+
+# Measured read bandwidth below which storage counts as latency-bound:
+# streamed reads then pay off even for consumers that retain the whole
+# payload (the overlap hides transport latency). At/above it, local
+# page-cache reads are memcpy-speed and the buffered mmap path's fewer
+# copies win for those consumers. Same 1 GB/s knee io_concurrency uses.
+_STREAM_READ_LATENCY_BPS = 1e9
+
+
+def stream_reads_mode() -> str:
+    """THE parser for ``TORCHSNAPSHOT_TPU_STREAM_READS``: ``never``
+    disables streamed reads, ``always`` streams every eligible entry,
+    and the default ``auto`` streams an entry when doing so buys
+    something — a smaller budget charge than the buffered consume
+    (device-bound, sliced, and coalesced-slab consumers), or measured
+    latency-bound storage where read/consume overlap hides transport
+    latency even at full retention."""
+    raw = os.environ.get(STREAM_READS_ENV_VAR, "auto").strip().lower()
+    if raw in ("0", "false", "off", "no", "never"):
+        return "never"
+    if raw in ("always", "force"):
+        return "always"
+    return "auto"
+
+
+def stream_reads_enabled() -> bool:
+    return stream_reads_mode() != "never"
 
 _DEFAULT_SUB_CHUNK_BYTES = 64 << 20
 _DEFAULT_SUB_CHUNK_MIN_BYTES = 8 << 20
@@ -217,7 +261,10 @@ class IOGovernor:
 
     # ---------------------------------------------------------- tunables
 
-    def sub_chunk_bytes(self, plugin: Optional[str] = None) -> int:
+    def sub_chunk_bytes(self, plugin: Optional[str] = None, op: str = "write") -> int:
+        """Streaming sub-chunk size for ``op`` ("write"/"read") — sized
+        from the MATCHING measured bandwidth (a fast local save must not
+        size a later network restore's read windows, and vice versa)."""
         pinned = os.environ.get(SUB_CHUNK_ENV_VAR, "").strip()
         if pinned:
             try:
@@ -231,7 +278,7 @@ class IOGovernor:
         lo = _env_int(SUB_CHUNK_MIN_ENV_VAR, _DEFAULT_SUB_CHUNK_MIN_BYTES)
         hi = _env_int(SUB_CHUNK_MAX_ENV_VAR, _DEFAULT_SUB_CHUNK_MAX_BYTES)
         hi = max(lo, hi)
-        bps = self.write_bps(plugin)
+        bps = self.read_bps(plugin) if op == "read" else self.write_bps(plugin)
         if bps is None:
             return min(max(_DEFAULT_SUB_CHUNK_BYTES, lo), hi)
         target = int(bps * _SUB_CHUNK_TARGET_SECONDS)
@@ -900,15 +947,124 @@ def sync_execute_write_reqs(
 
 
 class _ReadPipeline:
-    def __init__(self, read_req: ReadReq) -> None:
+    def __init__(
+        self,
+        read_req: ReadReq,
+        sub_chunk_bytes: Optional[int] = None,
+        stream_all: bool = False,
+    ) -> None:
         self.read_req = read_req
         self.consuming_cost_bytes: int = (
             read_req.buffer_consumer.get_consuming_cost_bytes()
         )
+        # Streaming election happens at construction, mirroring the write
+        # side: the consumer opts in for THIS sub-chunk size, and the
+        # budget then charges the consumer-declared streamed retention
+        # (the in-flight window for per-sub-chunk device_put and direct
+        # destination fills; the full payload for verify-before-commit
+        # scratch assembly) instead of the whole consuming cost.
+        #
+        # Under the default auto policy, full-retention consumers only
+        # stream when ``stream_all`` says the storage is latency-bound
+        # (or the operator forced it): on memcpy-speed local storage the
+        # buffered mmap path's fewer copies beat the pipeline, and
+        # streaming there would be a regression, not an optimization.
+        self.sub_chunk_bytes = sub_chunk_bytes
+        self.streamed = False
+        br = read_req.byte_range
+        empty = br is not None and br[1] <= br[0]
+        if (
+            sub_chunk_bytes is not None
+            and not empty
+            and read_req.buffer_consumer.can_stream(sub_chunk_bytes)
+        ):
+            window = min(
+                self.consuming_cost_bytes,
+                read_req.buffer_consumer.stream_admission_cost(sub_chunk_bytes),
+            )
+            if stream_all or window < self.consuming_cost_bytes:
+                self.admission_cost_bytes: int = window
+                self.streamed = True
+        if not self.streamed:
+            self.admission_cost_bytes = self.consuming_cost_bytes
+
+    async def _stream_read_and_consume(
+        self, storage: StoragePlugin, executor, throughput: _Throughput
+    ) -> bool:
+        """Fused read+consume: the plugin yields sub-chunks as the
+        transport delivers them and the consumer verifies/decodes each
+        while the next is still in flight — the entry's restore wall
+        becomes ~max(read, consume) instead of read + consume. Returns
+        False when the stream demands a from-offset-0 restart
+        (StreamRestartRequired); the caller then re-runs the entry
+        through the buffered path."""
+        read_io = ReadIO(
+            path=self.read_req.path, byte_range=self.read_req.byte_range
+        )
+        consumer = self.read_req.buffer_consumer
+
+        async def counted(chunks):
+            async for chunk in chunks:
+                n = memoryview(chunk).nbytes
+                throughput.add(n)
+                telemetry.counter_add("bytes_read", n)
+                yield chunk
+
+        try:
+            with telemetry.span(
+                "stream_read",
+                path=self.read_req.path,
+                sub_chunk_bytes=self.sub_chunk_bytes,
+            ) as sp:
+                stream = await storage.read_stream(read_io, self.sub_chunk_bytes)
+                sp.set(bytes=stream.nbytes)
+                try:
+                    await consumer.consume_stream(
+                        ReadStream(
+                            path=stream.path,
+                            nbytes=stream.nbytes,
+                            chunks=counted(stream.chunks),
+                        ),
+                        executor,
+                    )
+                finally:
+                    aclose = getattr(stream.chunks, "aclose", None)
+                    if aclose is not None:
+                        await aclose()
+        except StreamRestartRequired as e:
+            logger.warning(
+                "streamed read of %s restarting through the buffered "
+                "path: %s",
+                self.read_req.path,
+                e,
+            )
+            telemetry.counter_add("stream_read_restarts", 1)
+            return False
+        telemetry.counter_add("entries_read", 1)
+        telemetry.counter_add("entries_stream_read", 1)
+        return True
 
     async def read_and_consume(
-        self, storage: StoragePlugin, executor, throughput: _Throughput
+        self,
+        storage: StoragePlugin,
+        executor,
+        throughput: _Throughput,
+        budget: Optional["_MemoryBudget"] = None,
     ) -> "_ReadPipeline":
+        if self.streamed and await self._stream_read_and_consume(
+            storage, executor, throughput
+        ):
+            return self
+        if self.streamed and budget is not None:
+            # The buffered retry holds the FULL payload while the budget
+            # only charged the streamed window: charge the difference
+            # (possibly driving availability negative, like the
+            # starvation escape) so concurrent dispatch throttles
+            # instead of overshooting the per-rank budget unaccounted.
+            delta = self.consuming_cost_bytes - self.admission_cost_bytes
+            if delta > 0:
+                budget.acquire(delta)
+                self.admission_cost_bytes = self.consuming_cost_bytes
         read_io = ReadIO(
             path=self.read_req.path, byte_range=self.read_req.byte_range
         )
@@ -944,23 +1100,56 @@ async def execute_read_reqs(
     reporter = _ProgressReporter("read", rank, len(read_reqs), budget)
     reporter.start()
 
-    pending = [_ReadPipeline(req) for req in read_reqs]
-    pending.sort(key=lambda p: p.consuming_cost_bytes, reverse=True)
-    inflight: Set[asyncio.Task] = set()
-    io_concurrency = io_governor().io_concurrency(
-        "read", type(storage).__name__
+    governor = io_governor()
+    plugin_key = type(storage).__name__
+    # Streamed-read election mirrors the write side: only plugins that
+    # produce chunks incrementally are eligible (the buffered read_stream
+    # fallback would hold a full entry while the budget charged a
+    # window), and each consumer still opts in per entry via can_stream.
+    # Sub-chunk size comes from the measured READ bandwidth.
+    mode = stream_reads_mode()
+    sub_chunk = (
+        governor.sub_chunk_bytes(plugin_key, op="read")
+        if mode != "never"
+        and getattr(storage, "supports_streaming_reads", False)
+        else None
     )
+    # Full-retention consumers stream too when the storage is measurably
+    # latency-bound — there, overlap hides transport latency regardless
+    # of the budget charge. No measurement means no evidence: buffered.
+    read_bps = governor.read_bps(plugin_key)
+    stream_all = mode == "always" or (
+        read_bps is not None and read_bps < _STREAM_READ_LATENCY_BPS
+    )
+    pending = [
+        _ReadPipeline(req, sub_chunk_bytes=sub_chunk, stream_all=stream_all)
+        for req in read_reqs
+    ]
+    pending.sort(key=lambda p: p.consuming_cost_bytes, reverse=True)
+    n_streamed = sum(1 for p in pending if p.streamed)
+    if n_streamed:
+        logger.debug(
+            "[rank %d] streaming %d/%d read(s) in %d MB sub-chunks",
+            rank,
+            n_streamed,
+            len(pending),
+            (sub_chunk or 0) >> 20,
+        )
+    inflight: Set[asyncio.Task] = set()
+    io_concurrency = governor.io_concurrency("read", plugin_key)
 
     def dispatch() -> None:
         while pending and len(inflight) < io_concurrency:
-            cost = pending[0].consuming_cost_bytes
+            cost = pending[0].admission_cost_bytes
             if cost > budget.available and inflight:
                 break
             pipeline = pending.pop(0)
-            budget.acquire(pipeline.consuming_cost_bytes)
+            budget.acquire(pipeline.admission_cost_bytes)
             inflight.add(
                 event_loop.create_task(
-                    pipeline.read_and_consume(storage, executor, throughput)
+                    pipeline.read_and_consume(
+                        storage, executor, throughput, budget
+                    )
                 )
             )
             reporter.inflight_io += 1
@@ -974,7 +1163,7 @@ async def execute_read_reqs(
             inflight = inflight_set
             for task in done:
                 pipeline = task.result()
-                budget.release(pipeline.consuming_cost_bytes)
+                budget.release(pipeline.admission_cost_bytes)
                 reporter.inflight_io -= 1
                 reporter.completed_count += 1
                 reporter.completed_bytes += pipeline.consuming_cost_bytes

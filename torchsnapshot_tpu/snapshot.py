@@ -1184,6 +1184,14 @@ class Snapshot:
         the loss of a base's primary tier. Batching (read coalescing)
         runs per group — merging ranges across different origins would
         read from the wrong storage.
+
+        Coalescing composes with the streaming read path: adjacent
+        byte-ranged reads into the same batched-slab location merge into
+        ONE spanning request whose consumer slices a single sequential
+        sub-chunk stream to the per-entry consumers
+        (BatchedBufferConsumer.consume_stream), so the many-small-
+        ranged-GET restore pattern becomes a few large sequential reads
+        without ever materializing the spanning payload.
         """
         groups: Dict[Optional[str], List[ReadReq]] = {}
         for rr in read_reqs:

@@ -31,7 +31,7 @@ except ImportError:
     # local-FS plugin must never be the backend that import-fails.
     from .. import _aio as aiofiles
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO, WriteStream
+from ..io_types import ReadIO, ReadStream, StoragePlugin, WriteIO, WriteStream
 
 FSYNC_ENV_VAR = "TORCHSNAPSHOT_TPU_FSYNC"
 MMAP_ENV_VAR = "TORCHSNAPSHOT_TPU_MMAP_READS"
@@ -63,6 +63,7 @@ def _fsync_path(path: str) -> None:
 
 class FSStoragePlugin(StoragePlugin):
     supports_streaming = True
+    supports_streaming_reads = True
 
     def __init__(self, root: str, storage_options=None) -> None:
         self.root = root
@@ -187,6 +188,12 @@ class FSStoragePlugin(StoragePlugin):
         else:
             lo, hi = read_io.byte_range
             size = hi - lo
+            if size <= 0:
+                # Zero-length range: nothing to fetch — short-circuit before
+                # touching the file so direct plugin users match the cloud
+                # plugins' empty-range behavior.
+                read_io.buf = bytearray()
+                return
         if _mmap_enabled() and size >= _MMAP_MIN_BYTES:
             # Large payloads: MAP_PRIVATE the file instead of copying it
             # out of the page cache. Restores skip a full memcpy pass AND
@@ -218,6 +225,87 @@ class FSStoragePlugin(StoragePlugin):
                     )
                 got += n
             read_io.buf = buf
+
+    @staticmethod
+    def _pread_exact(fd: int, lo: int, hi: int):
+        """Positional read of exactly [lo, hi) into a writable buffer
+        (blocking; runs in an executor thread). pread never moves a
+        shared file offset, so concurrent window reads of one fd need no
+        seek bookkeeping. Windows come from the staging pool: a fresh
+        allocation per sub-chunk would pay first-touch page faults on
+        every window (several x the copy itself on lazily-backed VMs),
+        while pooled slabs recycle as soon as the consumer drops the
+        yielded chunk — whoever retains a view pins the slab until it
+        dies, so reuse can never alias a live chunk."""
+        # Imported here, not at module load: io_preparers.array imports
+        # jax-adjacent machinery this plugin must not require at import.
+        from ..io_preparers.array import _staging_pool
+
+        size = hi - lo
+        buf = _staging_pool.get(size)
+        view = memoryview(buf)
+        got = 0
+        while got < size:
+            n = os.preadv(fd, [view[got:]], lo + got)
+            if n == 0:
+                raise EOFError(
+                    f"short read: fd {fd} yielded {got} of {size} bytes "
+                    f"(offset {lo})"
+                )
+            got += n
+        return view
+
+    async def read_stream(self, read_io: ReadIO, sub_chunk_bytes: int) -> ReadStream:
+        """Streaming variant of ``read``: sub-chunk pread windows with a
+        one-window read-ahead — window N+1's pread is dispatched to the
+        executor BEFORE window N is yielded, so while the consumer
+        hashes/decompresses/device_puts window N the kernel is already
+        filling N+1. Each window is a fresh writable buffer (the mmap
+        fast path of ``read`` maps the whole payload at once, which is
+        exactly what a windowed stream must not do)."""
+        path = os.path.join(self.root, read_io.path)
+        if read_io.byte_range is None:
+            lo, size = 0, os.stat(path).st_size
+        else:
+            lo, hi = read_io.byte_range
+            size = max(0, hi - lo)
+
+        async def chunks():
+            if size <= 0:
+                return
+            loop = asyncio.get_running_loop()
+            spans = [
+                (o, min(o + sub_chunk_bytes, lo + size))
+                for o in range(lo, lo + size, sub_chunk_bytes)
+            ]
+            fd = os.open(path, os.O_RDONLY)
+            pending = None
+            try:
+                pending = loop.run_in_executor(
+                    None, self._pread_exact, fd, *spans[0]
+                )
+                for nxt in spans[1:]:
+                    chunk = await pending
+                    # Read-ahead: N+1 fills while the consumer works on N.
+                    pending = loop.run_in_executor(
+                        None, self._pread_exact, fd, *nxt
+                    )
+                    yield chunk
+                chunk = await pending
+                pending = None
+                yield chunk
+            finally:
+                if pending is not None:
+                    # An abandoned read-ahead still holds the fd: let it
+                    # land before closing (awaiting in finally is legal
+                    # during aclose; yielding would not be).
+                    try:
+                        await pending
+                    except Exception:
+                        pass
+                os.close(fd)
+
+        return ReadStream(path=read_io.path, nbytes=size, chunks=chunks())
 
     async def delete(self, path: str) -> None:
         await aiofiles.os.remove(os.path.join(self.root, path))

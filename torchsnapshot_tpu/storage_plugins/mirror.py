@@ -46,11 +46,23 @@ import asyncio
 import logging
 from typing import Any, Dict, List, Optional, Set
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import (
+    ReadIO,
+    ReadStream,
+    StoragePlugin,
+    StreamRestartRequired,
+    WriteIO,
+)
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_MIRROR_BACKLOG_BYTES = 512 * 1024 * 1024
+
+# Primary-tier read failures the mirror fallback covers: missing files,
+# transport/OS errors, AND truncation (the fs plugin signals a torn or
+# short primary object with EOFError, which is not an OSError — exactly
+# the data-loss case the durable tier exists for).
+_PRIMARY_READ_FAILURES = (FileNotFoundError, OSError, EOFError)
 
 
 class MirroredStoragePlugin(StoragePlugin):
@@ -119,7 +131,7 @@ class MirroredStoragePlugin(StoragePlugin):
     async def read(self, read_io: ReadIO) -> None:
         try:
             await self.primary.read(read_io)
-        except (FileNotFoundError, OSError) as primary_exc:
+        except _PRIMARY_READ_FAILURES as primary_exc:
             try:
                 await self.mirror.read(read_io)
             except BaseException:
@@ -127,6 +139,74 @@ class MirroredStoragePlugin(StoragePlugin):
             logger.info(
                 "read %s from the mirror (primary copy missing)", read_io.path
             )
+
+    @property
+    def supports_streaming_reads(self) -> bool:
+        # Streamed restores read the primary tier; the mirror only backs
+        # a failover, so the election follows the primary's capability.
+        return getattr(self.primary, "supports_streaming_reads", False)
+
+    async def read_stream(self, read_io: ReadIO, sub_chunk_bytes: int) -> ReadStream:
+        """Streaming read with RESTART-SAFE failover.
+
+        Mirror bytes are never spliced after primary bytes: replica
+        content is equal by design, but a primary that failed mid-stream
+        may have served bytes from a torn/corrupt object whose prefix
+        no checksum has validated yet — a spliced stream would silently
+        commit that prefix. So:
+
+        - primary unreadable up front, or dead before yielding ANY
+          chunk: fail over transparently — the consumer has seen
+          nothing, the mirror stream starts from offset 0;
+        - primary dead AFTER yielding bytes: raise
+          :class:`StreamRestartRequired` — the scheduler re-consumes the
+          whole entry through the buffered ``read`` path (which performs
+          its own primary-then-mirror failover), restarting the consumer
+          from offset 0.
+        """
+        try:
+            primary_stream = await self.primary.read_stream(
+                read_io, sub_chunk_bytes
+            )
+        except _PRIMARY_READ_FAILURES:
+            fallback = await self.mirror.read_stream(read_io, sub_chunk_bytes)
+            logger.info(
+                "streaming %s from the mirror (primary copy missing)",
+                read_io.path,
+            )
+            return fallback
+
+        async def chunks():
+            produced = 0
+            try:
+                async for chunk in primary_stream.chunks:
+                    yield chunk
+                    produced += memoryview(chunk).nbytes
+            except _PRIMARY_READ_FAILURES as primary_exc:
+                if produced:
+                    raise StreamRestartRequired(
+                        f"primary failed after streaming {produced} bytes of "
+                        f"{read_io.path!r}; re-read the entry from offset 0 "
+                        f"(mirror bytes are never spliced after primary "
+                        f"bytes)"
+                    ) from primary_exc
+                try:
+                    fallback = await self.mirror.read_stream(
+                        ReadIO(path=read_io.path, byte_range=read_io.byte_range),
+                        sub_chunk_bytes,
+                    )
+                except BaseException:
+                    raise primary_exc
+                logger.info(
+                    "streaming %s from the mirror (primary copy missing)",
+                    read_io.path,
+                )
+                async for chunk in fallback.chunks:
+                    yield chunk
+
+        return ReadStream(
+            path=read_io.path, nbytes=primary_stream.nbytes, chunks=chunks()
+        )
 
     async def delete(self, path: str) -> None:
         await self.primary.delete(path)

@@ -24,6 +24,23 @@ MAX_BACKOFF_S = 8.0
 STALL_TIMEOUT_S = 120.0
 
 
+def named(fn: Callable[[], Any], op: str) -> Callable[[], Any]:
+    """Label a transfer closure for retry telemetry: the plugins'
+    ``_retrying`` wrappers read ``__name__`` as the op tag on
+    ``storage_retry`` events, and lambdas built per ranged chunk would
+    otherwise all report as ``<lambda>``."""
+    try:
+        fn.__name__ = op
+        return fn
+    except AttributeError:
+        # Bound methods reject attribute writes — wrap instead.
+        def call() -> Any:
+            return fn()
+
+        call.__name__ = op
+        return call
+
+
 def is_transient_error(exc: BaseException) -> bool:
     """Classify transport errors worth retrying: 429/5xx-style service
     hiccups, connection and timeout failures. Everything else (permission
@@ -289,6 +306,44 @@ class CollectiveRetryStrategy:
         # The slept backoff, so callers can accumulate this coroutine's
         # total and pass it back in via ``backoff_slept_s``.
         return backoff
+
+
+async def ordered_window_chunks(path, spans, fetch, concurrency):
+    """Drive ranged fetches through a bounded in-flight window, yielding
+    chunks in offset order — the shared engine of the s3/gcs
+    ``read_stream`` implementations. ``fetch(lo, hi)`` returns an
+    awaitable future for the bytes of [lo, hi); the window is refilled
+    BEFORE each yield so later ranges are on the wire while the consumer
+    works, short responses raise (a short ranged response means the
+    object changed or was truncated mid-read), and any failure cancels
+    the in-flight siblings instead of leaving them running unawaited."""
+    tasks = {}
+    next_to_fire = 0
+
+    def fire() -> None:
+        nonlocal next_to_fire
+        while next_to_fire < len(spans) and len(tasks) < concurrency:
+            tasks[next_to_fire] = fetch(*spans[next_to_fire])
+            next_to_fire += 1
+
+    fire()
+    try:
+        for idx in range(len(spans)):
+            chunk = await tasks.pop(idx)
+            fire()  # keep the window full before the consumer works
+            lo, hi = spans[idx]
+            if len(chunk) != hi - lo:
+                raise IOError(
+                    f"short read on {path}: got {len(chunk)} bytes for "
+                    f"range [{lo}, {hi})"
+                )
+            yield chunk
+    except BaseException:
+        for t in tasks.values():
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks.values(), return_exceptions=True)
+        raise
 
 
 # ---------------------------------------------------------------- executor

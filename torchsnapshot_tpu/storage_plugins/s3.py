@@ -26,8 +26,14 @@ import contextlib
 from typing import Any, Callable, Dict, Optional
 
 from .. import telemetry
-from ..io_types import ReadIO, StoragePlugin, WriteIO, WriteStream
-from .retry import CollectiveRetryStrategy, cloud_io_executor, is_transient_error
+from ..io_types import ReadIO, ReadStream, StoragePlugin, WriteIO, WriteStream
+from .retry import (
+    CollectiveRetryStrategy,
+    cloud_io_executor,
+    is_transient_error,
+    named,
+    ordered_window_chunks,
+)
 
 # S3 hard limit for single-request PUTs is 5 GiB (and 5 TiB per object via
 # multipart). Array payloads are chunk/shard-split well below this upstream,
@@ -44,6 +50,7 @@ _RANGED_READ_CONCURRENCY = 4
 
 class S3StoragePlugin(StoragePlugin):
     supports_streaming = True
+    supports_streaming_reads = True
 
     def __init__(self, root: str, storage_options: Optional[Dict[str, Any]] = None):
         options = storage_options or {}
@@ -318,6 +325,12 @@ class S3StoragePlugin(StoragePlugin):
         key = self._key(read_io.path)
         if read_io.byte_range is not None:
             lo, hi = read_io.byte_range
+            if hi <= lo:
+                # Empty/inverted range: S3 rejects such Range headers with
+                # InvalidRange — short-circuit so direct plugin users don't
+                # depend on the scheduler's guard.
+                read_io.buf = bytearray()
+                return
             if hi - lo > RANGED_READ_CHUNK_BYTES:
                 # Split a large ranged GET into concurrent chunk GETs (the
                 # GCS plugin's pattern): a single-large-entry restore is
@@ -345,6 +358,49 @@ class S3StoragePlugin(StoragePlugin):
                     f"for range [{lo}, {hi})"
                 )
         read_io.buf = buf  # uncopied bytes
+
+    async def read_stream(self, read_io: ReadIO, sub_chunk_bytes: int) -> ReadStream:
+        """Streaming read: the existing concurrent-ranged-GET pattern,
+        reshaped into an ORDERED stream — a bounded window of
+        ``_RANGED_READ_CONCURRENCY`` chunk GETs is kept in flight and
+        chunks are yielded in offset order, so the consumer hashes/
+        decompresses chunk N while chunks N+1.. are still on the wire.
+        Full-object streams learn the size from one HEAD request (the
+        stream contract requires ``nbytes`` up front)."""
+        key = self._key(read_io.path)
+        if read_io.byte_range is None:
+            head = await self._retrying(
+                named(
+                    lambda: self.client.head_object(Bucket=self.bucket, Key=key),
+                    "head",
+                )
+            )
+            lo, hi = 0, int(head["ContentLength"])
+        else:
+            lo, hi = read_io.byte_range
+        size = max(0, hi - lo)
+
+        def fetch(p: int, q: int) -> "asyncio.Future":
+            def get() -> bytes:
+                return self.client.get_object(
+                    Bucket=self.bucket, Key=key, Range=f"bytes={p}-{q - 1}"
+                )["Body"].read()
+
+            return asyncio.ensure_future(self._retrying(named(get, "get_range")))
+
+        async def chunks():
+            if size <= 0:
+                return
+            spans = [
+                (o, min(o + sub_chunk_bytes, hi))
+                for o in range(lo, hi, sub_chunk_bytes)
+            ]
+            async for chunk in ordered_window_chunks(
+                read_io.path, spans, fetch, _RANGED_READ_CONCURRENCY
+            ):
+                yield chunk
+
+        return ReadStream(path=read_io.path, nbytes=size, chunks=chunks())
 
     async def _chunked_ranged_read(
         self, read_io: ReadIO, key: str, lo: int, hi: int
