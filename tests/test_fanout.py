@@ -1,0 +1,511 @@
+"""Cooperative restore fan-out: unit coverage (single process).
+
+Four seams, mirrored from the design (fanout.py):
+
+- **Partitioner extraction**: ``greedy_size_balanced`` must be
+  bit-identical to the historical inline loop in
+  ``_partition_write_units`` for the same input — the save side's
+  striping is a compatibility contract (existing snapshots' chunk
+  ownership), so the extraction may not move a single byte.
+- **Unit keys**: only rank-identical locations (``replicated/``,
+  ``sharded/``) form cooperative units; per-rank, slab, and zero-length
+  requests never do; the origin (incremental chains) is part of the key.
+- **Peer transport + session**: frames round-trip, owner→receiver
+  forwarding delivers bit-exact payloads to the scheduler's consumers
+  (two real sessions over loopback in one process), restarts discard
+  pre-restart bytes wholesale, aborts/timeouts degrade the entry to a
+  direct storage read.
+- **The device-free lint**: scripts/check_peer_channel.py is clean on
+  the real tree and actually catches a planted jax call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from torchsnapshot_tpu.fanout import (
+    CoopKeyPlan,
+    CoopRestoreSession,
+    PeerTransferError,
+    coop_restore_mode,
+    greedy_size_balanced,
+    unit_key,
+)
+from torchsnapshot_tpu.dist_store import (
+    PeerListener,
+    peer_connect,
+    recv_peer_frame,
+    send_peer_frame,
+)
+from torchsnapshot_tpu.io_types import ReadReq, WriteIO
+from torchsnapshot_tpu.manifest import ArrayEntry
+from torchsnapshot_tpu.scheduler import execute_read_reqs
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+SUB = 64 << 10
+
+
+# ------------------------------------------------------------- partitioner
+
+
+def _historical_partition(pool_sizes, world_size):
+    """The pre-extraction inline loop from _partition_write_units,
+    verbatim — the compatibility oracle."""
+    loads = [0] * world_size
+    owners = []
+    for nbytes in pool_sizes:
+        target = min(range(world_size), key=lambda r: (loads[r], r))
+        loads[target] += nbytes
+        owners.append(target)
+    return owners
+
+
+@pytest.mark.parametrize("world_size", [1, 2, 3, 7])
+def test_greedy_partition_bit_identical_to_save_side(world_size) -> None:
+    rng = np.random.default_rng(world_size)
+    for trial in range(20):
+        n = int(rng.integers(0, 40))
+        sizes = sorted(
+            (int(s) for s in rng.integers(1, 1 << 20, size=n)), reverse=True
+        )
+        assert greedy_size_balanced(sizes, world_size) == _historical_partition(
+            sizes, world_size
+        )
+
+
+def test_greedy_partition_respects_candidates() -> None:
+    sizes = [100, 90, 80, 70]
+    candidates = [[1, 2], [0], [2], [1, 2]]
+    owners = greedy_size_balanced(sizes, 3, candidates)
+    for owner, allowed in zip(owners, candidates):
+        assert owner in allowed
+    # Within the allowed sets, loads balance greedily and ties go low:
+    # unit 3 (70) goes to rank 2 (load 80) over rank 1 (load 100).
+    assert owners == [1, 0, 2, 2]
+
+
+def test_greedy_partition_balances() -> None:
+    sizes = sorted([5, 5, 5, 5, 20], reverse=True)
+    owners = greedy_size_balanced(sizes, 2)
+    loads = [0, 0]
+    for s, o in zip(sizes, owners):
+        loads[o] += s
+    assert abs(loads[0] - loads[1]) <= 10
+
+
+# --------------------------------------------------------------- unit keys
+
+
+def _req(path, byte_range=None, origin=None, nbytes=1024):
+    entry = ArrayEntry(
+        location=path,
+        serializer="buffer_protocol",
+        dtype="uint8",
+        shape=[nbytes],
+        replicated=True,
+    )
+    from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+
+    return ReadReq(
+        path=path,
+        buffer_consumer=ArrayBufferConsumer(entry, callback=lambda a: None),
+        byte_range=byte_range,
+        origin=origin,
+    )
+
+
+def test_unit_key_scopes_to_shared_locations() -> None:
+    assert unit_key(_req("replicated/model/w")) is not None
+    assert unit_key(_req("sharded/model/w", byte_range=(0, 10))) is not None
+    assert unit_key(_req("0/model/w")) is None  # per-rank
+    assert unit_key(_req("batched/abc123")) is None  # slab
+    assert unit_key(_req("replicated/x", byte_range=(5, 5))) is None  # empty
+    # The origin (incremental chains) distinguishes otherwise-equal keys.
+    a = unit_key(_req("replicated/x"))
+    b = unit_key(_req("replicated/x", origin="/base/snap"))
+    assert a != b
+    # Byte ranges distinguish too (post-reshard overlap reads).
+    c = unit_key(_req("sharded/x", byte_range=(0, 10)))
+    d = unit_key(_req("sharded/x", byte_range=(10, 20)))
+    assert c != d
+
+
+def test_coop_mode_parser(monkeypatch) -> None:
+    for raw, want in [
+        ("never", "never"),
+        ("0", "never"),
+        ("always", "always"),
+        ("1", "always"),
+        ("auto", "auto"),
+        ("", "auto"),
+        ("bogus", "auto"),
+    ]:
+        monkeypatch.setenv("TORCHSNAPSHOT_TPU_COOP_RESTORE", raw)
+        assert coop_restore_mode() == want
+
+
+def test_governor_coop_gate() -> None:
+    from torchsnapshot_tpu.scheduler import IOGovernor
+
+    gov = IOGovernor()
+    # No evidence: direct reads stay.
+    assert not gov.should_coop_restore("FSStoragePlugin")
+    gov.record_read("FSStoragePlugin", 1 << 30, 0.1)  # ~10 GB/s: memcpy-speed
+    assert not gov.should_coop_restore("FSStoragePlugin")
+    gov2 = IOGovernor()
+    gov2.record_read("S3StoragePlugin", 1 << 26, 1.0)  # ~64 MB/s: throttled
+    assert gov2.should_coop_restore("S3StoragePlugin")
+
+
+# ---------------------------------------------------------- raw transport
+
+
+def test_peer_frame_roundtrip() -> None:
+    got = []
+    done = asyncio.Event() if False else None  # noqa: F841
+
+    import threading
+
+    received = threading.Event()
+
+    def handler(conn):
+        try:
+            while True:
+                header, payload = recv_peer_frame(conn)
+                got.append((header, bytes(payload) if payload is not None else None))
+                if header.get("op") == "bye":
+                    received.set()
+                    return
+        except (ConnectionError, OSError, EOFError):
+            received.set()
+
+    listener = PeerListener()
+    listener.start(handler)
+    try:
+        sock = peer_connect(f"127.0.0.1:{listener.port}")
+        payload = os.urandom(257_123)
+        send_peer_frame(sock, {"op": "hello", "rank": 3})
+        send_peer_frame(
+            sock, {"op": "chunk", "key": "k", "gen": 1, "seq": 0}, payload
+        )
+        send_peer_frame(sock, {"op": "bye"})
+        assert received.wait(10.0)
+        sock.close()
+    finally:
+        listener.close()
+    assert got[0] == ({"op": "hello", "rank": 3}, None)
+    assert got[1][0]["op"] == "chunk" and got[1][1] == payload
+    assert got[2][0]["op"] == "bye"
+
+
+# ------------------------------------------------- session pair, one process
+
+
+def _session_pair(loop0, loop1):
+    l0, l1 = PeerListener(), PeerListener()
+    addrs = [f"127.0.0.1:{l0.port}", f"127.0.0.1:{l1.port}"]
+    s0 = CoopRestoreSession(0, addrs, l0, loop0)
+    s1 = CoopRestoreSession(1, addrs, l1, loop1)
+    s0._connect_peers()
+    s1._connect_peers()
+    return s0, s1
+
+
+def _entry_for(arr, location):
+    from torchsnapshot_tpu.integrity import compute_checksum
+    from torchsnapshot_tpu.serialization import dtype_to_string
+
+    entry = ArrayEntry(
+        location=location,
+        serializer="buffer_protocol",
+        dtype=dtype_to_string(arr.dtype),
+        shape=list(arr.shape),
+        replicated=True,
+    )
+    entry.checksum = compute_checksum(arr.tobytes())
+    return entry
+
+
+def _write(loop, plugin, path, payload) -> None:
+    loop.run_until_complete(plugin.write(WriteIO(path=path, buf=payload)))
+
+
+@pytest.fixture
+def loops():
+    loop0, loop1 = asyncio.new_event_loop(), asyncio.new_event_loop()
+    yield loop0, loop1
+    loop0.close()
+    loop1.close()
+
+
+def test_owner_forwards_receiver_consumes_bit_exact(tmp_path, loops, monkeypatch):
+    """The core data path: the owner reads from storage (streamed, small
+    sub-chunks) and forwards; the receiver's storage directory is EMPTY,
+    so its bit-exact result proves every byte came over the peer
+    channel — and its own chained CRC verified them."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(SUB))
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_STREAM_READS", "always")
+    loop0, loop1 = loops
+    arr = np.frombuffer(os.urandom(400_000), np.uint8).copy()
+    owner_fs = FSStoragePlugin(str(tmp_path / "full"))
+    empty_fs = FSStoragePlugin(str(tmp_path / "empty"))
+    _write(loop0, owner_fs, "replicated/x", arr.tobytes())
+
+    s0, s1 = _session_pair(loop0, loop1)
+    try:
+        from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+
+        entry = _entry_for(arr, "replicated/x")
+        out0, out1 = [], []
+        req0 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out0.append),
+        )
+        req1 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out1.append),
+        )
+        key = unit_key(req0)
+        plan0 = CoopKeyPlan(s0, {key: [1]}, {})
+        plan1 = CoopKeyPlan(s1, {}, {key: 0})
+
+        # Owner executes first: frames buffer in the receiver's staged
+        # inboxes (unbounded, routed on handler threads) until its loop
+        # consumes them — the cross-rank skew the design absorbs.
+        loop0.run_until_complete(
+            execute_read_reqs([req0], owner_fs, 1 << 30, 0, coop=plan0)
+        )
+        loop1.run_until_complete(
+            execute_read_reqs([req1], empty_fs, 1 << 30, 1, coop=plan1)
+        )
+        assert out0 and out0[0].tobytes() == arr.tobytes()
+        assert out1 and out1[0].tobytes() == arr.tobytes()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_owner_restart_never_splices_on_peer_path(tmp_path, loops, monkeypatch):
+    """Mirror-failover under cooperation: the owner's primary dies after
+    one streamed chunk, the entry restarts buffered off the replica, and
+    the RECEIVER commits only post-restart (generation-2) bytes — the
+    never-splice invariant extended over the peer channel."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(SUB))
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_STREAM_READS", "always")
+    from torchsnapshot_tpu.io_types import ReadStream
+    from torchsnapshot_tpu.storage_plugins.mirror import MirroredStoragePlugin
+
+    loop0, loop1 = loops
+    arr = np.frombuffer(os.urandom(400_000), np.uint8).copy()
+
+    class FlakyPrimary(FSStoragePlugin):
+        async def read_stream(self, read_io, sub_chunk_bytes):
+            inner = await super().read_stream(read_io, sub_chunk_bytes)
+
+            async def chunks():
+                it = inner.chunks
+                yield await it.__anext__()
+                await it.aclose()
+                raise OSError("injected primary mid-stream death")
+
+            return ReadStream(
+                path=inner.path, nbytes=inner.nbytes, chunks=chunks()
+            )
+
+        async def read(self, read_io):
+            raise OSError("injected primary read death")
+
+    for d in ("p", "m"):
+        _write(
+            loop0, FSStoragePlugin(str(tmp_path / d)), "replicated/x", arr.tobytes()
+        )
+    owner_storage = MirroredStoragePlugin(
+        FlakyPrimary(str(tmp_path / "p")),
+        FSStoragePlugin(str(tmp_path / "m")),
+        ".snapshot_metadata",
+    )
+    empty_fs = FSStoragePlugin(str(tmp_path / "empty"))
+
+    s0, s1 = _session_pair(loop0, loop1)
+    try:
+        from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+
+        entry = _entry_for(arr, "replicated/x")
+        out0, out1 = [], []
+        req0 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out0.append),
+        )
+        req1 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out1.append),
+        )
+        key = unit_key(req0)
+        plan0 = CoopKeyPlan(s0, {key: [1]}, {})
+        plan1 = CoopKeyPlan(s1, {}, {key: 0})
+        loop0.run_until_complete(
+            execute_read_reqs([req0], owner_storage, 1 << 30, 0, coop=plan0)
+        )
+        loop1.run_until_complete(
+            execute_read_reqs([req1], empty_fs, 1 << 30, 1, coop=plan1)
+        )
+        assert out0 and out0[0].tobytes() == arr.tobytes()
+        assert out1 and out1[0].tobytes() == arr.tobytes()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_owner_abort_degrades_receiver_to_direct_read(tmp_path, loops, monkeypatch):
+    """abort_incomplete (the owner never read the unit) must push the
+    receiver onto a direct storage read promptly — not the timeout."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(SUB))
+    loop0, loop1 = loops
+    arr = np.frombuffer(os.urandom(200_000), np.uint8).copy()
+    fs = FSStoragePlugin(str(tmp_path / "real"))
+    _write(loop1, fs, "replicated/x", arr.tobytes())
+
+    s0, s1 = _session_pair(loop0, loop1)
+    try:
+        from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+
+        entry = _entry_for(arr, "replicated/x")
+        out1 = []
+        req1 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out1.append),
+        )
+        key = unit_key(req1)
+        plan0 = CoopKeyPlan(s0, {key: [1]}, {})
+        plan1 = CoopKeyPlan(s1, {}, {key: 0})
+        plan0.abort_incomplete()  # the owner gives up before reading
+        loop1.run_until_complete(
+            execute_read_reqs([req1], fs, 1 << 30, 1, coop=plan1)
+        )
+        assert out1 and out1[0].tobytes() == arr.tobytes()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_receiver_timeout_degrades_to_direct_read(tmp_path, loops, monkeypatch):
+    """A silent (alive but never-sending) owner must cost the receiver
+    one coop timeout, then a direct read — never a hang."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_COOP_TIMEOUT", "1")
+    loop0, loop1 = loops
+    arr = np.frombuffer(os.urandom(100_000), np.uint8).copy()
+    fs = FSStoragePlugin(str(tmp_path / "real"))
+    _write(loop1, fs, "replicated/x", arr.tobytes())
+
+    s0, s1 = _session_pair(loop0, loop1)
+    try:
+        from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+
+        entry = _entry_for(arr, "replicated/x")
+        out1 = []
+        req1 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out1.append),
+        )
+        key = unit_key(req1)
+        plan1 = CoopKeyPlan(s1, {}, {key: 0})
+        loop1.run_until_complete(
+            execute_read_reqs([req1], fs, 1 << 30, 1, coop=plan1)
+        )
+        assert out1 and out1[0].tobytes() == arr.tobytes()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_owner_death_poisons_pending_units(tmp_path, loops, monkeypatch):
+    """An unclean connection drop from the owner aborts its pending
+    units immediately (fail-fast, not the timeout) and the receiver
+    direct-reads."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_COOP_TIMEOUT", "30")
+    loop0, loop1 = loops
+    arr = np.frombuffer(os.urandom(100_000), np.uint8).copy()
+    fs = FSStoragePlugin(str(tmp_path / "real"))
+    _write(loop1, fs, "replicated/x", arr.tobytes())
+
+    s0, s1 = _session_pair(loop0, loop1)
+    try:
+        from torchsnapshot_tpu.io_preparers.array import ArrayBufferConsumer
+
+        entry = _entry_for(arr, "replicated/x")
+        out1 = []
+        req1 = ReadReq(
+            path="replicated/x",
+            buffer_consumer=ArrayBufferConsumer(entry, callback=out1.append),
+        )
+        key = unit_key(req1)
+        plan1 = CoopKeyPlan(s1, {}, {key: 0})
+        # Send one chunk then die UNCLEANLY (no bye): simulates the
+        # owner crashing mid-entry.
+        sock, lock = s0._out[1]
+        with lock:
+            send_peer_frame(
+                sock,
+                {"op": "chunk", "key": key, "gen": 1, "seq": 0},
+                arr.tobytes()[:1000],
+            )
+            sock.close()
+        import time
+
+        t0 = time.perf_counter()
+        loop1.run_until_complete(
+            execute_read_reqs([req1], fs, 1 << 30, 1, coop=plan1)
+        )
+        # Fail-fast: well under the 30 s timeout.
+        assert time.perf_counter() - t0 < 10.0
+        assert out1 and out1[0].tobytes() == arr.tobytes()
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_world_size_1_never_offers() -> None:
+    class _PG:
+        def get_world_size(self):
+            return 1
+
+    offer = CoopRestoreSession.local_offer("FSStoragePlugin", _PG())
+    assert offer.addr is None
+    assert offer.engage([None], 0, None) is None
+
+
+# ------------------------------------------------------------------- lint
+
+
+def test_peer_channel_lint() -> None:
+    """Tier-1 wiring for scripts/check_peer_channel.py: the real peer
+    plane must be jax-free."""
+    result = subprocess.run(
+        [sys.executable, os.path.join("scripts", "check_peer_channel.py")],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+
+
+def test_peer_channel_lint_catches_jax() -> None:
+    import check_peer_channel as lint
+
+    bad = "import jax\n\ndef f(x):\n    return jax.device_put(x)\n"
+    violations = lint.check_source(bad, "<synthetic>")
+    assert len(violations) >= 2
+    aliased = "import jax.numpy as jnp\n\ndef f(x):\n    return jnp.sum(x)\n"
+    assert lint.check_source(aliased, "<synthetic>")
+    from_import = "from jax import device_put\n\ndef f(x):\n    return device_put(x)\n"
+    assert lint.check_source(from_import, "<synthetic>")
+    clean = "import numpy as np\n\ndef f(x):\n    return np.sum(x)\n"
+    assert lint.check_source(clean, "<synthetic>") == []

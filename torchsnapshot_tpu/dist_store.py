@@ -533,6 +533,123 @@ def create_store(
             time.sleep(0.1)
 
 
+# --------------------------------------------------------- peer transport
+#
+# Length-prefixed byte channel between RANKS — the data-plane sidecar to
+# the KV store above. The store moves metadata through rank 0; the peer
+# channel moves restore payload sub-chunks directly between the ranks
+# that have them and the ranks that need them (fanout.py), so cooperative
+# restores never funnel payload bytes through the coordination server.
+# Strictly host-network + threads: safe from background threads and never
+# touching device collectives, the same invariant the store itself keeps.
+#
+# Frame format (one frame = one protocol message):
+#
+#     u64 header_len | header (pickled dict) | u64 payload_len | payload
+#
+# The header is a tiny routing dict (op/key/gen/seq); the payload rides
+# raw — payload bytes are never pickled, so multi-MB sub-chunks move with
+# one copy into the receive buffer.
+
+PEER_CONNECT_TIMEOUT_S = 30.0
+
+
+def send_peer_frame(sock: socket.socket, header: Dict[str, Any], payload=None) -> None:
+    """Send one frame. ``payload`` is any buffer-protocol object (or
+    None). Callers serialize concurrent senders on one socket themselves
+    (a lock per connection) — interleaved sendalls would corrupt the
+    framing."""
+    h = pickle.dumps(header)
+    mv = memoryview(payload).cast("B") if payload is not None else None
+    sock.sendall(_LEN.pack(len(h)) + h + _LEN.pack(mv.nbytes if mv is not None else 0))
+    if mv is not None and mv.nbytes:
+        sock.sendall(mv)
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got = 0
+    while got < view.nbytes:
+        n = sock.recv_into(view[got:])
+        if not n:
+            raise ConnectionError("Peer connection closed mid-frame.")
+        got += n
+
+
+def recv_peer_frame(
+    sock: socket.socket, alloc: Optional[Any] = None
+) -> Tuple[Dict[str, Any], Optional[memoryview]]:
+    """Receive one frame: ``(header, payload_view_or_None)``.
+
+    ``alloc(nbytes)`` supplies the payload buffer (e.g. a pooled staging
+    slab, so repeated sub-chunk receives don't pay first-touch page
+    faults on every frame); default allocates a fresh bytearray. The
+    returned view stays valid for as long as the caller holds it."""
+    (hlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    header = pickle.loads(_recv_exact(sock, hlen))
+    (plen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if plen == 0:
+        return header, None
+    buf = alloc(plen) if alloc is not None else bytearray(plen)
+    view = memoryview(buf).cast("B")
+    _recv_exact_into(sock, view)
+    return header, view
+
+
+def peer_connect(addr: str, timeout: float = PEER_CONNECT_TIMEOUT_S) -> socket.socket:
+    """Connect to a peer listener at ``"host:port"``. TCP_NODELAY so the
+    small end/abort control frames aren't Nagle-delayed behind payload."""
+    host, _, port = addr.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+class PeerListener:
+    """Accepts inbound peer-channel connections, one handler thread per
+    connection (checkpoint-scale: world-1 inbound connections, payload
+    frames — the same threading shape as the store server). ``handler``
+    receives the raw connected socket and owns its lifecycle."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._handler: Optional[Any] = None
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, handler) -> None:
+        self._handler = handler
+        self._thread = threading.Thread(
+            target=self._serve, name="tpusnapshot-peer-listener", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._handler,
+                args=(conn,),
+                name="tpusnapshot-peer-conn",
+                daemon=True,
+            ).start()
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 class LinearBarrier:
     """Two-phase (arrive/depart) store barrier with leader action in between
     and cross-rank error propagation (reference: dist_store.py:91-196).

@@ -1,0 +1,785 @@
+"""Cooperative restore fan-out: rank-partitioned reads + peer redistribution.
+
+The save path writes every replicated byte ONCE — ``_partition_write_units``
+(snapshot.py) stripes replicated chunks across ranks. The restore path,
+until this module, read every replicated byte N TIMES: each rank fetched
+every replicated payload from storage in full, an N× read amplification
+that dominates multi-host restore time on shared/network storage.
+
+This module closes the asymmetry with the building blocks the repo
+already has:
+
+- the SAME deterministic greedy size-balanced partitioner the write side
+  uses (:func:`greedy_size_balanced`, extracted from
+  ``_partition_write_units`` so the two sides can never skew) elects one
+  OWNER rank per shared read unit;
+- the owner streams its partition from storage through the existing
+  ``ReadStream`` pipeline and FORWARDS each sub-chunk to the other
+  requesting ranks over a length-prefixed peer byte channel
+  (``dist_store.PeerListener`` — host network + threads only, never
+  device collectives, per the background-thread-safety invariant in
+  snapshot.py);
+- non-owners consume the forwarded sub-chunks through the same
+  incremental CRC/decompress/device_put consumers a storage stream
+  feeds, so peer consumption overlaps the owner's storage read exactly
+  like HtoD overlaps reads today. Receivers re-verify end to end (the
+  chained CRC is theirs, not trust in the owner).
+
+Scope: a read unit is an exact ``(origin, location, byte_range)``
+request under ``replicated/`` or ``sharded/`` — the locations that are
+rank-identical by construction. Units requested by ≥2 ranks are
+cooperative; per-rank and slab (``batched/``) payloads never are. The
+plan is computed from an all-gather of each rank's actual post-batching
+request set, so it is a pure function of rank-identical data — world
+size changes, device-digest skips, and env skew all repartition cleanly
+(a unit only one rank requests simply stays a direct read).
+
+Failure model: any peer failure or transport error degrades THAT ENTRY
+to a direct storage read on the affected rank — never a hang. An owner
+whose stream restarts (mirror failover, ``StreamRestartRequired``) sends
+a ``restart`` frame and re-forwards the complete post-restart payload as
+a new generation; receivers discard pre-restart bytes entirely, so
+replica bytes are never spliced after primary bytes on the peer path
+either. An owner that dies drops its TCP connections; receivers poison
+that owner's pending units and fall back. A receiver that sees nothing
+for ``TORCHSNAPSHOT_TPU_COOP_TIMEOUT`` seconds falls back too.
+
+Election is collective and elasticity-safe: one up-front all-gather
+(folded into the preverify gate's, snapshot.py) ANDs per-rank opt-ins —
+``TORCHSNAPSHOT_TPU_COOP_RESTORE`` auto/always/never, with ``auto``
+consulting the I/O governor's measured storage bandwidth
+(``IOGovernor.should_coop_restore``): on memcpy-speed local storage the
+socket copy costs more than the page-cache re-read, so direct reads
+stay; on throttled/network storage fan-out wins by ~N×.
+
+THIS MODULE MUST NEVER IMPORT OR CALL jax: every function here runs on
+background restore threads and the peer plane must stay device-free by
+construction — ``scripts/check_peer_channel.py`` lints exactly that.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import telemetry
+from .dist_store import (
+    PeerListener,
+    peer_connect,
+    recv_peer_frame,
+    send_peer_frame,
+)
+from .io_types import StreamRestartRequired
+
+logger = logging.getLogger(__name__)
+
+COOP_RESTORE_ENV_VAR = "TORCHSNAPSHOT_TPU_COOP_RESTORE"
+COOP_TIMEOUT_ENV_VAR = "TORCHSNAPSHOT_TPU_COOP_TIMEOUT"
+# A receiver that sees no frame for this long assumes the peer plane is
+# wedged (an ALIVE owner keeps frames or control messages flowing; a
+# dead one drops the connection, which surfaces in seconds) and falls
+# back to a direct storage read. Generous by default: a legitimate first
+# frame can trail the owner's whole partition read on slow storage.
+_DEFAULT_COOP_TIMEOUT_S = 600.0
+
+# High-water mark for UNBOUNDED receiver-side inbox buffering before a
+# one-time warning: buffering past this means owners are forwarding far
+# ahead of this rank's consumption (severe skew) — visible, not fatal.
+_INBOX_WARN_BYTES = 1 << 30
+
+# Storage-location prefixes that are rank-identical by construction —
+# the only locations where "the same request on two ranks" means "the
+# same bytes". Per-rank ("<rank>/") and write-batcher slab ("batched/")
+# locations never appear on more than one rank's plan.
+_SHARED_PREFIXES = ("replicated/", "sharded/")
+
+
+def coop_restore_mode() -> str:
+    """THE parser for ``TORCHSNAPSHOT_TPU_COOP_RESTORE``: ``never``
+    disables cooperative restores, ``always`` opts this rank in
+    unconditionally (engagement still requires every rank), and the
+    default ``auto`` opts in only when the I/O governor's measured read
+    bandwidth for the restore's storage backend says fan-out beats N
+    direct reads."""
+    raw = os.environ.get(COOP_RESTORE_ENV_VAR, "auto").strip().lower()
+    if raw in ("0", "false", "off", "no", "never"):
+        return "never"
+    if raw in ("1", "true", "on", "yes", "always", "force"):
+        return "always"
+    return "auto"
+
+
+def coop_timeout_s() -> float:
+    raw = os.environ.get(COOP_TIMEOUT_ENV_VAR, "").strip()
+    if raw:
+        try:
+            return max(1.0, float(raw))
+        except ValueError:
+            logger.warning("ignoring non-numeric %s=%r", COOP_TIMEOUT_ENV_VAR, raw)
+    return _DEFAULT_COOP_TIMEOUT_S
+
+
+# ------------------------------------------------------------- partitioner
+
+
+def greedy_size_balanced(
+    sizes: Sequence[int],
+    world_size: int,
+    candidates: Optional[Sequence[Sequence[int]]] = None,
+) -> List[int]:
+    """Deterministic greedy size-balanced assignment: owner rank per
+    unit, in the caller's (already deterministically sorted) order —
+    each unit goes to the least-loaded rank, ties to the lowest rank.
+
+    Extracted VERBATIM from the save side's ``_partition_write_units``
+    (snapshot.py) and now shared by both sides, so save striping and
+    restore fan-out can never skew: with ``candidates=None`` the
+    assignment is bit-identical to the historical inline loop for the
+    same input. ``candidates[i]`` optionally restricts unit ``i`` to a
+    subset of ranks (restore fan-out: the owner must be a rank that
+    actually requested the unit); every candidate list must be
+    non-empty and sorted for determinism."""
+    loads = [0] * world_size
+    owners: List[int] = []
+    for i, nbytes in enumerate(sizes):
+        pool = range(world_size) if candidates is None else candidates[i]
+        target = min(pool, key=lambda r: (loads[r], r))
+        loads[target] += nbytes
+        owners.append(target)
+    return owners
+
+
+def unit_key(read_req: Any) -> Optional[str]:
+    """Cooperative unit key for a read request, or None when the request
+    can never be shared across ranks. The key is the exact byte source:
+    origin snapshot (incremental chains read base storage), storage
+    location, and byte range — two ranks with the same key will receive
+    identical bytes from storage by construction."""
+    path = read_req.path
+    if not path.startswith(_SHARED_PREFIXES):
+        return None
+    br = read_req.byte_range
+    if br is not None and br[1] <= br[0]:
+        return None  # zero-length: nothing to move
+    lo, hi = (br[0], br[1]) if br is not None else (-1, -1)
+    return f"{read_req.origin or ''}|{path}|{lo}|{hi}"
+
+
+def _unit_nbytes(read_req: Any) -> int:
+    br = read_req.byte_range
+    if br is not None:
+        return max(0, br[1] - br[0])
+    return max(1, read_req.buffer_consumer.get_consuming_cost_bytes())
+
+
+# ---------------------------------------------------------------- protocol
+#
+# Frame ops (header dicts over dist_store.send_peer_frame):
+#   hello    {rank}                      first frame on every connection
+#   chunk    {key, gen, seq} + payload   one forwarded sub-chunk
+#   end      {key, gen, nbytes, nchunks} the generation completed
+#   restart  {key, gen}                  discard prior generations
+#   abort    {key}                       owner gave up on this unit
+#   bye      {}                          clean connection shutdown
+
+
+class PeerTransferError(IOError):
+    """A peer-fed unit cannot be delivered (owner died, aborted, or went
+    silent past the coop timeout). The scheduler degrades the entry to a
+    direct storage read — this is a routing signal, never fatal."""
+
+
+class _Inbox:
+    """Per-unit event mailbox bridging receiver threads to the restore's
+    asyncio loop. Events are staged under the session lock until the
+    first async consumer attaches (creating the asyncio.Queue ON the
+    loop thread); later posts hop via ``loop.call_soon_threadsafe`` so
+    no thread ever blocks waiting — inbound routing can never deadlock
+    against TCP backpressure."""
+
+    __slots__ = ("staged", "aq", "poisoned")
+
+    def __init__(self) -> None:
+        self.staged: List[Tuple] = []
+        self.aq: Optional[asyncio.Queue] = None
+        self.poisoned = False
+
+
+@dataclass
+class SendRole:
+    """This rank owns the unit: read it from storage and forward every
+    sub-chunk to ``subs`` while the local consumer processes it."""
+
+    session: "CoopRestoreSession"
+    plan: "CoopKeyPlan"
+    key: str
+    subs: List[int]
+
+    is_send = True
+    is_recv = False
+
+    async def chunk(self, gen: int, seq: int, buf) -> None:
+        await self.session._forward(
+            self.subs, {"op": "chunk", "key": self.key, "gen": gen, "seq": seq}, buf
+        )
+
+    async def end(self, gen: int, nbytes: int, nchunks: int) -> None:
+        await self.session._forward(
+            self.subs,
+            {
+                "op": "end",
+                "key": self.key,
+                "gen": gen,
+                "nbytes": nbytes,
+                "nchunks": nchunks,
+            },
+            None,
+        )
+        self.plan.mark_done(self.key)
+
+    async def restart(self, gen: int) -> None:
+        await self.session._forward(
+            self.subs, {"op": "restart", "key": self.key, "gen": gen}, None
+        )
+
+
+@dataclass
+class RecvRole:
+    """Another rank owns the unit: consume its forwarded sub-chunks."""
+
+    session: "CoopRestoreSession"
+    key: str
+    owner: int
+
+    is_send = False
+    is_recv = True
+
+    def stream(self):
+        """Ordered sub-chunk async iterator for the CURRENT generation.
+        Raises ``StreamRestartRequired`` when the owner restarts the
+        stream mid-generation (the consumer's no-partial-commit contract
+        makes the retry safe) and ``PeerTransferError`` when the unit
+        cannot be delivered at all."""
+        return self.session._open_stream(self.key, self.owner)
+
+    async def buffered(self) -> memoryview:
+        """The unit's complete payload for its FINAL generation —
+        restart frames reset the accumulation, so this never splices
+        bytes across generations."""
+        return await self.session._receive_buffered(self.key, self.owner)
+
+
+class CoopKeyPlan:
+    """One app-state key's cooperative read plan: which of this rank's
+    read requests it owns (and for whom), and which arrive from a peer.
+    Produced by :meth:`CoopRestoreSession.plan_for_key` from an
+    all-gather of every rank's request set — identical on every rank."""
+
+    def __init__(
+        self,
+        session: "CoopRestoreSession",
+        send: Dict[str, List[int]],
+        recv: Dict[str, int],
+    ) -> None:
+        self._session = session
+        self._send = send
+        self._recv = recv
+        self._taken: set = set()
+        self._done: set = set()
+
+    def take_role(self, read_req: Any):
+        """Role for one read request, or None (plain direct read).
+        Duplicate requests for one unit within a rank: only the first
+        takes the role (the owner forwards once; a duplicate consumer
+        direct-reads)."""
+        key = unit_key(read_req)
+        if key is None or key in self._taken:
+            return None
+        if key in self._send:
+            self._taken.add(key)
+            return SendRole(self._session, self, key, self._send[key])
+        owner = self._recv.get(key)
+        if owner is not None:
+            self._taken.add(key)
+            if owner in self._session._dead:
+                # Known-dead owner at dispatch time: skip the wait, read
+                # directly — cheaper than a poisoned-inbox round trip.
+                telemetry.counter_add("fanout_fallbacks", 1)
+                return None
+            return RecvRole(self._session, key, owner)
+        return None
+
+    def mark_done(self, key: str) -> None:
+        self._done.add(key)
+
+    def abort_incomplete(self) -> None:
+        """Abort every owned unit this rank never finished forwarding —
+        called when the key's execution raises or completes with units
+        unscheduled, so subscribers fall back promptly instead of waiting
+        out the coop timeout."""
+        for key, subs in self._send.items():
+            if key not in self._done:
+                self._session._forward_sync(subs, {"op": "abort", "key": key}, None)
+                self._done.add(key)
+
+    @property
+    def n_send(self) -> int:
+        return len(self._send)
+
+    @property
+    def n_recv(self) -> int:
+        return len(self._recv)
+
+
+class _Offer:
+    """One rank's election-time offer: the peer-channel address it will
+    serve on (None = not opting in). Created BEFORE the election
+    all-gather so the address can ride it; ``engage`` finalizes (or
+    closes the listener when the fleet did not unanimously opt in)."""
+
+    def __init__(
+        self, addr: Optional[str], listener: Optional[PeerListener]
+    ) -> None:
+        self.addr = addr
+        self._listener = listener
+
+    def engage(
+        self,
+        addrs: List[Optional[str]],
+        rank: int,
+        event_loop: asyncio.AbstractEventLoop,
+    ) -> Optional["CoopRestoreSession"]:
+        if self.addr is None or any(a is None for a in addrs):
+            if self._listener is not None:
+                self._listener.close()
+                if any(a is not None for a in addrs):
+                    logger.info(
+                        "cooperative restore disabled for this restore: not "
+                        "every rank opted in (env skew or rate-gate "
+                        "divergence); reading directly"
+                    )
+            return None
+        session = CoopRestoreSession(
+            rank, addrs, self._listener, event_loop  # type: ignore[arg-type]
+        )
+        session._connect_peers()
+        return session
+
+
+class CoopRestoreSession:
+    """One restore's peer data plane: the inbound receiver (routing
+    forwarded sub-chunks into per-unit inboxes), the outbound full-mesh
+    connections, the per-key plan collective, and the failure state."""
+
+    @classmethod
+    def local_offer(cls, plugin_name: str, pg_wrapper: Any) -> _Offer:
+        """This rank's election-time opt-in decision. Opting in binds
+        the listener (cheap) so the address can ride the election
+        all-gather; a failed election closes it again."""
+        if pg_wrapper.get_world_size() <= 1:
+            return _Offer(None, None)
+        mode = coop_restore_mode()
+        opt_in = False
+        if mode == "always":
+            opt_in = True
+        elif mode == "auto":
+            from .scheduler import io_governor
+
+            opt_in = io_governor().should_coop_restore(plugin_name)
+        if not opt_in:
+            return _Offer(None, None)
+        ip = cls._local_ip(pg_wrapper)
+        if ip is None:
+            # Can't determine an address peers can reach: advertising a
+            # guess (e.g. loopback on a multi-host world) would engage
+            # cooperation and stall subscribers into the coop timeout.
+            # Opting out degrades the whole fleet to direct reads NOW.
+            logger.warning(
+                "cannot determine this rank's peer-reachable address; "
+                "opting out of cooperative restore"
+            )
+            return _Offer(None, None)
+        try:
+            listener = PeerListener()
+        except OSError:
+            logger.exception("peer listener bind failed; opting out")
+            return _Offer(None, None)
+        return _Offer(f"{ip}:{listener.port}", listener)
+
+    @staticmethod
+    def _local_ip(pg_wrapper: Any) -> Optional[str]:
+        """The address peers can reach this rank on: the local end of
+        the store connection (the interface that already reaches the
+        coordination plane reaches the peer plane too). None when it
+        cannot be determined — the caller opts out, never guesses."""
+        try:
+            return pg_wrapper.pg.store._sock.getsockname()[0]
+        except Exception:  # noqa: BLE001 - wrapped/alternative stores
+            return None
+
+    def __init__(
+        self,
+        rank: int,
+        addrs: List[str],
+        listener: PeerListener,
+        event_loop: asyncio.AbstractEventLoop,
+    ) -> None:
+        self._rank = rank
+        self._world = len(addrs)
+        self._addrs = addrs
+        self._listener = listener
+        self._loop = event_loop
+        self._timeout = coop_timeout_s()
+        self._lock = threading.Lock()
+        self._inboxes: Dict[str, _Inbox] = {}
+        self._key_owner: Dict[str, int] = {}
+        # Ranks whose inbound connection dropped uncleanly (their owned
+        # units will never arrive) / ranks we can no longer send to.
+        self._dead: set = set()
+        self._send_dead: set = set()
+        self._out: Dict[int, Tuple[Any, threading.Lock]] = {}
+        self._closed = False
+        # Inbox buffering is deliberately unbounded (blocking inbound
+        # routing could TCP-deadlock the mesh) and sits OUTSIDE the
+        # scheduler's memory budget; in practice it is bounded by the
+        # owners' read speed and the receiver's dispatch-first priority
+        # for peer-fed entries, but pathological skew is made VISIBLE:
+        # a gauge plus a one-time warning past the high-water mark.
+        self._buffered_bytes = 0
+        self._warned_buffered = False
+        listener.start(self._handle_conn)
+
+    # ------------------------------------------------------------- mesh
+
+    def _connect_peers(self) -> None:
+        for r, addr in enumerate(self._addrs):
+            if r == self._rank:
+                continue
+            try:
+                sock = peer_connect(addr)
+                send_peer_frame(sock, {"op": "hello", "rank": self._rank})
+                self._out[r] = (sock, threading.Lock())
+            except OSError:
+                logger.warning(
+                    "peer channel to rank %d (%s) unavailable; its units "
+                    "will be read directly on that side",
+                    r,
+                    addr,
+                )
+                self._send_dead.add(r)
+
+    def _handle_conn(self, conn) -> None:
+        """Inbound routing loop (one thread per connected owner). Never
+        blocks on a full inbox — inboxes are unbounded, so TCP always
+        drains and the peer plane cannot distributed-deadlock; memory is
+        bounded in practice by the owner's read speed and the receiver's
+        dispatch priority for peer-fed entries."""
+        from .io_preparers.array import pooled_buffer
+
+        src: Optional[int] = None
+        clean = False
+        try:
+            while True:
+                header, payload = recv_peer_frame(conn, alloc=pooled_buffer)
+                op = header.get("op")
+                if op == "hello":
+                    src = int(header["rank"])
+                    continue
+                if op == "bye":
+                    clean = True
+                    return
+                key = header["key"]
+                if op == "chunk":
+                    self._post(key, ("chunk", header["gen"], payload))
+                elif op == "end":
+                    self._post(
+                        key,
+                        ("end", header["gen"], header["nbytes"], header["nchunks"]),
+                    )
+                elif op == "restart":
+                    self._post(key, ("restart", header["gen"]))
+                elif op == "abort":
+                    self._post(key, ("abort",))
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if not clean and src is not None and not self._closed:
+                self._mark_source_dead(src)
+
+    def _mark_source_dead(self, rank: int) -> None:
+        with self._lock:
+            self._dead.add(rank)
+            doomed = [
+                key for key, owner in self._key_owner.items() if owner == rank
+            ]
+        logger.warning(
+            "peer rank %d's channel dropped mid-restore; %d pending "
+            "unit(s) fall back to direct storage reads",
+            rank,
+            len(doomed),
+        )
+        for key in doomed:
+            self._post(key, ("abort",))
+
+    # ---------------------------------------------------------- receiving
+
+    def _post(self, key: str, event: Tuple) -> None:
+        warn = False
+        with self._lock:
+            inbox = self._inboxes.get(key)
+            if inbox is None:
+                inbox = self._inboxes[key] = _Inbox()
+            if event[0] == "chunk":
+                self._buffered_bytes += event[2].nbytes
+                if (
+                    self._buffered_bytes > _INBOX_WARN_BYTES
+                    and not self._warned_buffered
+                ):
+                    self._warned_buffered = True
+                    warn = True
+            if inbox.aq is None:
+                inbox.staged.append(event)
+            else:
+                self._loop.call_soon_threadsafe(inbox.aq.put_nowait, event)
+        telemetry.gauge_set("peer_inbox_buffered_bytes", self._buffered_bytes)
+        if warn:
+            logger.warning(
+                "peer inbox buffering exceeded %.1f GB on rank %d: owners "
+                "are forwarding far ahead of this rank's consumption "
+                "(severe rank skew?); frames are retained until consumed",
+                _INBOX_WARN_BYTES / 1e9,
+                self._rank,
+            )
+
+    def _attach(self, key: str) -> _Inbox:
+        """Bind a unit's inbox to the asyncio loop (must run ON the loop
+        thread, which every scheduler coroutine does)."""
+        with self._lock:
+            inbox = self._inboxes.get(key)
+            if inbox is None:
+                inbox = self._inboxes[key] = _Inbox()
+            if inbox.aq is None:
+                inbox.aq = asyncio.Queue()
+                for ev in inbox.staged:
+                    inbox.aq.put_nowait(ev)
+                inbox.staged = []
+            return inbox
+
+    async def _next_event(self, inbox: _Inbox, key: str) -> Tuple:
+        try:
+            ev = await asyncio.wait_for(inbox.aq.get(), self._timeout)
+        except asyncio.TimeoutError:
+            raise PeerTransferError(
+                f"no peer frame for unit {key!r} within {self._timeout:.0f}s"
+            ) from None
+        if ev[0] == "chunk":
+            with self._lock:
+                self._buffered_bytes -= ev[2].nbytes
+        return ev
+
+    def _register(self, key: str, owner: int) -> None:
+        """Dead-check + ownership registration ATOMICALLY: a death
+        landing between a lock-free check and the registration would
+        leave this unit waiting out the full timeout instead of failing
+        fast."""
+        with self._lock:
+            if owner in self._dead:
+                raise PeerTransferError(f"owner rank {owner} is dead")
+            self._key_owner[key] = owner
+
+    async def _open_stream(self, key: str, owner: int):
+        """Async generator over one generation's ordered sub-chunks."""
+        self._register(key, owner)
+        inbox = self._attach(key)
+        gen: Optional[int] = None
+        count = 0
+        nbytes = 0
+        while True:
+            ev = await self._next_event(inbox, key)
+            kind = ev[0]
+            if kind == "chunk":
+                if gen is None:
+                    gen = ev[1]
+                elif ev[1] != gen:
+                    raise StreamRestartRequired(
+                        f"peer stream for {key!r} restarted (generation "
+                        f"{ev[1]} superseded {gen})"
+                    )
+                count += 1
+                nbytes += ev[2].nbytes
+                yield ev[2]
+            elif kind == "end":
+                if gen is not None and ev[1] != gen:
+                    raise StreamRestartRequired(
+                        f"peer stream for {key!r} ended a superseded generation"
+                    )
+                if ev[2] != nbytes or ev[3] != count:
+                    raise IOError(
+                        f"peer stream for {key!r} delivered {nbytes} bytes/"
+                        f"{count} chunks, owner sent {ev[2]}/{ev[3]}"
+                    )
+                return
+            elif kind == "restart":
+                raise StreamRestartRequired(
+                    f"peer stream for {key!r} restarted by its owner"
+                )
+            elif kind == "abort":
+                raise PeerTransferError(f"owner aborted unit {key!r}")
+
+    async def _receive_buffered(self, key: str, owner: int) -> memoryview:
+        """Accumulate the unit's final generation into one buffer. A
+        restart frame RESETS the accumulation — pre-restart bytes are
+        dropped wholesale, never spliced."""
+        self._register(key, owner)
+        inbox = self._attach(key)
+        gen: Optional[int] = None
+        parts: List[memoryview] = []
+        while True:
+            ev = await self._next_event(inbox, key)
+            kind = ev[0]
+            if kind == "chunk":
+                if gen is None or ev[1] > gen:
+                    gen, parts = ev[1], []
+                if ev[1] == gen:
+                    parts.append(ev[2])
+                # ev[1] < gen: stale pre-restart chunk — drop.
+            elif kind == "restart":
+                if gen is None or ev[1] > gen:
+                    gen, parts = ev[1], []
+            elif kind == "end":
+                if gen is not None and ev[1] < gen:
+                    continue  # a superseded generation's tail — drop
+                total = sum(p.nbytes for p in parts)
+                if ev[2] != total or ev[3] != len(parts):
+                    raise IOError(
+                        f"peer transfer for {key!r} delivered {total} bytes/"
+                        f"{len(parts)} chunks, owner sent {ev[2]}/{ev[3]}"
+                    )
+                if len(parts) == 1:
+                    return parts[0]
+                out = bytearray(total)
+                pos = 0
+                for p in parts:
+                    out[pos : pos + p.nbytes] = p
+                    pos += p.nbytes
+                return memoryview(out)
+            elif kind == "abort":
+                raise PeerTransferError(f"owner aborted unit {key!r}")
+
+    # ---------------------------------------------------------- forwarding
+
+    def _send_one(self, rank: int, header: Dict[str, Any], payload) -> None:
+        entry = self._out.get(rank)
+        if entry is None or rank in self._send_dead:
+            return
+        sock, lock = entry
+        try:
+            with lock:
+                send_peer_frame(sock, header, payload)
+        except (ConnectionError, OSError):
+            # The subscriber is gone: it will direct-read; skip it from
+            # now on without failing the owner's own restore.
+            self._send_dead.add(rank)
+            logger.warning(
+                "peer channel to rank %d dropped; it falls back to direct reads",
+                rank,
+            )
+
+    def _forward_sync(self, subs: List[int], header: Dict[str, Any], payload) -> None:
+        for r in subs:
+            self._send_one(r, header, payload)
+
+    async def _forward(self, subs: List[int], header: Dict[str, Any], payload) -> None:
+        """Forward one frame to every subscriber off the event loop (the
+        loop's default executor — sendall can block on TCP backpressure
+        and must never stall the read pipeline's loop)."""
+        nbytes = memoryview(payload).nbytes if payload is not None else 0
+        with telemetry.span(
+            "peer_send", cat="fanout", key=header.get("key"), bytes=nbytes,
+            subs=len(subs),
+        ):
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._forward_sync, subs, header, payload
+            )
+            if nbytes:
+                telemetry.counter_add("bytes_to_peers", nbytes * len(subs))
+
+    # ------------------------------------------------------------ planning
+
+    def plan_for_key(self, read_reqs: List[Any], pg_wrapper: Any) -> CoopKeyPlan:
+        """COLLECTIVE (one all-gather): agree on this key's cooperative
+        units and their owners. Every rank must call this at the same
+        key slot — with an empty list when it has nothing to read — or
+        peers would hang; the local-contribution phase never raises.
+
+        Ownership is a pure function of the gathered request sets: the
+        shared units sorted (size-desc, key) and assigned by the same
+        greedy size-balanced partitioner the save side stripes with,
+        restricted to the ranks that actually requested each unit."""
+        local: Dict[str, int] = {}
+        for rr in read_reqs:
+            key = unit_key(rr)
+            if key is not None and key not in local:
+                local[key] = _unit_nbytes(rr)
+        gathered = pg_wrapper.all_gather_object(sorted(local.items()))
+
+        requesters: Dict[str, List[int]] = {}
+        sizes: Dict[str, int] = {}
+        for r, items in enumerate(gathered):
+            for key, nbytes in items:
+                requesters.setdefault(key, []).append(r)
+                sizes[key] = max(sizes.get(key, 0), int(nbytes))
+        pool = sorted(
+            (key for key, ranks in requesters.items() if len(ranks) > 1),
+            key=lambda k: (-sizes[k], k),
+        )
+        owners = greedy_size_balanced(
+            [sizes[k] for k in pool], self._world, [requesters[k] for k in pool]
+        )
+        send: Dict[str, List[int]] = {}
+        recv: Dict[str, int] = {}
+        for key, owner in zip(pool, owners):
+            if owner == self._rank:
+                send[key] = [r for r in requesters[key] if r != self._rank]
+            elif self._rank in requesters[key]:
+                recv[key] = owner
+        if send or recv:
+            logger.debug(
+                "[rank %d] cooperative plan: own %d unit(s), receive %d "
+                "from peers, %d shared total",
+                self._rank,
+                len(send),
+                len(recv),
+                len(pool),
+            )
+        return CoopKeyPlan(self, send, recv)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        """Clean shutdown: bye every peer (so our connection drop is not
+        mistaken for a death), then close the mesh and the listener."""
+        if self._closed:
+            return
+        self._closed = True
+        for r, (sock, lock) in list(self._out.items()):
+            try:
+                if r not in self._send_dead:
+                    with lock:
+                        send_peer_frame(sock, {"op": "bye"})
+            except (ConnectionError, OSError):
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._out.clear()
+        self._listener.close()

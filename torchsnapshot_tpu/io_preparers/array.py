@@ -195,6 +195,17 @@ def _pool_limit() -> int:
 _staging_pool = _StagingPool(_pool_limit())
 
 
+def pooled_buffer(nbytes: int) -> np.ndarray:
+    """A writable uint8 buffer drawn from the process staging pool,
+    recycled by the GC when every reference dies (see _StagingPool).
+
+    The public face of the pool for the other byte movers on the restore
+    hot path — the fs plugin's pread windows and the cooperative-restore
+    peer receiver (fanout.py) — so repeated sub-chunk buffers don't pay
+    first-touch page faults on every window/frame."""
+    return _staging_pool.get(nbytes)
+
+
 def fast_copyto(dst: np.ndarray, src: np.ndarray) -> None:
     """``np.copyto(dst, src, casting="same_kind")``, but through raw bytes
     when the dtypes match exactly and both sides are C-contiguous: numpy's

@@ -50,6 +50,17 @@ entries the plugin-declared retention (``stream_admission_cost`` — the
 stager's 2-chunk window for fs, part buffers for s3, the retained stream
 for gcs), not their full staging size.
 
+**Cooperative restore fan-out** (fanout.py): when a multi-rank restore
+engages cooperation, each read request carries a role — owners read from
+storage and FORWARD every sub-chunk to subscribing peers over the peer
+byte channel (one-send lookahead, so forwarding rides under the local
+decode), peer-fed entries consume forwarded sub-chunks through the same
+streaming consumers a storage stream feeds (full CRC re-verified on the
+receiver), and any peer failure degrades that entry to a direct storage
+read with the budget re-charged. Peer-fed entries are exempt from the
+I/O slot cap (they issue no storage request) and dispatch first so
+receiver-side buffering stays bounded by the owners' read speed.
+
 **I/O governor** (:class:`IOGovernor`): sub-chunk size, I/O concurrency,
 and the restore-side preverify gate adapt to rates this module measures on
 its own traffic (per-plugin write/read bandwidth) plus the fingerprint
@@ -334,6 +345,19 @@ class IOGovernor:
         if hash_bps is None or read_bps is None:
             return True  # no evidence: keep the zero-byte verify path
         return read_bps <= hash_bps * _PREVERIFY_READ_MARGIN
+
+    def should_coop_restore(self, plugin: Optional[str] = None) -> bool:
+        """Economic gate for cooperative restore fan-out (fanout.py,
+        under ``TORCHSNAPSHOT_TPU_COOP_RESTORE=auto``): partitioning
+        replicated reads across ranks and redistributing sub-chunks over
+        the host network wins ~N× when storage bandwidth is the
+        bottleneck, but on memcpy-speed local storage (page-cache reads)
+        the socket copy costs more than just re-reading — the same
+        latency-bound knee the streamed-read election uses. No recorded
+        read rate for this restore's backend means no evidence: direct
+        reads (the status quo) stay."""
+        bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
+        return bps is not None and bps < _STREAM_READ_LATENCY_BPS
 
 
 def preverify_mode() -> str:
@@ -952,11 +976,53 @@ class _ReadPipeline:
         read_req: ReadReq,
         sub_chunk_bytes: Optional[int] = None,
         stream_all: bool = False,
+        coop_plan=None,
+        peer_sub_chunk: Optional[int] = None,
     ) -> None:
         self.read_req = read_req
         self.consuming_cost_bytes: int = (
             read_req.buffer_consumer.get_consuming_cost_bytes()
         )
+        # Cooperative restore fan-out (fanout.py): the plan assigns this
+        # request a role — SendRole (this rank reads from storage and
+        # forwards every sub-chunk to the subscribing peers), RecvRole
+        # (another rank reads; the bytes arrive over the peer channel),
+        # or None (plain direct read).
+        self.coop_role = (
+            coop_plan.take_role(read_req) if coop_plan is not None else None
+        )
+        self.coop_gen = 1
+        self.peer_sub_chunk = peer_sub_chunk
+        self.peer_streamed = False
+        # Shared semaphore capping DIRECT-read fallbacks of peer-fed
+        # entries at the governor's I/O concurrency (set by
+        # execute_read_reqs when cooperation is active).
+        self.fallback_gate: Optional[asyncio.Semaphore] = None
+        if self.coop_role is not None and self.coop_role.is_recv:
+            # Peer-fed: no storage I/O on the happy path, so the storage
+            # streaming election below does not apply (a fallback after
+            # peer failure reads buffered). Streaming eligibility is the
+            # CONSUMER's alone — the peer channel always produces chunks
+            # incrementally, whatever the storage plugin supports.
+            self.sub_chunk_bytes = None
+            self.streamed = False
+            br = read_req.byte_range
+            empty = br is not None and br[1] <= br[0]
+            if (
+                peer_sub_chunk is not None
+                and not empty
+                and read_req.buffer_consumer.can_stream(peer_sub_chunk)
+            ):
+                self.peer_streamed = True
+                self.admission_cost_bytes: int = min(
+                    self.consuming_cost_bytes,
+                    read_req.buffer_consumer.stream_admission_cost(
+                        peer_sub_chunk
+                    ),
+                )
+            else:
+                self.admission_cost_bytes = self.consuming_cost_bytes
+            return
         # Streaming election happens at construction, mirroring the write
         # side: the consumer opts in for THIS sub-chunk size, and the
         # budget then charges the consumer-declared streamed retention
@@ -988,6 +1054,150 @@ class _ReadPipeline:
         if not self.streamed:
             self.admission_cost_bytes = self.consuming_cost_bytes
 
+    @property
+    def is_recv(self) -> bool:
+        return self.coop_role is not None and self.coop_role.is_recv
+
+    @property
+    def coop_order(self) -> int:
+        """Dispatch priority class. Peer-fed entries first: they do no
+        storage I/O (and are exempt from the I/O slot cap), and opening
+        them early drains the peer inboxes the owners are already
+        filling. Owned (forwarding) entries next, so every peer's
+        receive side is fed as early as possible; plain reads last."""
+        if self.coop_role is None:
+            return 2
+        return 0 if self.coop_role.is_recv else 1
+
+    def _recharge(self, budget: Optional["_MemoryBudget"]) -> None:
+        """The entry is about to hold its FULL payload (buffered retry
+        or fallback) while the budget only charged a streamed window:
+        charge the difference — possibly driving availability negative,
+        like the starvation escape — so concurrent dispatch throttles
+        instead of overshooting. Idempotent."""
+        delta = self.consuming_cost_bytes - self.admission_cost_bytes
+        if delta > 0 and budget is not None:
+            budget.acquire(delta)
+            self.admission_cost_bytes = self.consuming_cost_bytes
+
+    # ---------------------------------------------------- peer-fed path
+
+    async def _peer_stream_consume(
+        self, role, consumer, executor, throughput: _Throughput
+    ) -> None:
+        source = role.stream()
+
+        async def counted():
+            while True:
+                with telemetry.span("peer_recv", cat="fanout"):
+                    try:
+                        chunk = await source.__anext__()
+                    except StopAsyncIteration:
+                        return
+                n = memoryview(chunk).nbytes
+                throughput.add(n)
+                telemetry.counter_add("bytes_read", n)
+                telemetry.counter_add("bytes_from_peers", n)
+                yield chunk
+
+        stream = ReadStream(
+            path=self.read_req.path,
+            nbytes=self.consuming_cost_bytes,
+            chunks=counted(),
+        )
+        try:
+            await consumer.consume_stream(stream, executor)
+        finally:
+            aclose = getattr(source, "aclose", None)
+            if aclose is not None:
+                await aclose()
+
+    async def _peer_read_and_consume(
+        self, executor, throughput: _Throughput, budget: Optional["_MemoryBudget"]
+    ) -> bool:
+        """Consume this entry from its owner's forwarded sub-chunks.
+        Returns False when the bytes cannot be delivered (owner death,
+        abort, timeout, or integrity failure of the delivered bytes);
+        the caller then degrades to a direct storage read — the fan-out
+        failure contract: any peer failure costs one re-read, never a
+        hang. The receiver runs the FULL verification chain itself
+        (chained CRC, decompression), so a forwarding owner is never
+        trusted with integrity."""
+        from .fanout import PeerTransferError  # noqa: F401 (doc anchor)
+        from .integrity import IntegrityError
+
+        role = self.coop_role
+        consumer = self.read_req.buffer_consumer
+        path = self.read_req.path
+        try:
+            with telemetry.span(
+                "coop_read", path=path, source=role.owner,
+                bytes=self.consuming_cost_bytes,
+            ):
+                if self.peer_streamed:
+                    try:
+                        await self._peer_stream_consume(
+                            role, consumer, executor, throughput
+                        )
+                        telemetry.counter_add("entries_read", 1)
+                        telemetry.counter_add("entries_from_peers", 1)
+                        return True
+                    except StreamRestartRequired as e:
+                        # The owner's storage stream restarted (mirror
+                        # failover): pre-restart bytes are discarded
+                        # WHOLESALE and the final generation arrives
+                        # complete — never spliced.
+                        logger.warning(
+                            "peer-fed stream of %s restarting through the "
+                            "buffered path: %s",
+                            path,
+                            e,
+                        )
+                        telemetry.counter_add("stream_read_restarts", 1)
+                        self._recharge(budget)
+                with telemetry.span("peer_recv", cat="fanout", path=path):
+                    buf = await role.buffered()
+                n = memoryview(buf).nbytes
+                throughput.add(n)
+                telemetry.counter_add("bytes_read", n)
+                telemetry.counter_add("bytes_from_peers", n)
+                with telemetry.span("consume", path=path, bytes=n):
+                    await consumer.consume_buffer(buf, executor)
+                telemetry.counter_add("entries_read", 1)
+                telemetry.counter_add("entries_from_peers", 1)
+                return True
+        except (IOError, IntegrityError) as e:
+            # IOError covers the whole transport failure family
+            # (PeerTransferError, short/over-long transfers);
+            # IntegrityError a checksum mismatch of peer-delivered bytes
+            # — storage may still hold good bytes, so re-read directly
+            # (and surface storage's own error if it does not).
+            logger.warning(
+                "peer-fed read of %s from rank %s failed (%s: %s); falling "
+                "back to a direct storage read",
+                path,
+                role.owner,
+                type(e).__name__,
+                e,
+            )
+            telemetry.counter_add("fanout_fallbacks", 1)
+            self._recharge(budget)
+            return False
+
+    # ------------------------------------------------- owner forwarding
+
+    async def _forward_buffer(self, role, buf) -> None:
+        """Forward a buffered owner read to the subscribers, chunked at
+        the peer sub-chunk size (one frame per chunk so receivers keep
+        their incremental consume window)."""
+        mv = memoryview(buf).cast("B")
+        step = self.peer_sub_chunk or _DEFAULT_SUB_CHUNK_BYTES
+        n = 0
+        for lo in range(0, mv.nbytes, step):
+            await role.chunk(self.coop_gen, n, mv[lo : lo + step])
+            n += 1
+        await role.end(self.coop_gen, mv.nbytes, n)
+
     async def _stream_read_and_consume(
         self, storage: StoragePlugin, executor, throughput: _Throughput
     ) -> bool:
@@ -997,18 +1207,49 @@ class _ReadPipeline:
         becomes ~max(read, consume) instead of read + consume. Returns
         False when the stream demands a from-offset-0 restart
         (StreamRestartRequired); the caller then re-runs the entry
-        through the buffered path."""
+        through the buffered path.
+
+        Under a cooperative SendRole every sub-chunk is ALSO forwarded
+        to the subscribing peers with a one-send lookahead (chunk N
+        ships while the local consumer decodes it), so peer consumption
+        overlaps this owner's storage read; a restart bumps the
+        generation so receivers discard pre-restart bytes wholesale."""
         read_io = ReadIO(
             path=self.read_req.path, byte_range=self.read_req.byte_range
         )
         consumer = self.read_req.buffer_consumer
+        role = self.coop_role
+        send = role if (role is not None and role.is_send) else None
+        sent = {"n": 0, "bytes": 0}
 
         async def counted(chunks):
-            async for chunk in chunks:
-                n = memoryview(chunk).nbytes
-                throughput.add(n)
-                telemetry.counter_add("bytes_read", n)
-                yield chunk
+            pending_send = None
+            try:
+                async for chunk in chunks:
+                    n = memoryview(chunk).nbytes
+                    throughput.add(n)
+                    telemetry.counter_add("bytes_read", n)
+                    if send is not None:
+                        telemetry.counter_add("bytes_from_storage", n)
+                        if pending_send is not None:
+                            await pending_send
+                        pending_send = asyncio.get_running_loop().create_task(
+                            send.chunk(self.coop_gen, sent["n"], chunk)
+                        )
+                        sent["n"] += 1
+                        sent["bytes"] += n
+                    yield chunk
+                if pending_send is not None:
+                    await pending_send
+                    pending_send = None
+            finally:
+                if pending_send is not None:
+                    # Unwinding mid-stream (consumer error/restart): let
+                    # the in-flight frame land whole before closing.
+                    try:
+                        await pending_send
+                    except Exception:  # noqa: BLE001 - unwind path
+                        pass
 
         try:
             with telemetry.span(
@@ -1039,7 +1280,16 @@ class _ReadPipeline:
                 e,
             )
             telemetry.counter_add("stream_read_restarts", 1)
+            if send is not None:
+                # Subscribers must never splice post-restart bytes after
+                # pre-restart ones: bump the generation (receivers drop
+                # everything older) and re-forward the complete payload
+                # from the buffered retry.
+                self.coop_gen += 1
+                await send.restart(self.coop_gen)
             return False
+        if send is not None:
+            await send.end(self.coop_gen, sent["bytes"], sent["n"])
         telemetry.counter_add("entries_read", 1)
         telemetry.counter_add("entries_stream_read", 1)
         return True
@@ -1051,20 +1301,46 @@ class _ReadPipeline:
         throughput: _Throughput,
         budget: Optional["_MemoryBudget"] = None,
     ) -> "_ReadPipeline":
+        if self.is_recv:
+            if await self._peer_read_and_consume(executor, throughput, budget):
+                return self
+            # Peer delivery failed (owner death / abort / timeout /
+            # integrity): degrade to a direct storage read — the budget
+            # difference was already re-charged. The fallback is a REAL
+            # storage request that dispatch's slot exemption never
+            # counted, so it takes a slot here: a mass peer failure
+            # (dead owner with many units) must not flood the backend
+            # with more concurrent reads than the governor's cap.
+            if self.fallback_gate is not None:
+                async with self.fallback_gate:
+                    await self._buffered_read_and_consume(
+                        storage, executor, throughput, budget
+                    )
+            else:
+                await self._buffered_read_and_consume(
+                    storage, executor, throughput, budget
+                )
+            return self
         if self.streamed and await self._stream_read_and_consume(
             storage, executor, throughput
         ):
             return self
-        if self.streamed and budget is not None:
-            # The buffered retry holds the FULL payload while the budget
-            # only charged the streamed window: charge the difference
-            # (possibly driving availability negative, like the
-            # starvation escape) so concurrent dispatch throttles
-            # instead of overshooting the per-rank budget unaccounted.
-            delta = self.consuming_cost_bytes - self.admission_cost_bytes
-            if delta > 0:
-                budget.acquire(delta)
-                self.admission_cost_bytes = self.consuming_cost_bytes
+        await self._buffered_read_and_consume(storage, executor, throughput, budget)
+        return self
+
+    async def _buffered_read_and_consume(
+        self,
+        storage: StoragePlugin,
+        executor,
+        throughput: _Throughput,
+        budget: Optional["_MemoryBudget"] = None,
+    ) -> None:
+        # The buffered retry/fallback holds the FULL payload while the
+        # budget only charged the streamed window: charge the difference
+        # (possibly driving availability negative, like the starvation
+        # escape) so concurrent dispatch throttles instead of
+        # overshooting the per-rank budget unaccounted.
+        self._recharge(budget)
         read_io = ReadIO(
             path=self.read_req.path, byte_range=self.read_req.byte_range
         )
@@ -1082,9 +1358,14 @@ class _ReadPipeline:
         throughput.add(len(buf))
         telemetry.counter_add("bytes_read", len(buf))
         telemetry.counter_add("entries_read", 1)
+        role = self.coop_role
+        if role is not None and role.is_send:
+            telemetry.counter_add("bytes_from_storage", len(buf))
+            # Forward BEFORE the local consume: subscribers' decode
+            # pipelines start while this rank's consumer works.
+            await self._forward_buffer(role, buf)
         with telemetry.span("consume", path=self.read_req.path, bytes=len(buf)):
             await self.read_req.buffer_consumer.consume_buffer(buf, executor)
-        return self
 
 
 async def execute_read_reqs(
@@ -1092,6 +1373,7 @@ async def execute_read_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    coop=None,
 ) -> None:
     event_loop = asyncio.get_running_loop()
     executor = ThreadPoolExecutor(max_workers=_MAX_PER_RANK_CPU_CONCURRENCY)
@@ -1121,11 +1403,28 @@ async def execute_read_reqs(
     stream_all = mode == "always" or (
         read_bps is not None and read_bps < _STREAM_READ_LATENCY_BPS
     )
+    # Cooperative fan-out (fanout.py): ``coop`` is this key's CoopKeyPlan.
+    # The peer sub-chunk size is independent of the storage plugin's
+    # streaming support — the peer channel always produces chunks
+    # incrementally, and owners chunk buffered forwards at this size too.
+    peer_chunk = (
+        governor.sub_chunk_bytes(plugin_key, op="read") if coop is not None else None
+    )
     pending = [
-        _ReadPipeline(req, sub_chunk_bytes=sub_chunk, stream_all=stream_all)
+        _ReadPipeline(
+            req,
+            sub_chunk_bytes=sub_chunk,
+            stream_all=stream_all,
+            coop_plan=coop,
+            peer_sub_chunk=peer_chunk,
+        )
         for req in read_reqs
     ]
-    pending.sort(key=lambda p: p.consuming_cost_bytes, reverse=True)
+    # Peer-fed entries dispatch first (no storage I/O; draining inboxes
+    # early bounds receiver-side buffering), then owned/forwarding
+    # entries (peers are waiting on them), then plain reads — and within
+    # each class, largest first for budget packing.
+    pending.sort(key=lambda p: (p.coop_order, -p.consuming_cost_bytes))
     n_streamed = sum(1 for p in pending if p.streamed)
     if n_streamed:
         logger.debug(
@@ -1136,23 +1435,59 @@ async def execute_read_reqs(
             (sub_chunk or 0) >> 20,
         )
     inflight: Set[asyncio.Task] = set()
+    inflight_recv = 0
     io_concurrency = governor.io_concurrency("read", plugin_key)
+    if coop is not None:
+        fallback_gate = asyncio.Semaphore(io_concurrency)
+        for p in pending:
+            if p.is_recv:
+                p.fallback_gate = fallback_gate
 
     def dispatch() -> None:
-        while pending and len(inflight) < io_concurrency:
-            cost = pending[0].admission_cost_bytes
-            if cost > budget.available and inflight:
-                break
-            pipeline = pending.pop(0)
+        nonlocal inflight_recv
+
+        def launch(pipeline: _ReadPipeline) -> None:
+            nonlocal inflight_recv
             budget.acquire(pipeline.admission_cost_bytes)
+            if pipeline.is_recv:
+                inflight_recv += 1
             inflight.add(
                 event_loop.create_task(
-                    pipeline.read_and_consume(
-                        storage, executor, throughput, budget
-                    )
+                    pipeline.read_and_consume(storage, executor, throughput, budget)
                 )
             )
             reporter.inflight_io += 1
+
+        while pending:
+            head = pending[0]
+            # Peer-fed entries are exempt from the I/O slot cap: they
+            # issue no storage request while waiting, and capping them
+            # could starve the very sends that feed them. (Their direct
+            # fallbacks DO take a slot — the fallback gate below.)
+            if not head.is_recv and (len(inflight) - inflight_recv) >= io_concurrency:
+                break
+            cost = head.admission_cost_bytes
+            if cost > budget.available and inflight:
+                # Budget-blocked head. Parked peer-fed entries hold
+                # budget while WAITING on peers' forwards; if everything
+                # in flight is peer-fed, no LOCAL work will ever release
+                # budget, and the owned/plain reads that feed the fleet
+                # must not sit behind them — that head-of-line stall
+                # would idle every rank into the coop timeout. Admit the
+                # first non-peer-fed entry over budget instead (the same
+                # starvation escape the write pipeline uses); the escape
+                # self-closes once any non-recv work is in flight.
+                if inflight_recv == len(inflight):
+                    idx = next(
+                        (i for i, p in enumerate(pending) if not p.is_recv),
+                        None,
+                    )
+                    if idx is not None:
+                        telemetry.counter_add("budget_defers", 1)
+                        launch(pending.pop(idx))
+                        continue
+                break
+            launch(pending.pop(0))
 
     dispatch()
     try:
@@ -1164,6 +1499,8 @@ async def execute_read_reqs(
             for task in done:
                 pipeline = task.result()
                 budget.release(pipeline.admission_cost_bytes)
+                if pipeline.is_recv:
+                    inflight_recv -= 1
                 reporter.inflight_io -= 1
                 reporter.completed_count += 1
                 reporter.completed_bytes += pipeline.consuming_cost_bytes
@@ -1194,7 +1531,8 @@ def sync_execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
+    coop=None,
 ) -> None:
     event_loop.run_until_complete(
-        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank)
+        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank, coop=coop)
     )

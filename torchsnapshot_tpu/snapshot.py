@@ -685,6 +685,7 @@ class Snapshot:
         )
         timer = _PhaseTimer("Snapshot.restore")
         recorder = telemetry.begin_op("restore", rank)
+        coop_session = None
         try:
             metadata = self._read_metadata(storage, event_loop)
             available = get_manifest_for_rank(metadata, rank)
@@ -726,16 +727,22 @@ class Snapshot:
             # sharded entries are merged globally), so restores with
             # nothing to verify pay no extra round trips.
             #
-            # The flag is AGREED COLLECTIVELY before the key loop: each
-            # rank resolves device_digests from its own env/args and its
-            # own measured hash-vs-read economics (io_governor), so skew
-            # — a rank with TORCHSNAPSHOT_TPU_DEVICE_DIGESTS unset, or
-            # one whose measured rates favor reading — previously meant
-            # one rank skipping the per-key gather while peers entered
-            # it, hanging the restore until the 1800 s store timeout.
-            # One up-front all-gather (gated only on the rank-identical
-            # manifest condition) ANDs the local flags: any divergence
-            # degrades to no-verification everywhere, never a hang.
+            # BOTH flags are AGREED COLLECTIVELY before the key loop:
+            # each rank resolves device_digests from its own env/args
+            # and its own measured hash-vs-read economics (io_governor),
+            # so skew — a rank with TORCHSNAPSHOT_TPU_DEVICE_DIGESTS
+            # unset, or one whose measured rates favor reading —
+            # previously meant one rank skipping the per-key gather
+            # while peers entered it, hanging the restore until the
+            # 1800 s store timeout. One up-front all-gather ANDs the
+            # local flags: any divergence degrades to
+            # no-verification/direct-reads everywhere, never a hang.
+            # The cooperative fan-out election (fanout.py —
+            # TORCHSNAPSHOT_TPU_COOP_RESTORE + the governor's bandwidth
+            # gate) RIDES THE SAME all-gather: a multi-rank restore pays
+            # one flag round trip, not two. Each rank's peer-channel
+            # address travels with its opt-in; cooperation engages only
+            # when every rank offered one.
             manifest_verifiable = any(
                 isinstance(e, ShardedArrayEntry)
                 and e.shards
@@ -743,18 +750,33 @@ class Snapshot:
                 for e in available.values()
             )
             dist_verify = False
-            if pg_wrapper.get_world_size() > 1 and manifest_verifiable:
-                local_flag = bool(device_digests) and self._preverify_worthwhile(
-                    storage, explicit=explicit_digests
-                )
-                flags = pg_wrapper.all_gather_object(bool(local_flag))
-                dist_verify = all(bool(f) for f in flags)
-                if local_flag and not dist_verify:
-                    logger.info(
-                        "distributed digest verification disabled for this "
-                        "restore: not every rank opted in (env skew or "
-                        "rate-gate divergence); reading normally"
+            if pg_wrapper.get_world_size() > 1:
+                from .fanout import CoopRestoreSession
+
+                local_pre = False
+                if manifest_verifiable:
+                    local_pre = bool(
+                        device_digests
+                    ) and self._preverify_worthwhile(
+                        storage, explicit=explicit_digests
                     )
+                offer = CoopRestoreSession.local_offer(
+                    type(storage).__name__, pg_wrapper
+                )
+                gathered_flags = pg_wrapper.all_gather_object(
+                    (bool(local_pre), offer.addr)
+                )
+                if manifest_verifiable:
+                    dist_verify = all(bool(p) for p, _ in gathered_flags)
+                    if local_pre and not dist_verify:
+                        logger.info(
+                            "distributed digest verification disabled for "
+                            "this restore: not every rank opted in (env "
+                            "skew or rate-gate divergence); reading normally"
+                        )
+                coop_session = offer.engage(
+                    [a for _, a in gathered_flags], rank, event_loop
+                )
             for key in ordered:
                 prepared = None
                 if key in app_state:
@@ -771,24 +793,68 @@ class Snapshot:
                         available,
                         pg_wrapper,
                     )
+                # Read planning is hoisted ahead of execution so the
+                # cooperative plan collective can run between the two on
+                # EVERY rank — with an empty request list when this rank
+                # has nothing (missing key, planning failure): the
+                # gather is by slot, and a deserted one would hang
+                # peers. A rank contributing nothing simply isn't a
+                # requester; its would-be units stay direct elsewhere.
+                groups = None
+                flattened = None
                 if prepared is not None:
                     try:
-                        self._load_stateful(
+                        read_reqs, flattened = self._plan_stateful_reads(
                             rank=rank,
-                            stateful=app_state[key],
                             key=key,
                             available=available,
                             metadata=metadata,
-                            storage=storage,
-                            event_loop=event_loop,
-                            memory_budget=memory_budget,
                             device_digests=device_digests,
                             prepared=prepared,
                             preverified=preverified,
                         )
+                        groups = self._group_read_reqs(read_reqs)
                     except BaseException as e:  # noqa: B036
                         if exc is None:
                             exc = e
+                        groups = None
+                coop_plan = None
+                if coop_session is not None:
+                    coop_plan = coop_session.plan_for_key(
+                        [rr for _, reqs in (groups or []) for rr in reqs],
+                        pg_wrapper,
+                    )
+                if groups is not None:
+                    try:
+                        try:
+                            self._execute_grouped(
+                                groups,
+                                storage,
+                                memory_budget,
+                                rank,
+                                event_loop,
+                                origin_mirrors=metadata.origin_mirrors,
+                                coop=coop_plan,
+                            )
+                        finally:
+                            if coop_plan is not None:
+                                # Owned units never forwarded (an error
+                                # aborted this key's execution) must not
+                                # leave subscribers waiting out the coop
+                                # timeout: abort them promptly.
+                                coop_plan.abort_incomplete()
+                        self._finish_stateful_load(
+                            stateful=app_state[key],
+                            key=key,
+                            metadata=metadata,
+                            rank=rank,
+                            flattened=flattened,
+                        )
+                    except BaseException as e:  # noqa: B036
+                        if exc is None:
+                            exc = e
+                elif coop_plan is not None:
+                    coop_plan.abort_incomplete()
                 pg_wrapper.barrier()
             timer.mark("load")
             # BEFORE the raise: every rank reaches this point (per-key
@@ -805,6 +871,13 @@ class Snapshot:
                 raise exc
             timer.log()
         finally:
+            if coop_session is not None:
+                try:
+                    # Clean shutdown (bye frames) so this rank's exit is
+                    # never mistaken for a mid-restore death by peers.
+                    coop_session.close()
+                except Exception:
+                    pass
             try:
                 pg_wrapper.retire()
             except Exception:
@@ -1007,25 +1080,24 @@ class Snapshot:
             )
         return decision
 
-    def _load_stateful(
+    def _plan_stateful_reads(
         self,
         rank: int,
-        stateful: Stateful,
         key: str,
         available: Manifest,
         metadata: SnapshotMetadata,
-        storage: StoragePlugin,
-        event_loop: asyncio.AbstractEventLoop,
-        memory_budget: int,
-        device_digests: bool = False,
-        prepared: "Optional[Tuple[Any, Dict[str, Any]]]" = None,
+        device_digests: bool,
+        prepared: "Tuple[Any, Dict[str, Any]]",
         preverified: "Optional[set]" = None,
-    ) -> None:
-        if prepared is not None:
-            state_dict, flattened = prepared
-        else:
-            state_dict = stateful.state_dict()
-            _, flattened = flatten(state_dict, prefix=key)
+    ) -> "Tuple[List[ReadReq], Dict[str, Any]]":
+        """Plan one app-state key's reads WITHOUT executing them.
+
+        Split out of the load so the cooperative fan-out plan collective
+        (fanout.py) can run between planning and execution — the plan is
+        an all-gather of each rank's actual request set, so requests
+        must exist before it and execution must wait for it. Primitive
+        entries are resolved into ``flattened`` here (no I/O)."""
+        _, flattened = prepared
         preverified = preverified or set()
 
         read_reqs: List[ReadReq] = []
@@ -1064,12 +1136,16 @@ class Snapshot:
                     assume_verified=logical_path in preverified,
                 )
             )
+        return read_reqs, flattened
 
-        self._execute_read_reqs_grouped(
-            read_reqs, storage, memory_budget, rank, event_loop,
-            origin_mirrors=metadata.origin_mirrors,
-        )
-
+    def _finish_stateful_load(
+        self,
+        stateful: Stateful,
+        key: str,
+        metadata: SnapshotMetadata,
+        rank: int,
+        flattened: Dict[str, Any],
+    ) -> None:
         container_manifest = {
             p: e
             for p, e in get_manifest_for_rank(metadata, rank).items()
@@ -1165,6 +1241,28 @@ class Snapshot:
             storage.sync_close(event_loop)
             event_loop.close()
 
+    @staticmethod
+    def _group_read_reqs(
+        read_reqs: List[ReadReq], batch: bool = True
+    ) -> "List[Tuple[Optional[str], List[ReadReq]]]":
+        """Group reads by payload origin and coalesce within each group,
+        in DETERMINISTIC order (local snapshot first, then origins
+        sorted): multi-rank cooperative restores execute groups in
+        lockstep-identical order, so an owner's group-N forwards are
+        produced while its peers consume group N — never a group apart
+        by construction. Batching (read coalescing) runs per group
+        BEFORE the cooperative plan is gathered, so unit keys name the
+        exact requests the scheduler will execute."""
+        groups: Dict[Optional[str], List[ReadReq]] = {}
+        for rr in read_reqs:
+            groups.setdefault(rr.origin, []).append(rr)
+        ordered = sorted(groups.items(), key=lambda kv: (kv[0] is not None, kv[0] or ""))
+        if batch:
+            # Merge adjacent ranged reads (slab restores, chunked reads)
+            # into spanning reads — it only coalesces, never reorders data.
+            ordered = [(origin, batch_read_requests(reqs)) for origin, reqs in ordered]
+        return ordered
+
     def _execute_read_reqs_grouped(
         self,
         read_reqs: List[ReadReq],
@@ -1175,15 +1273,32 @@ class Snapshot:
         batch: bool = True,
         origin_mirrors: Optional[Dict[str, str]] = None,
     ) -> None:
-        """Execute reads, grouped by payload origin.
+        self._execute_grouped(
+            self._group_read_reqs(read_reqs, batch=batch),
+            storage,
+            memory_budget,
+            rank,
+            event_loop,
+            origin_mirrors=origin_mirrors,
+        )
+
+    def _execute_grouped(
+        self,
+        groups: "List[Tuple[Optional[str], List[ReadReq]]]",
+        storage: StoragePlugin,
+        memory_budget: int,
+        rank: int,
+        event_loop: asyncio.AbstractEventLoop,
+        origin_mirrors: Optional[Dict[str, str]] = None,
+        coop=None,
+    ) -> None:
+        """Execute grouped reads (see ``_group_read_reqs``).
 
         Incremental snapshots reference unchanged payloads in their base
         snapshot(s); those reads go through a plugin opened on the origin
         URL — wrapped with the origin's OWN mirror (recorded in this
         snapshot's ``origin_mirrors``) so deduplicated payloads survive
-        the loss of a base's primary tier. Batching (read coalescing)
-        runs per group — merging ranges across different origins would
-        read from the wrong storage.
+        the loss of a base's primary tier.
 
         Coalescing composes with the streaming read path: adjacent
         byte-ranged reads into the same batched-slab location merge into
@@ -1192,18 +1307,17 @@ class Snapshot:
         (BatchedBufferConsumer.consume_stream), so the many-small-
         ranged-GET restore pattern becomes a few large sequential reads
         without ever materializing the spanning payload.
+
+        ``coop``: this key's cooperative fan-out plan (fanout.py) —
+        unit keys carry the origin, so each group's execution matches
+        only its own units, and origin-borrowed replicated payloads
+        (incremental chains) are read once from the BASE's storage by
+        their owner and forwarded, exactly like local ones.
         """
-        groups: Dict[Optional[str], List[ReadReq]] = {}
-        for rr in read_reqs:
-            groups.setdefault(rr.origin, []).append(rr)
-        for origin, reqs in groups.items():
-            # Merge adjacent ranged reads (slab restores, chunked reads)
-            # into spanning reads — it only coalesces, never reorders data.
-            if batch:
-                reqs = batch_read_requests(reqs)
+        for origin, reqs in groups:
             if origin is None:
                 sync_execute_read_reqs(
-                    reqs, storage, memory_budget, rank, event_loop
+                    reqs, storage, memory_budget, rank, event_loop, coop=coop
                 )
                 continue
             from .storage_plugin import strip_mirror_options
@@ -1220,7 +1334,8 @@ class Snapshot:
             )
             try:
                 sync_execute_read_reqs(
-                    reqs, origin_storage, memory_budget, rank, event_loop
+                    reqs, origin_storage, memory_budget, rank, event_loop,
+                    coop=coop,
                 )
             except FileNotFoundError as e:
                 where = (
@@ -1734,12 +1849,16 @@ def _partition_write_units(
             owned_objects.add(logical_path)
 
     # Greedy: largest first, to the least-loaded rank; all ties broken
-    # deterministically so every rank computes the identical plan.
+    # deterministically so every rank computes the identical plan. The
+    # assignment itself lives in fanout.greedy_size_balanced — SHARED
+    # with the restore-side cooperative fan-out so save striping and
+    # restore partitioning can never skew (bit-identical to the
+    # historical inline loop for the same input).
+    from .fanout import greedy_size_balanced
+
     pool.sort(key=lambda t: (-t[0], t[1], t[2] or ([], [])))
-    loads = [0] * world_size
-    for nbytes, logical_path, chunk in pool:
-        target = min(range(world_size), key=lambda r: (loads[r], r))
-        loads[target] += nbytes
+    owners = greedy_size_balanced([t[0] for t in pool], world_size)
+    for (nbytes, logical_path, chunk), target in zip(pool, owners):
         if target == rank:
             if chunk is None:
                 owned_objects.add(logical_path)

@@ -239,10 +239,10 @@ class FSStoragePlugin(StoragePlugin):
         dies, so reuse can never alias a live chunk."""
         # Imported here, not at module load: io_preparers.array imports
         # jax-adjacent machinery this plugin must not require at import.
-        from ..io_preparers.array import _staging_pool
+        from ..io_preparers.array import pooled_buffer
 
         size = hi - lo
-        buf = _staging_pool.get(size)
+        buf = pooled_buffer(size)
         view = memoryview(buf)
         got = 0
         while got < size:
