@@ -1,0 +1,363 @@
+"""Chaos soak: randomized (seeded) fault schedules against real takes,
+plus the disabled-injector overhead leg.
+
+Two legs:
+
+``--soak`` (default; ``--iterations N``, default 40)
+    Generates N seeded fault plans over the write-path sites — random
+    site, trigger hit, action, and corruption offsets drawn from ONE
+    seeded RNG, so a failing iteration replays from its printed plan
+    string — runs a real SIGKILL-capable take under each in a
+    subprocess, and asserts the crash-consistency invariant every time:
+    the run either commits a bit-exact restorable snapshot or leaves
+    the previous snapshot restorable and fsck-clean (and a committed
+    snapshot that does NOT restore bit-exact must be fsck-dirty).
+    This is the open-ended complement to the deterministic tier-1
+    matrix (tests/test_chaos_matrix.py): same invariant, unbounded
+    schedule space.
+
+``--overhead``
+    The acceptance gate for the injector's disabled hot path: times a
+    ~2 GiB save with the injector disabled (one module-global flag
+    check per site hit — the shipping configuration) against the same
+    save with the shim bypassed entirely (site/mutate monkeypatched to
+    raw no-ops), and ASSERTS the best-vs-best delta is under 1% (with a
+    50 ms absolute floor — bench.py's recipe for this bimodal host).
+
+Usage::
+
+    python benchmarks/chaos_soak.py --soak --iterations 40 --seed 7
+    python benchmarks/chaos_soak.py --overhead
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.bench_utils import report  # noqa: E402
+
+# Write-path sites a soak take can hit (read sites are covered by the
+# deterministic matrix; the soak's focus is commit-protocol integrity).
+_SOAK_SITES = [
+    "fs.write", "fs.pwrite", "scheduler.stage", "commit.metadata",
+]
+_SOAK_ACTIONS = [
+    "transient", "permanent", "kill", "corrupt", "truncate:0.5",
+    "delay:0.01",
+]
+
+_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+
+root, plan = sys.argv[1], sys.argv[2]
+
+def state(seed):
+    rng = np.random.default_rng(seed)
+    return {"model": StateDict(
+        **{f"p{i}": rng.standard_normal(400_000).astype(np.float32)
+           for i in range(4)}
+    )}
+
+if plan:
+    faultinject.configure(plan)
+Snapshot.take(os.path.join(root, "cur"), state(1))
+"""
+
+
+def _expected_state(seed: int):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {
+        f"p{i}": rng.standard_normal(400_000).astype(np.float32)
+        for i in range(4)
+    }
+
+
+def _random_plan(rng: random.Random) -> str:
+    site = rng.choice(_SOAK_SITES)
+    action = rng.choice(_SOAK_ACTIONS)
+    hit = rng.randint(1, 8)
+    trigger = f"{hit}+" if rng.random() < 0.3 else str(hit)
+    return f"{site}@{trigger}={action};seed={rng.randint(0, 2**31)}"
+
+
+def _run_soak_iteration(root: str, plan: str) -> str:
+    """One seeded schedule; returns the outcome label. Raises on any
+    invariant violation."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.cli import run_fsck
+
+    cur = os.path.join(root, "cur")
+    shutil.rmtree(cur, ignore_errors=True)
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD, root, plan],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    killed = r.returncode == -signal.SIGKILL
+    # Aborts must trace back to the plan, not to an unrelated crash.
+    # Downstream consequences count: a corrupted/truncated fence write
+    # surfaces as StaleCommitError (the commit refusing to trust a fence
+    # it can no longer read) — that IS the protocol working.
+    fault_signature = any(
+        s in r.stderr
+        for s in ("Injected", "fault injection", "StaleCommitError")
+    )
+    if not killed and r.returncode != 0 and not fault_signature:
+        raise AssertionError(
+            f"plan {plan!r}: child failed outside the injector "
+            f"(rc={r.returncode}):\n{r.stderr[-2000:]}"
+        )
+
+    committed = os.path.exists(os.path.join(cur, ".snapshot_metadata"))
+    expected = _expected_state(1)
+    if committed:
+        dst = {
+            "model": StateDict(
+                **{k: np.zeros_like(v) for k, v in expected.items()}
+            )
+        }
+        exact = False
+        try:
+            Snapshot(cur).restore(dst)
+            exact = all(
+                np.array_equal(dst["model"][k], expected[k]) for k in expected
+            )
+        except Exception:  # noqa: BLE001
+            exact = False
+        if exact:
+            return "committed"
+        code, _ = run_fsck(cur, echo=lambda *a, **k: None)
+        if code == 0:
+            raise AssertionError(
+                f"plan {plan!r}: committed, not bit-exact restorable, fsck "
+                "clean — SILENT CORRUPTION"
+            )
+        return "committed-detectable"
+    # Nothing committed: prev must be restorable + fsck-clean.
+    prev = os.path.join(root, "prev")
+    prev_expected = _expected_state(0)
+    dst = {
+        "model": StateDict(
+            **{k: np.zeros_like(v) for k, v in prev_expected.items()}
+        )
+    }
+    Snapshot(prev).restore(dst)
+    assert all(
+        np.array_equal(dst["model"][k], prev_expected[k])
+        for k in prev_expected
+    ), f"plan {plan!r}: previous snapshot damaged"
+    code, _ = run_fsck(prev, echo=lambda *a, **k: None)
+    assert code == 0, f"plan {plan!r}: previous snapshot not fsck-clean"
+    if os.path.isdir(cur):
+        code, _ = run_fsck(cur, echo=lambda *a, **k: None)
+        assert code in (1, 2), f"plan {plan!r}: rubble fsck'd clean"
+    return "killed" if killed else "aborted"
+
+
+def soak(iterations: int, seed: int) -> None:
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    rng = random.Random(seed)
+    root = tempfile.mkdtemp(prefix="chaos_soak_")
+    try:
+        Snapshot.take(
+            os.path.join(root, "prev"),
+            {
+                "model": StateDict(
+                    **{k: v for k, v in _expected_state(0).items()}
+                )
+            },
+        )
+        outcomes: dict = {}
+        t0 = time.perf_counter()
+        for it in range(iterations):
+            plan = _random_plan(rng)
+            outcome = _run_soak_iteration(root, plan)
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+            print(
+                json.dumps({"iter": it, "plan": plan, "outcome": outcome}),
+                flush=True,
+            )
+        report(
+            "chaos_soak",
+            {
+                "iterations": iterations,
+                "seed": seed,
+                "outcomes": outcomes,
+                "wall_s": round(time.perf_counter() - t0, 3),
+            },
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def overhead(trials: int = 5) -> None:
+    """Disabled-injector overhead on a ~2 GiB save: flag-check shim vs
+    bypassed shim. Asserts best-vs-best delta < 1% with a 50 ms floor
+    (ISSUE 5 acceptance)."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+
+    nbytes = 2 << 30
+    n_arrays = 8
+    per = nbytes // n_arrays // 4
+    state = {
+        "model": StateDict(
+            **{
+                f"p{i}": np.random.default_rng(i)
+                .standard_normal(per)
+                .astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+    }
+
+    try:
+        import psutil
+    except ImportError:  # pragma: no cover - baked into the image
+        psutil = None
+    proc = psutil.Process() if psutil is not None else None
+
+    def timed_save() -> tuple:
+        """One save's (wall, cpu/wall ratio). The save is CPU-bound on
+        tmpfs (memcpy + CRC), so a clean trial's process CPU time ~=
+        wall; when the host steals the core or reclaims pages mid-window
+        wall inflates while CPU time doesn't — same DURING-trial
+        contention detector bench.py uses."""
+        root = tempfile.mkdtemp(prefix="chaos_overhead_")
+        try:
+            cpu0 = proc.cpu_times() if proc is not None else None
+            t0 = time.perf_counter()
+            Snapshot.take(os.path.join(root, "s"), state)
+            wall = time.perf_counter() - t0
+            if cpu0 is None:
+                return wall, 1.0
+            cpu1 = proc.cpu_times()
+            busy = (cpu1.user - cpu0.user) + (cpu1.system - cpu0.system)
+            return wall, busy / max(wall, 1e-9)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    def bypassed(fn):
+        saved = (faultinject.site, faultinject.mutate)
+        faultinject.site = lambda name: None
+        faultinject.mutate = lambda name, buf: buf
+        try:
+            return fn()
+        finally:
+            faultinject.site, faultinject.mutate = saved
+
+    # One discarded warmup save: the FIRST take of a process pays the
+    # staging-pool first-touch faults and page-cache population — ~30x a
+    # warm save — which would otherwise land entirely on one leg.
+    faultinject.disable()
+    timed_save()
+    # Paired trials with ALTERNATING leg order: the second save of a
+    # back-to-back pair periodically eats a multi-second page-reclaim
+    # stall from the first save's 2 GiB rmtree (measured 0.8 s vs 5.8 s
+    # on this lazily-backed VM). A fixed order pins that stall to one
+    # leg and measures the host, not the shim; alternating cancels the
+    # positional bias, and contended pairs (either leg's cpu/wall below
+    # the bench.py 0.6 threshold) are discarded and retried, bounded.
+    bypass_walls, shim_walls = [], []
+    contended = []
+    # Best-vs-best with an absolute floor and early stop — bench.py's
+    # telemetry-leg recipe for exactly this host: bimodal trials (reclaim
+    # stalls, hypervisor steals) only ever INFLATE a wall time, so each
+    # leg's min is the honest estimate of its intrinsic cost, and one
+    # shim trial landing within budget of the bypass best already proves
+    # the flag check is cheap. The 50 ms floor keeps the gate meaningful
+    # when a contended host drags both legs around: 236 shim calls per
+    # 2 GiB save cost microseconds, not percents.
+    max_pairs = 2 * trials
+    for pair in range(max_pairs):
+        if pair % 2 == 0:
+            byp, byp_ratio = bypassed(timed_save)
+            faultinject.disable()
+            shim, shim_ratio = timed_save()
+        else:
+            faultinject.disable()
+            shim, shim_ratio = timed_save()
+            byp, byp_ratio = bypassed(timed_save)
+        # cpu/wall ratio is the DURING-trial contention detector (the
+        # save is CPU-bound on tmpfs); flagged trials still count into
+        # the mins — noise can only make the gate pessimistic — but are
+        # recorded for audit.
+        if proc is not None and min(byp_ratio, shim_ratio) < 0.6:
+            contended.append(
+                {"bypass_s": round(byp, 3), "shim_s": round(shim, 3)}
+            )
+        bypass_walls.append(byp)
+        shim_walls.append(shim)
+        budget_s = max(0.01 * min(bypass_walls), 0.05)
+        if pair + 1 >= trials and (
+            min(shim_walls) - min(bypass_walls)
+        ) < budget_s:
+            break
+    bypass_best = min(bypass_walls)
+    shim_best = min(shim_walls)
+    budget_s = max(0.01 * bypass_best, 0.05)
+    delta = (shim_best - bypass_best) / bypass_best
+    report(
+        "chaos_overhead",
+        {
+            "gib": round(nbytes / (1 << 30), 2),
+            "pairs": len(bypass_walls),
+            "bypass_trials_s": [round(t, 3) for t in bypass_walls],
+            "shim_trials_s": [round(t, 3) for t in shim_walls],
+            "bypass_best_s": round(bypass_best, 3),
+            "shim_best_s": round(shim_best, 3),
+            "overhead_pct": round(delta * 100, 3),
+            "contended_pairs": contended,
+        },
+        data_bytes=nbytes,
+    )
+    assert (shim_best - bypass_best) < budget_s, (
+        f"disabled-injector overhead {delta * 100:.2f}% over the 1% budget "
+        f"(bypass best {bypass_best:.3f}s vs shim best {shim_best:.3f}s, "
+        f"floor 50 ms)"
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--soak", action="store_true")
+    parser.add_argument("--overhead", action="store_true")
+    parser.add_argument("--iterations", type=int, default=40)
+    parser.add_argument("--seed", type=int, default=0xC4A05)
+    parser.add_argument("--trials", type=int, default=5)
+    args = parser.parse_args()
+    if not (args.soak or args.overhead):
+        args.soak = True
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.soak:
+        soak(args.iterations, args.seed)
+    if args.overhead:
+        overhead(args.trials)
+
+
+if __name__ == "__main__":
+    main()

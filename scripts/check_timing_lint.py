@@ -50,6 +50,7 @@ BENCHMARK_ALLOWLIST = {
     "async_stall.py",
     "attention_bench.py",
     "bench_utils.py",
+    "chaos_soak.py",  # soak wall + the disabled-injector overhead gate
     "coop_restore.py",  # fan-out vs direct restore walls time wall clock
     "device_dedup.py",
     "dist_verify.py",

@@ -16,17 +16,30 @@ from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
 from torchsnapshot_tpu.test_utils import run_with_subprocesses
 
 
+# The commit fence (.snapshot_fence) is a control file written
+# synchronously at plan time — BEFORE async_take returns, which is what
+# makes the fenced GC sound (see snapshot._take_impl). Slow/faulty
+# payload-write plugins must exempt it: these tests target the PAYLOAD
+# write path (staged in the background), not the fence plant.
+def _is_payload(write_io: WriteIO) -> bool:
+    return not (
+        write_io.path == SNAPSHOT_METADATA_FNAME
+        or write_io.path.endswith(".snapshot_fence")
+    )
+
+
 class SlowFSStoragePlugin(FSStoragePlugin):
     WRITE_DELAY_S = 1.0
 
     async def write(self, write_io: WriteIO) -> None:
-        await asyncio.sleep(self.WRITE_DELAY_S)
+        if _is_payload(write_io):
+            await asyncio.sleep(self.WRITE_DELAY_S)
         await super().write(write_io)
 
 
 class FaultyFSStoragePlugin(FSStoragePlugin):
     async def write(self, write_io: WriteIO) -> None:
-        if write_io.path != SNAPSHOT_METADATA_FNAME:
+        if _is_payload(write_io):
             raise RuntimeError("injected storage failure")
         await super().write(write_io)
 

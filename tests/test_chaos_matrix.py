@@ -1,0 +1,576 @@
+"""Seeded chaos matrix: deterministic fault schedules against real takes
+and restores, asserting the library's core invariant on every one:
+
+    every faulted run either commits a bit-exact restorable snapshot, or
+    leaves the previous snapshot restorable and the directory fsck-clean
+    — and a commit that is NOT bit-exact restorable must be fsck-dirty
+    (detectable), never silently wrong.
+
+The matrix spans the fs, s3-emulated (FakeS3Client), and mirrored
+backends at world size 1 in-process, world size 2 via the subprocess
+launcher, SIGKILL schedules in real subprocesses, and the bounded
+barrier-deadline drill (TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT) for rank
+death mid-plan. Schedules are plain fault-plan strings — replay any of
+them outside the suite with TORCHSNAPSHOT_TPU_FAULT_PLAN=<plan>.
+
+A slow randomized soak over the same invariant lives in
+benchmarks/chaos_soak.py.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+from torchsnapshot_tpu.cli import run_fsck
+from torchsnapshot_tpu.manifest import CorruptSnapshotError
+from torchsnapshot_tpu.storage_plugins.retry import CollectiveRetryStrategy
+
+
+def _state(seed: int, big: bool = False) -> dict:
+    rng = np.random.default_rng(seed)
+    leaves = {
+        "w": rng.standard_normal(20_000).astype(np.float32),
+        "b": rng.standard_normal(3_000).astype(np.float64),
+        "step": np.array([seed], dtype=np.int64),
+    }
+    if big:
+        # Large enough for the streaming write election (sub-chunk
+        # pwrites), so fs.pwrite schedules hit a live site.
+        leaves["big"] = rng.standard_normal(3_000_000).astype(np.float32)
+    return {"model": StateDict(**leaves)}
+
+
+def _zeros_like(state: dict) -> dict:
+    return {
+        "model": StateDict(
+            **{
+                k: np.zeros_like(np.asarray(v))
+                for k, v in state["model"].items()
+            }
+        )
+    }
+
+
+def _equal(a: dict, b: dict) -> bool:
+    return all(
+        np.array_equal(np.asarray(a["model"][k]), np.asarray(b["model"][k]))
+        for k in a["model"]
+    )
+
+
+def _committed(path: str, opts) -> bool:
+    try:
+        Snapshot(path, storage_options=opts).metadata
+        return True
+    except Exception:  # noqa: BLE001 - missing, corrupt, backend-specific
+        return False
+
+
+async def _nosleep(_s: float) -> None:
+    return None
+
+
+def _backend(kind: str, tmp_path):
+    """(prev_path, cur_path, storage_options, fsck_opts, local_cur)."""
+    if kind == "fs":
+        return (
+            str(tmp_path / "prev"),
+            str(tmp_path / "cur"),
+            None,
+            None,
+            str(tmp_path / "cur"),
+        )
+    if kind == "s3":
+        from tests.test_s3_storage_plugin import FakeS3Client
+
+        opts = {
+            "client": FakeS3Client(),
+            "retry_strategy": CollectiveRetryStrategy(
+                stall_timeout_s=0.5, sleep=_nosleep
+            ),
+        }
+        return ("s3://bucket/prev", "s3://bucket/cur", opts, opts, None)
+    if kind == "mirror":
+        def opts_for(name):
+            return {"mirror_url": str(tmp_path / f"mirror_{name}")}
+
+        return (
+            str(tmp_path / "prev"),
+            str(tmp_path / "cur"),
+            opts_for("cur"),
+            None,
+            str(tmp_path / "cur"),
+        )
+    raise AssertionError(kind)
+
+
+def _check_take_invariant(
+    backend, tmp_path, plan: str, big: bool = False
+) -> str:
+    """Run one take-phase schedule; assert the binary invariant."""
+    state0, state1 = _state(0, big), _state(1, big)
+    prev, cur, opts, fsck_opts, local_cur = _backend(backend, tmp_path)
+    prev_opts = (
+        {"mirror_url": str(tmp_path / "mirror_prev")}
+        if backend == "mirror"
+        else opts
+    )
+    Snapshot.take(prev, state0, storage_options=prev_opts)
+
+    faultinject.configure(plan)
+    err = None
+    try:
+        Snapshot.take(cur, state1, storage_options=opts)
+    except BaseException as e:  # noqa: B036
+        err = e
+    finally:
+        faultinject.disable()
+
+    if _committed(cur, fsck_opts):
+        dst = _zeros_like(state1)
+        exact = False
+        try:
+            Snapshot(cur, storage_options=fsck_opts).restore(dst)
+            exact = _equal(dst, state1)
+        except Exception:  # noqa: BLE001
+            exact = False
+        if not exact:
+            # Committed-but-not-restorable is tolerable ONLY when fsck
+            # can see it — silent corruption is the bug class this
+            # matrix exists to catch.
+            code, report = run_fsck(cur, storage_options=fsck_opts)
+            assert code != 0, (
+                f"plan {plan!r}: committed, not bit-exact restorable, and "
+                f"fsck reports clean — silent corruption"
+            )
+            return "committed-detectable"
+        return "committed"
+
+    # Not committed: the previous snapshot must be untouched and the
+    # rubble must read as a partial/corrupt commit, never as a valid
+    # snapshot. Normally the take also surfaced a failure; the one
+    # exception is storage silently corrupting the metadata bytes at the
+    # commit point (corrupt/truncate plans on commit.metadata), where the
+    # writer cannot know — there, detection is fsck's job.
+    if err is None:
+        assert local_cur is not None, (
+            f"plan {plan!r}: no commit and no error on a backend fsck "
+            "cannot scan"
+        )
+        code, _ = run_fsck(local_cur, storage_options=fsck_opts)
+        assert code == 1, (
+            f"plan {plan!r}: take reported success, nothing committed, and "
+            f"fsck exits {code} — a silent non-commit"
+        )
+    dst0 = _zeros_like(state0)
+    Snapshot(prev, storage_options=prev_opts).restore(dst0)
+    assert _equal(dst0, state0), f"plan {plan!r}: previous snapshot damaged"
+    code, _ = run_fsck(prev, storage_options=prev_opts)
+    assert code == 0, f"plan {plan!r}: previous snapshot not fsck-clean"
+    if local_cur is not None and os.path.isdir(local_cur):
+        code, _ = run_fsck(local_cur, storage_options=fsck_opts)
+        assert code in (1, 2), f"plan {plan!r}: rubble fsck'd clean"
+    return "aborted"
+
+
+def _check_restore_invariant(backend, tmp_path, plan: str) -> str:
+    """Run one restore-phase schedule: a faulted restore must either
+    deliver bit-exact data or raise — never return silently-wrong bytes
+    — and a clean retry afterwards must succeed bit-exact."""
+    state1 = _state(1)
+    _prev, cur, opts, fsck_opts, _local = _backend(backend, tmp_path)
+    Snapshot.take(cur, state1, storage_options=opts)
+
+    faultinject.configure(plan)
+    dst = _zeros_like(state1)
+    err = None
+    try:
+        Snapshot(cur, storage_options=opts).restore(dst)
+    except Exception as e:  # noqa: BLE001
+        err = e
+    finally:
+        faultinject.disable()
+    if err is None:
+        assert _equal(dst, state1), (
+            f"plan {plan!r}: restore returned silently-wrong data"
+        )
+        outcome = "restored"
+    else:
+        outcome = "raised"
+
+    dst2 = _zeros_like(state1)
+    Snapshot(cur, storage_options=opts).restore(dst2)
+    assert _equal(dst2, state1), f"plan {plan!r}: clean retry not bit-exact"
+    code, _ = run_fsck(cur, storage_options=fsck_opts)
+    assert code == 0, f"plan {plan!r}: snapshot dirtied by a faulted restore"
+    return outcome
+
+
+# --------------------------------------------------------- world size 1
+
+FS_TAKE_PLANS = [
+    "fs.write@1=transient",                 # the fence write itself
+    "fs.write@2=transient",                 # first payload
+    "fs.write@2=permanent",
+    "fs.write@3=permanent",
+    "scheduler.stage@1=permanent",
+    "scheduler.stage@2=transient",
+    "commit.metadata@1=corrupt;seed=11",    # torn commit point
+    "commit.metadata@1=truncate:0.3",
+    "fs.write@2=corrupt;seed=12",           # silent write corruption
+    "fs.write@3=truncate:0.5",
+    "fs.write@p0.4=transient;seed=1",
+    "fs.write@p0.4=transient;seed=2",
+    "fs.write@p0.2=permanent;seed=3",
+    "fs.write@50=transient",                # past the write window: no-op
+    "fs.write@2=delay:0.02;fs.write@3=delay:0.02",
+]
+
+
+@pytest.mark.parametrize("plan", FS_TAKE_PLANS)
+def test_chaos_fs_take(tmp_path, plan):
+    outcome = _check_take_invariant("fs", tmp_path, plan)
+    if plan in ("fs.write@50=transient",
+                "fs.write@2=delay:0.02;fs.write@3=delay:0.02"):
+        assert outcome == "committed"
+    if plan.startswith("scheduler.stage@1") or plan.startswith("fs.write@1="):
+        assert outcome == "aborted"
+
+
+def test_chaos_fs_take_streamed_pwrite(tmp_path):
+    outcome = _check_take_invariant(
+        "fs", tmp_path, "fs.pwrite@2=transient", big=True
+    )
+    assert outcome in ("aborted", "committed")
+
+
+FS_RESTORE_PLANS = [
+    "fs.read@1=permanent",
+    "fs.read@2=transient",
+    "fs.read@1=corrupt;seed=5",
+    "fs.read@1=truncate:0.5",
+    "fs.read@p0.5=transient;seed=6",
+    "fs.read@2=delay:0.02",
+]
+
+
+@pytest.mark.parametrize("plan", FS_RESTORE_PLANS)
+def test_chaos_fs_restore(tmp_path, plan):
+    outcome = _check_restore_invariant("fs", tmp_path, plan)
+    if plan == "fs.read@2=delay:0.02":
+        assert outcome == "restored"
+    if plan in ("fs.read@1=permanent", "fs.read@1=corrupt;seed=5"):
+        assert outcome == "raised"
+
+
+S3_TAKE_PLANS = [
+    "s3.put@1=transient",            # absorbed by the collective retry
+    "s3.put@p0.5=transient;seed=4",  # every attempt eventually lands
+    "s3.put@1+=transient",           # service down: fleet gives up
+    "s3.put@2=permanent",
+    "s3.put@2=corrupt;seed=6",       # corrupt stored object
+    "s3.put@2=truncate:0.5",
+]
+
+
+@pytest.mark.parametrize("plan", S3_TAKE_PLANS)
+def test_chaos_s3_take(tmp_path, plan):
+    outcome = _check_take_invariant("s3", tmp_path, plan)
+    if plan in ("s3.put@1=transient", "s3.put@p0.5=transient;seed=4"):
+        # Transient blips must be absorbed by retry, not abort the take.
+        assert outcome == "committed"
+    if plan == "s3.put@1+=transient":
+        assert outcome == "aborted"
+
+
+S3_RESTORE_PLANS = [
+    "s3.get@1=transient",       # retried
+    "s3.get@1+=permanent",      # service down
+    "s3.get@2=corrupt;seed=9",  # checksum catches it
+]
+
+
+@pytest.mark.parametrize("plan", S3_RESTORE_PLANS)
+def test_chaos_s3_restore(tmp_path, plan):
+    outcome = _check_restore_invariant("s3", tmp_path, plan)
+    if plan == "s3.get@1=transient":
+        assert outcome == "restored"
+
+
+MIRROR_TAKE_PLANS = [
+    "fs.write@3=transient",   # may hit either tier; binary outcome holds
+    "fs.write@4=permanent",
+    "fs.write@p0.3=transient;seed=8",
+]
+
+
+@pytest.mark.parametrize("plan", MIRROR_TAKE_PLANS)
+def test_chaos_mirror_take(tmp_path, plan):
+    _check_take_invariant("mirror", tmp_path, plan)
+    # Two-tier commit order: a committed mirror implies a committed
+    # primary (mirror metadata is deferred until payload replication
+    # drained) — never the other way around.
+    mirror_meta = tmp_path / "mirror_cur" / ".snapshot_metadata"
+    if mirror_meta.exists():
+        assert (tmp_path / "cur" / ".snapshot_metadata").exists()
+
+
+MIRROR_RESTORE_PLANS = [
+    "mirror.primary_read@1=permanent",    # one read fails over
+    "mirror.primary_read@1+=permanent",   # total primary loss
+    "mirror.primary_read@2=transient",
+]
+
+
+@pytest.mark.parametrize("plan", MIRROR_RESTORE_PLANS)
+def test_chaos_mirror_restore(tmp_path, plan):
+    outcome = _check_restore_invariant("mirror", tmp_path, plan)
+    # Failover is transparent: the mirror serves the bytes, bit-exact.
+    assert outcome == "restored"
+
+
+def test_chaos_mirror_total_primary_loss_restores_from_mirror(tmp_path):
+    """Not a plan-string schedule but the same invariant: wipe the whole
+    primary payload tree after commit; the mirror serves the restore."""
+    import shutil
+
+    state1 = _state(1)
+    opts = {"mirror_url": str(tmp_path / "mirror_cur")}
+    cur = str(tmp_path / "cur")
+    Snapshot.take(cur, state1, storage_options=opts)
+    shutil.rmtree(tmp_path / "cur" / "0")
+    dst = _zeros_like(state1)
+    Snapshot(cur, storage_options=opts).restore(dst)
+    assert _equal(dst, state1)
+
+
+# ------------------------------------------------------ SIGKILL schedules
+
+KILL_PLANS = [
+    "fs.write@2=kill",         # mid first payload
+    "fs.write@4=kill",         # later in the write window
+    "commit.metadata@1=kill",  # exactly at the commit point
+]
+
+_KILL_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+
+root, plan = sys.argv[1], sys.argv[2]
+
+def state(seed):
+    rng = np.random.default_rng(seed)
+    return {"model": StateDict(
+        w=rng.standard_normal(20_000).astype(np.float32),
+        b=rng.standard_normal(3_000).astype(np.float64),
+        step=np.array([seed], dtype=np.int64),
+    )}
+
+Snapshot.take(os.path.join(root, "prev"), state(0))
+faultinject.configure(plan)
+Snapshot.take(os.path.join(root, "cur"), state(1))
+print("SURVIVED")  # only reachable if the plan never fired
+"""
+
+
+@pytest.mark.parametrize("plan", KILL_PLANS)
+def test_chaos_sigkill(tmp_path, plan):
+    r = subprocess.run(
+        [sys.executable, "-c", _KILL_CHILD, str(tmp_path), plan],
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+    assert "SURVIVED" not in r.stdout
+    cur = str(tmp_path / "cur")
+    assert not os.path.exists(os.path.join(cur, ".snapshot_metadata"))
+    # The previous snapshot is untouched and fsck-clean.
+    state0 = _state(0)
+    dst = _zeros_like(state0)
+    Snapshot(str(tmp_path / "prev")).restore(dst)
+    assert _equal(dst, state0)
+    assert run_fsck(str(tmp_path / "prev"))[0] == 0
+    # The rubble reads as a partial commit (or nothing at all).
+    if os.path.isdir(cur):
+        assert run_fsck(cur)[0] in (1, 2)
+
+
+# --------------------------------------------------------- world size 2
+
+
+def _w2_state(rank: int, seed: int) -> dict:
+    rng = np.random.default_rng(1000 * rank + seed)
+    return {
+        "model": StateDict(
+            w=rng.standard_normal(8_000).astype(np.float32),
+            step=np.array([seed], dtype=np.int64),
+        )
+    }
+
+
+def _w2_take_worker(rank: int, world_size: int, root: str, plan: str,
+                    victim: int):
+    from torchsnapshot_tpu import faultinject as fi
+
+    state0, state1 = _w2_state(rank, 0), _w2_state(rank, 1)
+    Snapshot.take(os.path.join(root, "prev"), state0)
+    if rank == victim:
+        fi.configure(plan)
+    err = None
+    try:
+        Snapshot.take(os.path.join(root, "cur"), state1)
+    except BaseException as e:  # noqa: B036
+        err = repr(e)
+    finally:
+        fi.disable()
+    prev_ok = False
+    dst = _zeros_like(state0)
+    Snapshot(os.path.join(root, "prev")).restore(dst)
+    prev_ok = _equal(dst, state0)
+    return {"err": err, "prev_ok": prev_ok}
+
+
+W2_TAKE_PLANS = [
+    ("scheduler.stage@1=permanent", 1),
+    ("fs.write@2=transient", 0),
+    ("fs.write@1=permanent", 1),  # rank 1's first payload write
+    # Drain-phase desertion regression: the delay parks the write task
+    # past the manifest gather, so the transient fires inside rank 0's
+    # post-gather sync_complete — the phase whose failures used to desert
+    # peers at the commit barrier until the 1800 s timeout (now
+    # propagated through the wrapper error channel).
+    ("fs.write@2=delay:0.3;fs.write@2=transient", 0),
+]
+
+
+@pytest.mark.parametrize("plan,victim", W2_TAKE_PLANS)
+def test_chaos_w2_take_abort_is_collective(tmp_path, plan, victim):
+    """One rank's fault aborts the take on EVERY rank, commits nothing,
+    and leaves the previous snapshot restorable on every rank."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _w2_take_worker, 2, str(tmp_path), plan, victim, timeout=180.0
+    )
+    for rank, out in results.items():
+        assert out["err"] is not None, (rank, plan)
+        assert out["prev_ok"], (rank, plan)
+    assert not os.path.exists(tmp_path / "cur" / ".snapshot_metadata")
+    assert run_fsck(str(tmp_path / "prev"))[0] == 0
+
+
+def _w2_restore_worker(rank: int, world_size: int, root: str, plan: str,
+                       victim: int):
+    from torchsnapshot_tpu import faultinject as fi
+
+    state1 = _w2_state(rank, 1)
+    Snapshot.take(os.path.join(root, "cur"), state1)
+    if rank == victim:
+        fi.configure(plan)
+    err = None
+    dst = _zeros_like(state1)
+    try:
+        Snapshot(os.path.join(root, "cur")).restore(dst)
+    except Exception as e:  # noqa: BLE001
+        err = repr(e)
+    finally:
+        fi.disable()
+    silently_wrong = err is None and not _equal(dst, state1)
+    dst2 = _zeros_like(state1)
+    Snapshot(os.path.join(root, "cur")).restore(dst2)
+    return {
+        "err": err,
+        "silently_wrong": silently_wrong,
+        "retry_ok": _equal(dst2, state1),
+    }
+
+
+def test_chaos_w2_restore_fault_is_local_and_recoverable(tmp_path):
+    """A rank's read fault during a collective restore fails THAT rank
+    cleanly (no hang, no silent corruption) and a clean retry restores
+    bit-exact everywhere."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    # Hit 2, not 1: hit 1 is the .snapshot_metadata read, which fails
+    # BEFORE the restore's first collective — an asymmetric pre-collective
+    # abort that deserts rank 0's gather (bounded only by the barrier
+    # timeout). Payload reads (hit 2 on) fail inside the lockstep-
+    # protected key loop, the contract this drill exercises.
+    results = run_with_subprocesses(
+        _w2_restore_worker, 2, str(tmp_path), "fs.read@2=permanent", 1,
+        timeout=180.0,
+    )
+    for rank, out in results.items():
+        assert not out["silently_wrong"], rank
+        assert out["retry_ok"], rank
+    assert results[1]["err"] is not None
+
+
+def _w2_rpc_death_worker(rank: int, world_size: int, root: str):
+    from torchsnapshot_tpu import faultinject as fi
+
+    state1 = _w2_state(rank, 1)
+    if rank == 1:
+        # Kill the coordination plane under rank 1 mid-take: every store
+        # round trip from hit 6 on fails (the take's collectives start
+        # around there; earlier hits cover the launcher's own plumbing).
+        fi.configure("dist_store.rpc@6+=transient")
+    err = None
+    try:
+        Snapshot.take(os.path.join(root, "cur"), state1)
+    except BaseException as e:  # noqa: B036
+        err = repr(e)
+    finally:
+        fi.disable()
+    return err
+
+
+def test_chaos_w2_rank_death_mid_plan_fails_fast(tmp_path, monkeypatch):
+    """The barrier-timeout satellite drill: with
+    TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT set, a rank whose coordination
+    plane dies mid-take fails EVERY rank within the configured bound —
+    not the 1800 s default — and nothing commits."""
+    import time as _time
+
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT", "8")
+    t0 = _time.monotonic()
+    results = run_with_subprocesses(
+        _w2_rpc_death_worker, 2, str(tmp_path), timeout=120.0
+    )
+    elapsed = _time.monotonic() - t0
+    for rank, err in results.items():
+        assert err is not None, rank
+    assert not os.path.exists(tmp_path / "cur" / ".snapshot_metadata")
+    # Well under the 1800 s default; generous margin over the 8 s bound
+    # for process spawn + jax import.
+    assert elapsed < 100, elapsed
+
+
+def test_matrix_is_large_enough():
+    """The acceptance floor: >= 30 deterministic schedules across
+    backends and world sizes (kills and w2 drills included)."""
+    n = (
+        len(FS_TAKE_PLANS)
+        + 1  # streamed pwrite
+        + len(FS_RESTORE_PLANS)
+        + len(S3_TAKE_PLANS)
+        + len(S3_RESTORE_PLANS)
+        + len(MIRROR_TAKE_PLANS)
+        + len(MIRROR_RESTORE_PLANS)
+        + len(KILL_PLANS)
+        + len(W2_TAKE_PLANS)
+        + 2  # w2 restore drill + rpc-death drill
+    )
+    assert n >= 30, n

@@ -58,7 +58,10 @@ first_payload_durable = asyncio.Event()
 async def gated_write(self, write_io):
     global n_payload_writes
     is_meta = write_io.path.endswith(".snapshot_metadata")
-    if stall_at == "payload" and not is_meta:
+    # The commit fence (.snapshot_fence) is a control file, not a payload:
+    # it must neither stall nor count toward the payload-write numbering.
+    is_internal = is_meta or write_io.path.endswith(".snapshot_fence")
+    if stall_at == "payload" and not is_internal:
         # Let the first payload land fully, then stall the second forever:
         # the take is killed with SOME payloads durable and no metadata.
         # The writes run concurrently, so the stalling task must WAIT for
@@ -220,11 +223,138 @@ def test_sigkill_during_metadata_write_commits_nothing(tmp_path) -> None:
     _assert_uncommitted_and_recoverable(root, step0)
 
 
+# ------------------------------------------- deterministic (faultinject)
+
+# Surgical kill points without monkeypatched stalls: the injector's kill
+# action SIGKILLs the child at an exact site hit, so async_take and the
+# mirror tier get the same crash drills the sync fs path has — chosen
+# deterministically, not by timing.
+_CHILD_FAULT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+
+root, plan, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+state = {
+    "model": StateDict(
+        w=np.arange(64_000, dtype=np.float32),
+        b=np.arange(8_000, dtype=np.float64),
+    )
+}
+faultinject.configure(plan)
+path = os.path.join(root, f"step_{1:010d}")
+if mode == "async":
+    Snapshot.async_take(path, state).wait()
+elif mode == "mirror":
+    Snapshot.take(
+        path,
+        state,
+        storage_options={
+            "mirror_url": os.path.join(root, "mirror_tier", f"step_{1:010d}")
+        },
+    )
+else:
+    Snapshot.take(path, state)
+print("SURVIVED")
+"""
+
+
+def _run_fault_child(root: str, plan: str, mode: str) -> None:
+    err_path = os.path.join(root, "child.stderr")
+    with open(err_path, "wb") as err:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_FAULT, root, plan, mode],
+            stdout=subprocess.DEVNULL,
+            stderr=err,
+        )
+    proc.wait(timeout=150)
+    if proc.returncode != -signal.SIGKILL:
+        with open(err_path) as f:
+            raise AssertionError(
+                f"child exited rc={proc.returncode}, expected SIGKILL from "
+                "the fault plan:\n" + f.read()
+            )
+
+
+def test_sigkill_async_take_at_commit_point(tmp_path) -> None:
+    """async_take's background commit thread killed exactly at the
+    metadata commit site: the early-returned handle's promise ('wait()
+    either returns a committed snapshot or raises') can never be met, so
+    what must hold is the on-disk protocol — nothing committed, previous
+    step intact."""
+    root = str(tmp_path)
+    step0 = _take_step0(root)
+    _run_fault_child(root, "commit.metadata@1=kill", "async")
+    _assert_uncommitted_and_recoverable(root, step0)
+
+
+def test_sigkill_async_take_mid_payload(tmp_path) -> None:
+    """async_take killed during a payload write (hit 1 is the commit
+    fence; hit 2 the first payload temp-file write)."""
+    root = str(tmp_path)
+    step0 = _take_step0(root)
+    _run_fault_child(root, "fs.write@2=kill", "async")
+    _assert_uncommitted_and_recoverable(root, step0)
+
+
+def test_sigkill_mirror_metadata_commit_leaves_mirror_uncommitted(
+    tmp_path,
+) -> None:
+    """Mirror-tier crash drill: killed at the MIRROR's deferred metadata
+    commit — the LAST buffered write of a mirrored take. Hit arithmetic:
+    the fence and both payloads each write twice (primary + mirror
+    replication) = 6, primary metadata = 7, mirror metadata = 8. The
+    primary tier must be fully committed and bit-exact; the mirror must
+    hold payloads but read as uncommitted — metadata-last holds
+    independently per tier."""
+    root = str(tmp_path)
+    step0 = _take_step0(root)
+    _run_fault_child(root, "fs.write@8=kill", "mirror")
+
+    step1 = os.path.join(root, f"step_{1:010d}")
+    assert os.path.exists(os.path.join(step1, ".snapshot_metadata"))
+    assert cli_main(["verify", step1]) == 0
+    dst = {
+        "model": StateDict(
+            w=np.zeros(64_000, np.float32), b=np.zeros(8_000, np.float64)
+        )
+    }
+    Snapshot(path=step1).restore(dst)
+    np.testing.assert_array_equal(
+        dst["model"]["w"], np.arange(64_000, dtype=np.float32)
+    )
+
+    mirror = os.path.join(root, "mirror_tier", f"step_{1:010d}")
+    assert os.path.isdir(mirror), "mirror payloads should have replicated"
+    assert not os.path.exists(
+        os.path.join(mirror, ".snapshot_metadata")
+    ), "a killed mirror commit must leave the mirror uncommitted"
+    payloads = [
+        f
+        for dp, _, fs in os.walk(mirror)
+        for f in fs
+        if not f.startswith(".") and ".tmp." not in f
+    ]
+    assert payloads, "mirror payload replication ran before the kill"
+    # step0 untouched throughout.
+    dst0 = {
+        "model": StateDict(
+            w=np.zeros(64_000, np.float32), b=np.zeros(8_000, np.float64)
+        )
+    }
+    Snapshot(path=os.path.join(root, f"step_{0:010d}")).restore(dst0)
+    np.testing.assert_array_equal(dst0["model"]["w"], step0["model"]["w"])
+
+
 # ----------------------------------------------------------- randomized
 
 # Unlike _CHILD, no stall point: the child takes a real ~96 MB snapshot at
 # full speed and touches the gate right before Snapshot.take so the parent
-# can sample a kill time anywhere in (or past) the take window.
+# can sample a kill time anywhere in (or past) the take window. ``mode``
+# extends the drill across the take surfaces: sync, async_take (the
+# background commit thread is what dies), and the mirrored two-tier path.
 _CHILD_FREE = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -232,20 +362,33 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import numpy as np
 from torchsnapshot_tpu import Snapshot, StateDict
 
-root, gate = sys.argv[1], sys.argv[2]
+root, gate, mode = sys.argv[1], sys.argv[2], sys.argv[3]
 state = {
     "model": StateDict(
         **{f"p{i}": np.full(3_000_000, i, dtype=np.float32) for i in range(8)}
     )
 }
+path = os.path.join(root, f"step_{1:010d}")
 with open(gate, "w") as f:
     f.write("taking")
-Snapshot.take(os.path.join(root, f"step_{1:010d}"), state)
+if mode == "async":
+    Snapshot.async_take(path, state).wait()
+elif mode == "mirror":
+    Snapshot.take(
+        path,
+        state,
+        storage_options={
+            "mirror_url": os.path.join(root, "mirror_tier", f"step_{1:010d}")
+        },
+    )
+else:
+    Snapshot.take(path, state)
 """
 
 
 @pytest.mark.slow
-def test_random_kill_points_commit_or_recover(tmp_path) -> None:
+@pytest.mark.parametrize("mode", ["sync", "async", "mirror"])
+def test_random_kill_points_commit_or_recover(tmp_path, mode) -> None:
     """Kill the writer at RANDOM points instead of surgical ones: whatever
     the timing, the outcome must be binary — either the snapshot committed
     (verify passes, every value restores exactly) or it did not (recovery
@@ -270,7 +413,9 @@ def test_random_kill_points_commit_or_recover(tmp_path) -> None:
     # Calibrate: one unkilled take, timed from the gate to the metadata
     # file appearing, so random kill points span THIS host's take window.
     gate = str(tmp_path / "gate_cal")
-    proc, err_path = _spawn_writer_until_gate(_CHILD_FREE, [root, gate], gate)
+    proc, err_path = _spawn_writer_until_gate(
+        _CHILD_FREE, [root, gate, mode], gate
+    )
     t0 = time.monotonic()
     meta = os.path.join(partial, ".snapshot_metadata")
     while not os.path.exists(meta):
@@ -291,8 +436,14 @@ def test_random_kill_points_commit_or_recover(tmp_path) -> None:
             delay = None  # kill right AFTER the commit point -> committed
         else:
             delay = rng.uniform(0.0, 1.2) * t_take
+        # A fresh mirror tier per iteration: a committed outcome must
+        # come from THIS run's replication, not a previous iteration's.
+        if mode == "mirror":
+            shutil.rmtree(
+                os.path.join(root, "mirror_tier"), ignore_errors=True
+            )
         proc, err_path = _spawn_writer_until_gate(
-            _CHILD_FREE, [root, gate], gate
+            _CHILD_FREE, [root, gate, mode], gate
         )
         if delay is None:
             t0 = time.monotonic()
@@ -347,3 +498,127 @@ def test_random_kill_points_commit_or_recover(tmp_path) -> None:
     print(f"outcomes: {outcomes}")
     # The deterministic iterations guarantee both branches really ran.
     assert outcomes["committed"] >= 1 and outcomes["uncommitted"] >= 1
+
+
+# ----------------------------------------------- resurrected stragglers
+
+
+def test_async_take_plants_fence_before_returning(tmp_path) -> None:
+    """The fenced-GC safety argument requires the fence to exist by the
+    time async_take RETURNS: a fence planted later (by the background
+    commit thread) would be self-satisfying — a straggler reclaimed by
+    GC could resume, re-plant its own token, pass its own commit check,
+    and splice stale metadata over a newer snapshot."""
+    from torchsnapshot_tpu import faultinject
+
+    faultinject.disable()
+    snap = tmp_path / "snap"
+    state = {"model": StateDict(w=np.arange(4096, dtype=np.float32))}
+    pending = Snapshot.async_take(str(snap), state)
+    planted_on_return = os.path.exists(snap / ".snapshot_fence")
+    pending.wait()
+    assert planted_on_return, (
+        "async_take returned without planting the commit fence"
+    )
+    # Committed: fence deleted at the commit point.
+    assert os.path.exists(snap / ".snapshot_metadata")
+    assert not os.path.exists(snap / ".snapshot_fence")
+
+
+def test_straggler_with_reclaimed_fence_cannot_commit(tmp_path) -> None:
+    """End-to-end straggler drill: the fence is removed (a fenced GC
+    reclaiming the partial) while the async commit thread is still
+    draining payload I/O — the commit must abort with StaleCommitError
+    and write no metadata, never re-plant and splice."""
+    from torchsnapshot_tpu import faultinject
+    from torchsnapshot_tpu.snapshot import StaleCommitError
+
+    snap = tmp_path / "snap"
+    state = {
+        "model": StateDict(
+            w=np.arange(4096, dtype=np.float32),
+            b=np.arange(256, dtype=np.float64),
+        )
+    }
+    # Every storage write sleeps 300 ms: async_take returns at
+    # staging-complete while payload writes are still in flight, giving
+    # the parent a deterministic window to play the GC before the
+    # commit thread's drain finishes.
+    faultinject.configure("fs.write@1+=delay:0.3")
+    try:
+        pending = Snapshot.async_take(str(snap), state)
+        fence = snap / ".snapshot_fence"
+        assert os.path.exists(fence)
+        os.remove(fence)  # the fenced GC reclaiming this take
+        with pytest.raises(StaleCommitError):
+            pending.wait()
+    finally:
+        faultinject.disable()
+    assert not os.path.exists(snap / ".snapshot_metadata")
+    # The straggler must not have re-planted its fence either.
+    assert not os.path.exists(snap / ".snapshot_fence")
+
+
+def test_commit_check_does_not_replant_missing_fence(tmp_path) -> None:
+    """Unit form of the straggler drill: _write_snapshot_metadata with a
+    generation whose fence is gone raises StaleCommitError and leaves
+    the directory untouched (no metadata, no fence)."""
+    import asyncio
+
+    from torchsnapshot_tpu.snapshot import (
+        Snapshot as Snap,
+        SnapshotMetadata,
+        StaleCommitError,
+    )
+    from torchsnapshot_tpu.storage_plugin import (
+        url_to_storage_plugin_in_event_loop,
+    )
+    from torchsnapshot_tpu.version import __version__
+
+    meta = SnapshotMetadata(version=__version__, world_size=1, manifest={})
+    meta._commit_gen = "deadbeef"
+    meta._commit_path = str(tmp_path)
+    loop = asyncio.new_event_loop()
+    storage = url_to_storage_plugin_in_event_loop(str(tmp_path), loop, None)
+    try:
+        with pytest.raises(StaleCommitError):
+            Snap._write_snapshot_metadata(meta, storage, loop)
+    finally:
+        storage.sync_close(loop)
+        loop.close()
+    assert not os.path.exists(tmp_path / ".snapshot_metadata")
+    assert not os.path.exists(tmp_path / ".snapshot_fence")
+
+
+def _fence_fault_worker(rank: int, world_size: int, root: str) -> str:
+    """Rank 0's very first storage write is the commit fence; injecting a
+    permanent fault there must abort EVERY rank fast (the failure rides
+    the manifest gather), not desert the peers until the barrier
+    timeout."""
+    from torchsnapshot_tpu import faultinject
+
+    if rank == 0:
+        faultinject.configure("fs.write@1=permanent")
+    state = {
+        "model": StateDict(w=np.arange(2048, dtype=np.float32) + rank)
+    }
+    t0 = time.monotonic()
+    try:
+        Snapshot.take(os.path.join(root, "snap"), state)
+        return "committed"  # must not happen
+    except Exception:
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60, f"abort took {elapsed:.0f}s — peers deserted"
+        return "aborted"
+    finally:
+        faultinject.disable()
+
+
+def test_fence_write_failure_aborts_all_ranks_fast(tmp_path) -> None:
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _fence_fault_worker, 2, str(tmp_path)
+    )
+    assert all(v == "aborted" for v in results.values()), results
+    assert not os.path.exists(tmp_path / "snap" / ".snapshot_metadata")

@@ -1,0 +1,406 @@
+"""The deterministic fault-injection subsystem (faultinject.py) and its
+site lint (scripts/check_fault_sites.py).
+
+Covers the plan grammar (triggers, actions, seeding, rejection of
+malformed specs), the exact-hit determinism fault schedules rely on, the
+exception taxonomy (transient == retryable ConnectionError; permanent ==
+OSError), the disabled-is-a-no-op contract the hot paths depend on, a
+SIGKILL plan in a real subprocess, end-to-end abort through a real take,
+and the lint that keeps every site unique/registered/shim-only.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+from torchsnapshot_tpu.faultinject import (
+    FaultPlan,
+    InjectedFault,
+    InjectedPermanentError,
+    InjectedTransientError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_fault_sites.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faultinject.disable()
+    yield
+    faultinject.disable()
+
+
+# ------------------------------------------------------------- grammar
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "garbage",
+        "fs.write=transient",            # no trigger
+        "fs.write@=transient",           # empty trigger
+        "no.such.site@1=transient",      # unregistered site
+        "fs.write@1=explode",            # unknown action
+        "fs.write@0=transient",          # hits are 1-based
+        "fs.write@p1.5=transient",       # probability outside [0, 1]
+        "fs.write@x=transient",          # malformed trigger
+        "fs.write@1=delay:abc",          # non-numeric arg
+        "seed=1",                        # no rules at all
+        "fs.write@1=transient;seed=zz",  # malformed seed
+        "",                              # FaultPlan("") directly
+    ],
+)
+def test_malformed_plans_rejected(spec):
+    with pytest.raises(ValueError):
+        FaultPlan(spec)
+
+
+def test_configure_and_disable_roundtrip():
+    assert not faultinject.active()
+    faultinject.configure("fs.write@1=transient")
+    assert faultinject.active()
+    assert faultinject.active_spec() == "fs.write@1=transient"
+    faultinject.disable()
+    assert not faultinject.active()
+    assert faultinject.hits() == {}
+
+
+# ------------------------------------------------------- trigger logic
+
+
+def test_exact_nth_hit_fires_once():
+    faultinject.configure("fs.write@3=transient")
+    faultinject.site("fs.write")
+    faultinject.site("fs.write")
+    with pytest.raises(InjectedTransientError):
+        faultinject.site("fs.write")
+    faultinject.site("fs.write")  # hit 4: no fault
+    assert faultinject.hits() == {"fs.write": 4}
+
+
+def test_open_ended_trigger_fires_from_nth_on():
+    faultinject.configure("fs.write@2+=permanent")
+    faultinject.site("fs.write")
+    for _ in range(3):
+        with pytest.raises(InjectedPermanentError):
+            faultinject.site("fs.write")
+
+
+def test_sites_count_independently():
+    faultinject.configure("fs.write@2=transient")
+    faultinject.site("fs.read")
+    faultinject.site("fs.read")
+    faultinject.site("fs.write")  # hit 1 of fs.write: no fault
+    assert faultinject.hits() == {"fs.read": 2, "fs.write": 1}
+
+
+def test_probabilistic_trigger_is_seed_deterministic():
+    def pattern(seed):
+        faultinject.configure(f"fs.write@p0.5=transient;seed={seed}")
+        fired = []
+        for _ in range(64):
+            try:
+                faultinject.site("fs.write")
+                fired.append(False)
+            except InjectedTransientError:
+                fired.append(True)
+        return fired
+
+    a, b = pattern(7), pattern(7)
+    assert a == b, "same seed must replay the same schedule"
+    assert any(a) and not all(a)
+    assert pattern(8) != a, "a different seed should differ (p=0.5, n=64)"
+
+
+def test_configure_resets_counters_and_rng():
+    faultinject.configure("fs.write@1=transient")
+    with pytest.raises(InjectedTransientError):
+        faultinject.site("fs.write")
+    faultinject.configure("fs.write@1=transient")
+    with pytest.raises(InjectedTransientError):
+        faultinject.site("fs.write")
+
+
+# ------------------------------------------------------------- actions
+
+
+def test_exception_taxonomy():
+    faultinject.configure("fs.write@1=transient;fs.read@1=permanent")
+    with pytest.raises(ConnectionError) as ti:
+        faultinject.site("fs.write")
+    assert isinstance(ti.value, InjectedFault)
+    with pytest.raises(OSError) as pi:
+        faultinject.site("fs.read")
+    assert isinstance(pi.value, InjectedFault)
+    # permanent must NOT look transient to the retry machinery.
+    from torchsnapshot_tpu.storage_plugins.retry import is_transient_error
+
+    assert is_transient_error(ti.value)
+    assert not is_transient_error(pi.value)
+
+
+def test_corrupt_flips_exactly_one_byte_deterministically():
+    payload = bytes(range(256)) * 4
+    faultinject.configure("fs.write@1=corrupt;seed=5")
+    out1 = bytes(faultinject.mutate("fs.write", payload))
+    faultinject.configure("fs.write@1=corrupt;seed=5")
+    out2 = bytes(faultinject.mutate("fs.write", payload))
+    assert out1 == out2, "corrupt offset must be seed-deterministic"
+    assert len(out1) == len(payload)
+    diffs = [i for i, (a, b) in enumerate(zip(payload, out1)) if a != b]
+    assert len(diffs) == 1
+
+
+def test_corrupt_offset_argument_respected():
+    faultinject.configure("fs.write@1=corrupt:3")
+    out = bytes(faultinject.mutate("fs.write", b"\x00" * 16))
+    assert out[3] == 0xFF and sum(out) == 0xFF
+
+
+def test_truncate_keeps_fraction():
+    faultinject.configure("fs.write@1=truncate:0.25")
+    out = faultinject.mutate("fs.write", b"x" * 100)
+    assert memoryview(out).nbytes == 25
+
+
+def test_truncate_default_is_half():
+    faultinject.configure("fs.write@1=truncate")
+    assert memoryview(faultinject.mutate("fs.write", b"x" * 10)).nbytes == 5
+
+
+def test_delay_returns_buffer_unchanged():
+    faultinject.configure("fs.write@1=delay:0")
+    buf = b"abc"
+    assert bytes(faultinject.mutate("fs.write", buf)) == b"abc"
+
+
+def test_data_actions_are_noop_at_control_sites():
+    faultinject.configure("dist_store.rpc@1=corrupt")
+    faultinject.site("dist_store.rpc")  # must not raise
+
+
+def test_combined_rules_mutate_then_raise():
+    faultinject.configure(
+        "fs.write@1=truncate:0.5;fs.write@1=transient"
+    )
+    with pytest.raises(InjectedTransientError):
+        faultinject.mutate("fs.write", b"x" * 8)
+
+
+# ------------------------------------------------- disabled hot path
+
+
+def test_disabled_shim_is_identity():
+    assert faultinject.site("fs.write") is None
+    buf = bytearray(b"payload")
+    assert faultinject.mutate("fs.write", buf) is buf
+    assert faultinject.hits() == {}
+
+
+def test_refresh_from_env(monkeypatch):
+    monkeypatch.setenv(
+        faultinject.FAULT_PLAN_ENV_VAR, "fs.write@1=transient"
+    )
+    faultinject.refresh_from_env()
+    assert faultinject.active()
+    monkeypatch.delenv(faultinject.FAULT_PLAN_ENV_VAR)
+    faultinject.refresh_from_env()
+    assert not faultinject.active()
+
+
+# ------------------------------------------------------- end to end
+
+
+def test_staging_fault_aborts_take_without_commit(tmp_path):
+    state = {"m": StateDict(w=np.arange(2048, dtype=np.float32))}
+    path = str(tmp_path / "snap")
+    faultinject.configure("scheduler.stage@1=permanent")
+    with pytest.raises(Exception):
+        Snapshot.take(path, state)
+    faultinject.disable()
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+    # The same path commits cleanly once the plan is gone.
+    Snapshot.take(path, state)
+    dst = {"m": StateDict(w=np.zeros(2048, np.float32))}
+    Snapshot(path).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], state["m"]["w"])
+
+
+def test_transient_storage_fault_is_retried_by_s3(tmp_path):
+    """An injected transient at the retry-wrapped s3 boundary is absorbed
+    by the collective retry strategy — the take commits anyway."""
+    from tests.test_s3_storage_plugin import FakeS3Client
+    from torchsnapshot_tpu.storage_plugins.retry import (
+        CollectiveRetryStrategy,
+    )
+
+    async def _nosleep(_s):
+        return None
+
+    client = FakeS3Client()
+    opts = {
+        "client": client,
+        "retry_strategy": CollectiveRetryStrategy(sleep=_nosleep),
+    }
+    state = {"m": StateDict(w=np.arange(512, dtype=np.float32))}
+    faultinject.configure("s3.put@1=transient")
+    Snapshot.take("s3://bucket/chaos", state, storage_options=opts)
+    faultinject.disable()
+    dst = {"m": StateDict(w=np.zeros(512, np.float32))}
+    Snapshot("s3://bucket/chaos", storage_options=opts).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], state["m"]["w"])
+
+
+def test_transient_read_fault_is_retried_by_gcs(tmp_path, monkeypatch):
+    """An injected transient at gcs.get is absorbed by the retry
+    machinery — the site sits INSIDE the retried closure (like s3.get),
+    so the drill exercises the real retry path instead of escaping
+    after a successful fetch."""
+    from tests.test_gcs_storage_plugin import FakeBucket
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins.retry import (
+        CollectiveRetryStrategy,
+    )
+
+    async def _nosleep(_s):
+        return None
+
+    bucket = FakeBucket()
+    monkeypatch.setattr(
+        gcs_mod.GCSStoragePlugin,
+        "_make_bucket",
+        staticmethod(lambda name, options: bucket),
+    )
+    opts = {"retry_strategy": CollectiveRetryStrategy(sleep=_nosleep)}
+    state = {"m": StateDict(w=np.arange(512, dtype=np.float32))}
+    Snapshot.take("gs://bkt/chaos", state, storage_options=opts)
+    faultinject.configure("gcs.get@1=transient")
+    dst = {"m": StateDict(w=np.zeros(512, np.float32))}
+    Snapshot("gs://bkt/chaos", storage_options=opts).restore(dst)
+    faultinject.disable()
+    np.testing.assert_array_equal(dst["m"]["w"], state["m"]["w"])
+
+
+def test_kill_plan_sigkills_subprocess(tmp_path):
+    """A kill action takes the process down with SIGKILL — no atexit, no
+    finally — exactly at the targeted site hit."""
+    child = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np\n"
+        "from torchsnapshot_tpu import Snapshot, StateDict\n"
+        "state = {'m': StateDict(w=np.arange(2048, dtype=np.float32))}\n"
+        f"Snapshot.take({str(tmp_path / 'snap')!r}, state)\n"
+        "print('UNREACHABLE')\n"
+    )
+    env = dict(os.environ)
+    env["TORCHSNAPSHOT_TPU_FAULT_PLAN"] = "commit.metadata@1=kill"
+    r = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    assert r.returncode == -signal.SIGKILL, r.stderr
+    assert "UNREACHABLE" not in r.stdout
+    # Killed at the commit point: fence present, metadata absent.
+    assert not os.path.exists(tmp_path / "snap" / ".snapshot_metadata")
+    assert os.path.exists(tmp_path / "snap" / ".snapshot_fence")
+
+
+# ------------------------------------------------------------- lint
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location("check_fault_sites", LINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fault_site_lint_package_clean():
+    r = subprocess.run(
+        [sys.executable, LINT], capture_output=True, text=True, timeout=120
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_fault_site_lint_detects_violations():
+    lint = _load_lint()
+    violations, uses = lint.check_source(
+        "from . import faultinject\n"
+        "from .faultinject import site\n"           # bypasses the shim
+        "faultinject.site('no.such.site')\n"        # unregistered
+        "faultinject.site(some_variable)\n"         # non-literal
+        "faultinject.configure('fs.write@1=kill')\n"  # past the shim
+        "faultinject.mutate('fs.write', b'x')\n"    # the one clean call
+        "",
+        "<test>",
+    )
+    whats = "\n".join(w for _, w in violations)
+    assert "from ...faultinject import" in whats
+    assert "no.such.site" in whats
+    assert "string literal" in whats
+    assert "faultinject.configure" in whats
+    assert uses == {"fs.write": [6]}
+
+
+def test_fault_site_lint_rejects_duplicate_and_dead_sites(tmp_path):
+    lint = _load_lint()
+    # Two call sites for one name -> non-deterministic schedules; and the
+    # synthetic package wires almost nothing, so every other registered
+    # site must be reported as dead.
+    (tmp_path / "a.py").write_text(
+        "from . import faultinject\nfaultinject.site('fs.write')\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "from . import faultinject\nfaultinject.site('fs.write')\n"
+    )
+    failures = "\n".join(lint.run(package_dir=str(tmp_path)))
+    assert "2 call sites" in failures
+    assert "wired nowhere" in failures
+
+
+def test_every_registered_site_has_a_kind():
+    assert set(faultinject.SITES.values()) <= {"control", "data"}
+    assert faultinject.KNOWN_SITES == frozenset(faultinject.SITES)
+
+
+def test_malformed_env_plan_does_not_break_import(tmp_path):
+    """A typo'd TORCHSNAPSHOT_TPU_FAULT_PLAN must not make the package
+    unimportable (the fsck/verify CLIs import it too) — import warns
+    loudly and runs uninjected; configure() still raises."""
+    import subprocess
+    import sys
+
+    child = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from torchsnapshot_tpu import faultinject\n"
+        "assert not faultinject.active()\n"
+        "print('IMPORT_OK')\n"
+    )
+    env = dict(os.environ)
+    env["TORCHSNAPSHOT_TPU_FAULT_PLAN"] = "fs.write@0=kill"  # 1-based: invalid
+    r = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    assert r.returncode == 0 and "IMPORT_OK" in r.stdout, r.stderr[-800:]
+    assert "ignoring malformed" in r.stderr
+    # Deliberate configuration still fails fast.
+    with pytest.raises(ValueError, match="1-based"):
+        faultinject.configure("fs.write@0=kill")

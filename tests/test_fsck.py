@@ -1,0 +1,370 @@
+"""The ``fsck`` subcommand: every corruption class it exists to detect,
+one seeded instance each, plus the clean/cannot-check/repair contracts.
+
+Classes (ISSUE 5 acceptance): truncated payload, flipped byte, missing
+file, orphan temp dir, dangling incremental dep — each must exit nonzero
+with the right finding class — plus corrupt metadata, partial commits,
+stale fences, and the ``--repair`` quarantine being reversible and
+convergent (a second fsck after repair is clean).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import CorruptSnapshotError, Snapshot, StateDict
+from torchsnapshot_tpu.cli import main as cli_main, run_fsck
+
+
+def _take(path: str, scale: float = 1.0, record_digests: bool = False) -> dict:
+    state = {
+        "model": StateDict(
+            w=np.arange(4096, dtype=np.float32) * scale,
+            b=np.arange(256, dtype=np.float64) * scale,
+        )
+    }
+    Snapshot.take(str(path), state, record_digests=record_digests)
+    return state
+
+
+def _payload(path, name: str) -> str:
+    p = os.path.join(str(path), "0", "model", name)
+    assert os.path.exists(p), p
+    return p
+
+
+def test_clean_snapshot_is_clean(tmp_path):
+    _take(tmp_path / "snap")
+    code, report = run_fsck(str(tmp_path / "snap"))
+    assert code == 0
+    assert report.clean
+    assert report.payloads_ok == 2
+    # Committed snapshots carry no fence (deleted at the commit point).
+    assert not os.path.exists(tmp_path / "snap" / ".snapshot_fence")
+
+
+def test_truncated_payload_detected(tmp_path):
+    _take(tmp_path / "snap")
+    with open(_payload(tmp_path / "snap", "w_0"), "r+b") as f:
+        f.truncate(100)
+    code, report = run_fsck(str(tmp_path / "snap"))
+    assert code == 1
+    assert "truncated-payload" in report.classes()
+
+
+def test_flipped_byte_detected(tmp_path):
+    _take(tmp_path / "snap")
+    with open(_payload(tmp_path / "snap", "w_0"), "r+b") as f:
+        f.seek(1234)
+        byte = f.read(1)
+        f.seek(1234)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    code, report = run_fsck(str(tmp_path / "snap"))
+    assert code == 1
+    assert "checksum-mismatch" in report.classes()
+
+
+def test_missing_payload_detected(tmp_path):
+    _take(tmp_path / "snap")
+    os.remove(_payload(tmp_path / "snap", "b_0"))
+    code, report = run_fsck(str(tmp_path / "snap"))
+    assert code == 1
+    assert "missing-payload" in report.classes()
+
+
+def test_orphan_temp_dir_detected_and_repaired(tmp_path):
+    snap = tmp_path / "snap"
+    _take(snap)
+    os.makedirs(snap / "batched.tmp.4242")
+    (snap / "batched.tmp.4242" / "slab").write_bytes(b"\x00" * 64)
+    (snap / "stray_payload").write_bytes(b"\x00" * 8)
+    code, report = run_fsck(str(snap))
+    assert code == 1
+    assert {"temp-file", "orphan"} <= report.classes()
+
+    code, report = run_fsck(str(snap), repair=True)
+    assert code == 0, report.findings
+    assert len(report.repaired) == 2
+    # Reversible: quarantined, not deleted.
+    assert (snap / ".fsck_quarantine" / "batched.tmp.4242" / "slab").exists()
+    assert (snap / ".fsck_quarantine" / "stray_payload").exists()
+    # Convergent: a second fsck (quarantine dir ignored) is clean.
+    code, report = run_fsck(str(snap))
+    assert code == 0, report.findings
+
+
+def test_repair_never_touches_corruption(tmp_path):
+    snap = tmp_path / "snap"
+    _take(snap)
+    with open(_payload(snap, "w_0"), "r+b") as f:
+        f.truncate(100)
+    code, report = run_fsck(str(snap), repair=True)
+    assert code == 1
+    assert "truncated-payload" in report.classes()
+    assert not report.repaired
+
+
+def test_dangling_incremental_dep_detected(tmp_path):
+    base = tmp_path / "base"
+    state = _take(base, record_digests=True)
+    Snapshot.take(
+        str(tmp_path / "inc"),
+        {
+            "model": StateDict(
+                w=np.asarray(state["model"]["w"]),
+                b=np.asarray(state["model"]["b"]),
+            )
+        },
+        incremental_base=str(base),
+        record_digests=True,
+    )
+    # Baseline: intact chain is clean.
+    code, report = run_fsck(str(tmp_path / "inc"))
+    assert code == 0, report.findings
+
+    os.remove(_payload(base, "w_0"))
+    code, report = run_fsck(str(tmp_path / "inc"))
+    assert code == 1
+    assert "dangling-dep" in report.classes()
+
+    # Base gone entirely: the dep findings name the base as unreadable.
+    import shutil
+
+    shutil.rmtree(base)
+    code, report = run_fsck(str(tmp_path / "inc"))
+    assert code == 1
+    assert "dangling-dep" in report.classes()
+
+
+def test_corrupt_metadata_detected(tmp_path):
+    snap = tmp_path / "snap"
+    _take(snap)
+    meta = snap / ".snapshot_metadata"
+    raw = meta.read_bytes()
+    meta.write_bytes(raw[: len(raw) // 2])  # torn mid-write
+    code, report = run_fsck(str(snap))
+    assert code == 1
+    assert "corrupt-metadata" in report.classes()
+    with pytest.raises(CorruptSnapshotError) as exc_info:
+        Snapshot(str(snap)).metadata
+    assert str(snap) in str(exc_info.value)
+
+    meta.write_bytes(b"")  # zero-byte commit residue
+    code, report = run_fsck(str(snap))
+    assert code == 1
+    assert "corrupt-metadata" in report.classes()
+    with pytest.raises(CorruptSnapshotError):
+        Snapshot(str(snap)).metadata
+
+
+def test_partial_commit_detected(tmp_path):
+    partial = tmp_path / "partial"
+    os.makedirs(partial / "0" / "model")
+    (partial / "0" / "model" / "w_0").write_bytes(b"\x00" * 512)
+    code, report = run_fsck(str(partial))
+    assert code == 1
+    assert "partial-commit" in report.classes()
+
+
+def test_stale_fence_detected_and_repaired(tmp_path):
+    snap = tmp_path / "snap"
+    _take(snap)
+    (snap / ".snapshot_fence").write_text('{"gen": "dead"}')
+    code, report = run_fsck(str(snap))
+    assert code == 1
+    assert "stale-fence" in report.classes()
+    code, report = run_fsck(str(snap), repair=True)
+    assert code == 0, report.findings
+
+
+def test_fsck_agrees_with_mirror_failover(tmp_path):
+    """Restore-equivalence: a payload whose primary copy is lost but
+    whose mirror copy is intact must fsck CLEAN (restore reads it fine
+    via failover) — with explicit mirror options AND with none, because
+    the snapshot's own recorded mirror_url is applied by default (a
+    degraded-but-healthy deployment must not raise a false alarm). An
+    explicit ``mirror_url=None`` audits the primary tier alone."""
+    snap = tmp_path / "snap"
+    opts = {"mirror_url": str(tmp_path / "mirror")}
+    state = {
+        "model": StateDict(
+            w=np.arange(4096, dtype=np.float32),
+            b=np.arange(256, dtype=np.float64),
+        )
+    }
+    Snapshot.take(str(snap), state, storage_options=opts)
+    os.remove(_payload(snap, "w_0"))
+
+    code, report = run_fsck(str(snap), storage_options=opts)
+    assert code == 0, report.findings
+    # No options: the recorded meta.mirror_url kicks in (restore would
+    # succeed through it, so fsck must be clean too).
+    code, report = run_fsck(str(snap))
+    assert code == 0, report.findings
+    # Primary tier alone, by explicit caller word.
+    code, report = run_fsck(str(snap), storage_options={"mirror_url": None})
+    assert code == 1
+    assert "missing-payload" in report.classes()
+
+
+def test_cannot_check_exit_codes(tmp_path):
+    assert run_fsck(str(tmp_path / "absent"))[0] == 2
+    os.makedirs(tmp_path / "empty")
+    assert run_fsck(str(tmp_path / "empty"))[0] == 2
+
+
+def test_cli_entrypoint_exit_codes(tmp_path, capsys):
+    snap = tmp_path / "snap"
+    _take(snap)
+    assert cli_main(["fsck", str(snap)]) == 0
+    with open(_payload(snap, "w_0"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff\xff")
+    assert cli_main(["fsck", str(snap)]) == 1
+    out = capsys.readouterr().out
+    assert "CHECKSUM-MISMATCH" in out
+    assert cli_main(["fsck", str(tmp_path / "absent")]) == 2
+
+
+def test_truncated_mmap_sized_range_is_eof_not_valueerror(tmp_path):
+    """A byte-ranged read big enough for the fs plugin's mmap path
+    (>= 1 MiB) whose range extends past a truncated file's EOF must
+    surface as EOFError — the taxonomy the buffered path and mirror
+    failover speak — never CPython mmap's ValueError (which bypassed
+    failover and crashed fsck). Whole-file reads stat first, so only
+    ranged reads — slab byte_ranges — could hit the leak."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_types import ReadIO
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    full = (1 << 20) + 4096
+    (tmp_path / "slab").write_bytes(b"\xab" * full)
+    with open(tmp_path / "slab", "r+b") as f:
+        f.truncate(full // 2)
+
+    plugin = FSStoragePlugin(str(tmp_path))
+    loop = asyncio.new_event_loop()
+    try:
+        with pytest.raises(EOFError):
+            loop.run_until_complete(
+                plugin.read(ReadIO(path="slab", byte_range=(0, full)))
+            )
+    finally:
+        plugin.sync_close(loop)
+        loop.close()
+
+
+def test_truncated_primary_range_fails_over_to_mirror(tmp_path):
+    """The production consequence of the EOF taxonomy: a truncated
+    primary under an intact mirror must fail over (EOFError is a
+    documented primary-read loss), bit-exact — on the mmap-sized ranged
+    path, where the old ValueError bypassed _PRIMARY_READ_FAILURES."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_types import ReadIO
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+    from torchsnapshot_tpu.storage_plugins.mirror import (
+        MirroredStoragePlugin,
+    )
+
+    full = (1 << 20) + 4096
+    payload = bytes(range(256)) * (full // 256)
+    os.makedirs(tmp_path / "primary")
+    os.makedirs(tmp_path / "mirror")
+    (tmp_path / "primary" / "slab").write_bytes(payload)
+    (tmp_path / "mirror" / "slab").write_bytes(payload)
+    with open(tmp_path / "primary" / "slab", "r+b") as f:
+        f.truncate(full // 2)
+
+    loop = asyncio.new_event_loop()
+    primary = FSStoragePlugin(str(tmp_path / "primary"))
+    mirror = FSStoragePlugin(str(tmp_path / "mirror"))
+    plugin = MirroredStoragePlugin(primary, mirror, ".snapshot_metadata")
+    try:
+        read_io = ReadIO(path="slab", byte_range=(0, full))
+        loop.run_until_complete(plugin.read(read_io))
+        assert bytes(read_io.buf) == payload
+    finally:
+        plugin.sync_close(loop)
+        loop.close()
+
+
+def test_cloud_style_notfound_is_a_finding_not_a_crash(tmp_path):
+    """Backend-specific not-found types (botocore NoSuchKey, google-api
+    NotFound) are matched by NAME — fsck must turn them into findings
+    and keep scanning, never abort with a traceback."""
+    from torchsnapshot_tpu.cli import (
+        _classify_read_failure,
+        _is_not_found_error,
+    )
+
+    class NoSuchKey(Exception):  # botocore's shape, by name
+        pass
+
+    class NotFound(Exception):  # google-api's shape, by name
+        pass
+
+    assert _is_not_found_error(NoSuchKey("missing"))
+    assert _is_not_found_error(NotFound("missing"))
+    assert not _is_not_found_error(RuntimeError("throttled"))
+    assert _classify_read_failure(NoSuchKey("x"), None) == "missing-payload"
+    assert _classify_read_failure(NoSuchKey("x"), "dangling-dep") == (
+        "dangling-dep"
+    )
+    assert _classify_read_failure(EOFError("x"), None) == "truncated-payload"
+    assert _classify_read_failure(RuntimeError("x"), None) == "io-error"
+
+
+def test_metadata_transport_error_is_cannot_check(tmp_path, monkeypatch):
+    """A transport/auth failure reading .snapshot_metadata (not a
+    not-found) means fsck can conclude nothing: exit 2 with a diagnosis
+    through the caller's echo, never a raw traceback."""
+    snap = tmp_path / "snap"
+    _take(snap)
+
+    class ClientError(Exception):  # transport-shaped, NOT a not-found
+        pass
+
+    from torchsnapshot_tpu.snapshot import Snapshot as _Snap
+
+    def _boom(self, storage, event_loop):
+        raise ClientError("connection reset by peer")
+
+    monkeypatch.setattr(_Snap, "_read_metadata", _boom)
+    lines: list = []
+    code, report = run_fsck(str(snap), echo=lines.append)
+    assert code == 2
+    assert not report.findings
+    # The cannot-check diagnosis reaches programmatic echo consumers.
+    assert any("ClientError" in ln for ln in lines)
+
+
+def test_fsck_verifies_batched_slab_ranges(tmp_path, monkeypatch):
+    """Slab-batched payloads share one location under different byte
+    ranges; fsck must verify each range (and catch a flip inside one)."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_ENABLE_BATCHING", "1")
+    state = {
+        "model": StateDict(
+            **{f"p{i}": np.arange(64, dtype=np.float32) + i for i in range(6)}
+        )
+    }
+    snap = tmp_path / "snap"
+    Snapshot.take(str(snap), state)
+    code, report = run_fsck(str(snap))
+    assert code == 0, report.findings
+    slabs = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(snap / "batched")
+        for f in fs
+    ]
+    assert slabs, "batching should have produced a slab"
+    with open(slabs[0], "r+b") as f:
+        f.seek(40)
+        f.write(b"\xde\xad")
+    code, report = run_fsck(str(snap))
+    assert code == 1
+    assert "checksum-mismatch" in report.classes()

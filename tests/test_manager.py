@@ -162,8 +162,13 @@ def test_failed_async_save_raises_on_next_save(tmp_path, monkeypatch):
     from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
 
     class Faulty(FSStoragePlugin):
+        # The fence is planted synchronously at plan time; failing it
+        # would fail save(0) itself. This test targets the BACKGROUND
+        # payload-write failure surfacing on the next save.
         async def write(self, write_io) -> None:
-            if write_io.path != SNAPSHOT_METADATA_FNAME:
+            if write_io.path != SNAPSHOT_METADATA_FNAME and not (
+                write_io.path.endswith(".snapshot_fence")
+            ):
                 raise RuntimeError("injected storage failure")
             await super().write(write_io)
 
@@ -293,3 +298,61 @@ def test_warmup_noop_under_incremental_or_compression(tmp_path):
 
     if native_available() and checksums_enabled():
         assert warmed > 0
+
+
+def test_gc_reclaims_mirror_tier_partials(tmp_path):
+    """A crashed mirrored save leaves TWO partial trees — the primary
+    step dir and its replica under the mirror root. The fenced GC on the
+    next save must reclaim both, or crash/retry cycles leak unreferenced
+    payloads on the mirror tier forever."""
+    primary_root = tmp_path / "primary"
+    mirror_root = tmp_path / "mirror"
+    step0 = "step_0000000000"
+    for root in (primary_root, mirror_root):
+        os.makedirs(root / step0 / "0" / "app")
+        (root / step0 / "0" / "app" / "junk_0").write_bytes(b"\x00" * 256)
+        (root / step0 / ".snapshot_fence").write_text('{"gen": "dead"}')
+
+    mgr = CheckpointManager(
+        str(primary_root),
+        save_interval_steps=1,
+        storage_options={"mirror_url": str(mirror_root)},
+    )
+    mgr.save(0, {"app": _state(0)})
+    # Both partials reclaimed, then re-taken and committed on each tier.
+    assert os.path.exists(primary_root / step0 / ".snapshot_metadata")
+    assert os.path.exists(mirror_root / step0 / ".snapshot_metadata")
+    assert not os.path.exists(primary_root / step0 / "0" / "app" / "junk_0")
+    assert not os.path.exists(mirror_root / step0 / "0" / "app" / "junk_0")
+
+
+def test_gc_spares_mirror_of_committed_step(tmp_path):
+    """The mirror's metadata commit is deferred to close() and
+    suppressed after any mirror write failure, so a COMMITTED primary
+    step can own a metadata-less mirror tree. That tree is degraded
+    failover redundancy for the resume point — the GC must never
+    reclaim it (only mirror dirs whose primary is also uncommitted)."""
+    primary_root = tmp_path / "primary"
+    mirror_root = tmp_path / "mirror"
+    mgr = CheckpointManager(
+        str(primary_root),
+        save_interval_steps=1,
+        storage_options={"mirror_url": str(mirror_root)},
+    )
+    mgr.save(0, {"app": _state(0)})
+    step0 = "step_0000000000"
+    assert os.path.exists(primary_root / step0 / ".snapshot_metadata")
+    # Simulate a crash before the mirror's deferred metadata commit.
+    os.remove(mirror_root / step0 / ".snapshot_metadata")
+    mirrored_payloads = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(mirror_root / step0)
+        for f in fs
+    ]
+    assert mirrored_payloads, "mirror tier should hold replica payloads"
+
+    mgr.save(1, {"app": _state(1)})
+    for p in mirrored_payloads:
+        assert os.path.exists(p), (
+            "GC reclaimed the mirror replica of a committed step"
+        )

@@ -11,6 +11,11 @@ on-call asks, so they get first-class commands here:
 - ``cat``      — print one entry via ``Snapshot.read_object``.
 - ``verify``   — re-hash every payload against its recorded checksum
   (end-to-end CRC32C integrity, see integrity.py).
+- ``fsck``     — full consistency check: manifest<->payload existence/
+  size/CRC agreement, incremental-chain (deps) integrity, orphan and
+  partial-commit detection; ``--repair`` quarantines orphans under
+  ``.fsck_quarantine/``. Exit codes: 0 clean, 1 findings, 2 cannot-check
+  (see docs/source/fault_tolerance.rst).
 - ``migrate``  — convert a reference-format (pytorch/torchsnapshot)
   snapshot to native format (tricks/torchsnapshot_interop.py).
 - ``consolidate`` — materialize an incremental snapshot as a
@@ -66,32 +71,54 @@ def _array_nbytes(entry: ArrayEntry) -> Optional[int]:
         return None
 
 
-def _entry_payloads(
+def _entry_payloads_ex(
     entry: Entry,
-) -> List[Tuple[str, Optional[List[int]], Optional[str], Optional[int], Optional[str]]]:
-    """(location, byte_range, checksum, nbytes, origin) per payload the
-    entry owns. ``origin`` is the base snapshot holding the bytes when the
-    entry was deduplicated by an incremental take."""
+) -> List[
+    Tuple[
+        str,
+        Optional[List[int]],
+        Optional[str],
+        Optional[int],
+        Optional[str],
+        Optional[str],
+    ]
+]:
+    """(location, byte_range, checksum, nbytes, origin, codec) per payload
+    the entry owns. ``origin`` is the base snapshot holding the bytes when
+    the entry was deduplicated by an incremental take; ``codec`` the
+    compression codec (stored size != ``nbytes`` when set)."""
     if isinstance(entry, ArrayEntry):
         return [
             (entry.location, entry.byte_range, entry.checksum,
-             _array_nbytes(entry), entry.origin)
+             _array_nbytes(entry), entry.origin, entry.codec)
         ]
     if isinstance(entry, ChunkedArrayEntry):
         return [
             (c.array.location, c.array.byte_range, c.array.checksum,
-             _array_nbytes(c.array), c.array.origin)
+             _array_nbytes(c.array), c.array.origin, c.array.codec)
             for c in entry.chunks
         ]
     if isinstance(entry, ShardedArrayEntry):
         return [
             (s.array.location, s.array.byte_range, s.array.checksum,
-             _array_nbytes(s.array), s.array.origin)
+             _array_nbytes(s.array), s.array.origin, s.array.codec)
             for s in entry.shards
         ]
     if isinstance(entry, ObjectEntry):
-        return [(entry.location, None, entry.checksum, entry.size, entry.origin)]
+        return [
+            (entry.location, None, entry.checksum, entry.size, entry.origin,
+             getattr(entry, "codec", None))
+        ]
     return []
+
+
+def _entry_payloads(
+    entry: Entry,
+) -> List[Tuple[str, Optional[List[int]], Optional[str], Optional[int], Optional[str]]]:
+    """(location, byte_range, checksum, nbytes, origin) — the historical
+    5-tuple view (tests and external tooling unpack it); fsck uses the
+    codec-aware ``_entry_payloads_ex``."""
+    return [p[:5] for p in _entry_payloads_ex(entry)]
 
 
 def _entry_nbytes(entry: Entry) -> Optional[int]:
@@ -227,43 +254,83 @@ def cmd_cat(args: argparse.Namespace) -> int:
     return 0
 
 
+def _payloads_by_origin(
+    meta: SnapshotMetadata,
+) -> Dict[Optional[str], List[Tuple]]:
+    """Distinct stored payloads grouped by origin, in deterministic order:
+    ``{origin: [(location, byte_range, checksum, nbytes, codec), ...]}``.
+
+    Replicated entries appear under every rank prefix and slab-batched
+    sub-entries share a location under different byte ranges — each
+    distinct ``(origin, location, byte_range)`` is listed exactly once.
+    Payloads an incremental take left in a base snapshot group under that
+    base's URL so its plugin opens once. Shared by ``verify`` and
+    ``fsck`` — the two must never disagree on what "every payload" means.
+    """
+    seen: Dict[Tuple[Optional[str], str, Optional[Tuple[int, int]]], Tuple] = {}
+    for entry in meta.manifest.values():
+        for location, byte_range, checksum, nbytes, origin, codec in (
+            _entry_payloads_ex(entry)
+        ):
+            key = (origin, location, tuple(byte_range) if byte_range else None)
+            seen.setdefault(key, (checksum, nbytes, codec))
+    by_origin: Dict[Optional[str], List[Tuple]] = {}
+    for (origin, location, byte_range), info in sorted(
+        seen.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])
+    ):
+        by_origin.setdefault(origin, []).append((location, byte_range) + info)
+    return by_origin
+
+
+def _origin_storage_options(
+    origin: Optional[str],
+    meta: SnapshotMetadata,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Plugin options for reading payloads at ``origin`` (None = the
+    snapshot itself), restore-equivalent: an origin reads through ITS
+    recorded mirror fallback — never through this snapshot's mirror
+    settings — so verify/fsck agree with what restore can actually read
+    (including after a base's primary loss). The snapshot's OWN tier
+    likewise defaults to its recorded ``mirror_url`` when the caller
+    supplied none: a mirrored snapshot whose primary payloads were lost
+    restores fine through the failover, and fsck must say so instead of
+    raising a false missing-payload alarm on a degraded-but-healthy
+    deployment."""
+    if origin is None:
+        # An explicitly-present mirror_url key (even None) is the
+        # caller's word — e.g. {"mirror_url": None} audits the primary
+        # tier alone.
+        if meta.mirror_url and "mirror_url" not in (storage_options or {}):
+            return {**(storage_options or {}), "mirror_url": meta.mirror_url}
+        return storage_options
+    from .storage_plugin import strip_mirror_options
+
+    opts = strip_mirror_options(storage_options)
+    mirror = (meta.origin_mirrors or {}).get(origin)
+    if mirror:
+        opts = {**(opts or {}), "mirror_url": mirror}
+    return opts
+
+
 def cmd_verify(args: argparse.Namespace) -> int:
     from .storage_plugin import url_to_storage_plugin_in_event_loop
 
     meta = _load_metadata(args.path)
-    # Replicated entries appear under every rank prefix and chunked stripes
-    # can share a location: verify each distinct payload once. Payloads an
-    # incremental take left in a base snapshot are verified there (grouped
-    # by origin so each base's plugin opens once).
-    seen: Dict[Tuple[Optional[str], str, Optional[Tuple[int, int]]], Optional[str]] = {}
-    for entry in meta.manifest.values():
-        for location, byte_range, checksum, _, origin in _entry_payloads(entry):
-            key = (origin, location, tuple(byte_range) if byte_range else None)
-            seen.setdefault(key, checksum)
-    by_origin: Dict[Optional[str], List[Tuple[str, Optional[Tuple[int, int]], Optional[str]]]] = {}
-    for (origin, location, byte_range), checksum in sorted(
-        seen.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])
-    ):
-        by_origin.setdefault(origin, []).append((location, byte_range, checksum))
+    by_origin = _payloads_by_origin(meta)
 
     event_loop = asyncio.new_event_loop()
     ok = skipped = failed = 0
-    origin_mirrors = meta.origin_mirrors or {}
     try:
         for origin, payloads in by_origin.items():
-            # Restore-equivalent semantics: origin payloads verify through
-            # the origin's recorded mirror fallback, so verify agrees with
-            # what restore can actually read (incl. after primary loss).
-            opts = None
-            mirror = origin_mirrors.get(origin) if origin is not None else None
-            if mirror:
-                opts = {"mirror_url": mirror}
             storage = url_to_storage_plugin_in_event_loop(
-                origin if origin is not None else args.path, event_loop, opts
+                origin if origin is not None else args.path,
+                event_loop,
+                _origin_storage_options(origin, meta),
             )
             where = f" [{origin}]" if origin is not None else ""
             try:
-                for location, byte_range, checksum in payloads:
+                for location, byte_range, checksum, _nbytes, _codec in payloads:
                     if checksum is None:
                         skipped += 1
                         if args.verbose:
@@ -286,6 +353,371 @@ def cmd_verify(args: argparse.Namespace) -> int:
         event_loop.close()
     print(f"verified {ok} payloads, {skipped} without checksums, {failed} failed")
     return 1 if failed else 0
+
+
+# ------------------------------------------------------------------- fsck
+#
+# ``verify`` answers "do the payload bytes match their checksums"; fsck
+# answers the on-call's bigger question — "is this snapshot DIRECTORY in
+# a state the restore path will accept, and if not, what exactly is
+# wrong". It layers manifest<->payload existence/size agreement, chained
+# CRC verification, incremental-chain (deps) integrity, orphan/partial-
+# commit detection, and an optional quarantine repair, with CI-friendly
+# exit codes: 0 clean, 1 findings, 2 cannot-check.
+
+
+class FsckReport:
+    """Findings grouped by class. ``findings`` holds what is wrong NOW
+    (after any repair); ``repaired`` what --repair quarantined."""
+
+    #: finding classes --repair may quarantine (never payload data)
+    REPAIRABLE = ("orphan", "temp-file", "stale-fence")
+
+    def __init__(self) -> None:
+        self.findings: List[Tuple[str, str, str]] = []  # (class, where, what)
+        self.repaired: List[Tuple[str, str]] = []  # (class, where)
+        self.payloads_ok = 0
+        self.payloads_skipped = 0
+
+    def add(self, cls: str, where: str, what: str) -> None:
+        self.findings.append((cls, where, what))
+
+    def classes(self) -> set:
+        return {c for c, _, _ in self.findings}
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _fsck_local_dir(path: str) -> Optional[str]:
+    """The local directory behind ``path`` (orphan scan / repair surface),
+    or None for remote backends."""
+    from .storage_plugin import local_fs_root
+
+    return local_fs_root(path)
+
+
+def _is_not_found_error(exc: BaseException) -> bool:
+    from .storage_plugins.retry import is_not_found_error
+
+    return is_not_found_error(exc)
+
+
+def _classify_read_failure(exc: BaseException, dep_cls: Optional[str]) -> str:
+    """Map a payload-read exception to a finding class. fsck's job is to
+    diagnose, so NO read failure may escape as a crash: unknown backend
+    errors degrade to io-error (dangling-dep inside an origin chain)."""
+    if _is_not_found_error(exc):
+        return dep_cls or "missing-payload"
+    if isinstance(exc, EOFError):
+        return "truncated-payload"
+    return dep_cls or "io-error"
+
+
+def _fsck_payload_checks(
+    path: str,
+    meta: SnapshotMetadata,
+    storage_options: Optional[Dict[str, Any]],
+    report: FsckReport,
+    echo,
+    verbose: bool,
+) -> None:
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    by_origin = _payloads_by_origin(meta)
+    event_loop = asyncio.new_event_loop()
+    try:
+        for origin, payloads in by_origin.items():
+            dep_cls = "dangling-dep" if origin is not None else None
+            where_tag = f" [{origin}]" if origin is not None else ""
+            opts = _origin_storage_options(origin, meta, storage_options)
+            if origin is not None:
+                # Deps integrity: the base snapshot itself must still be a
+                # committed, readable snapshot — a payload read succeeding
+                # against an uncommitted rubble directory proves little.
+                from .snapshot import Snapshot
+
+                try:
+                    Snapshot(origin, storage_options=opts).metadata
+                except Exception as e:  # noqa: BLE001
+                    report.add(
+                        "dangling-dep",
+                        origin,
+                        f"base snapshot unreadable ({type(e).__name__}: {e})",
+                    )
+            try:
+                storage = url_to_storage_plugin_in_event_loop(
+                    origin if origin is not None else path, event_loop, opts
+                )
+            except Exception as e:  # noqa: BLE001
+                report.add(
+                    dep_cls or "io-error",
+                    origin or path,
+                    f"cannot open storage ({type(e).__name__}: {e})",
+                )
+                continue
+            origin_dir = _fsck_local_dir(origin if origin is not None else path)
+            if (opts or {}).get("mirror_url"):
+                # A mirror fallback is in play: the primary's stat proves
+                # nothing (restore reads through the failover), so every
+                # check must go through the plugin like restore does.
+                origin_dir = None
+            try:
+                for location, byte_range, checksum, nbytes, codec in payloads:
+                    where = f"{location}{where_tag}"
+                    # Existence/size agreement first, via stat where the
+                    # backend is a local filesystem with no mirror tier:
+                    # catches truncation without reading (and without
+                    # tripping SIGBUS on an mmap of a range past EOF).
+                    if origin_dir is not None:
+                        import os
+
+                        fpath = os.path.join(origin_dir, location)
+                        if not os.path.exists(fpath):
+                            report.add(
+                                dep_cls or "missing-payload", where,
+                                "payload file missing",
+                            )
+                            continue
+                        fsize = os.path.getsize(fpath)
+                        need = None
+                        if byte_range is not None:
+                            need = byte_range[1]
+                        elif codec is None and nbytes is not None:
+                            need = nbytes
+                        if need is not None and fsize < need:
+                            report.add(
+                                "truncated-payload", where,
+                                f"file is {fsize} bytes; manifest needs "
+                                f"{need}",
+                            )
+                            continue
+                    read_io = ReadIO(
+                        path=location,
+                        byte_range=tuple(byte_range) if byte_range else None,
+                    )
+                    try:
+                        event_loop.run_until_complete(storage.read(read_io))
+                    except Exception as e:  # noqa: BLE001
+                        report.add(
+                            _classify_read_failure(e, dep_cls),
+                            where,
+                            f"{type(e).__name__}: {e}",
+                        )
+                        continue
+                    buf = read_io.buf
+                    if (
+                        codec is None
+                        and byte_range is None
+                        and nbytes is not None
+                        and len(buf) != nbytes
+                    ):
+                        report.add(
+                            "size-mismatch", where,
+                            f"stored {len(buf)} bytes; manifest says {nbytes}",
+                        )
+                        continue
+                    if checksum is None:
+                        report.payloads_skipped += 1
+                        if verbose:
+                            echo(f"SKIP  {where} (no checksum recorded)")
+                        continue
+                    try:
+                        verify_checksum(buf, checksum, location)
+                    except IntegrityError as e:
+                        report.add("checksum-mismatch", where, str(e))
+                        continue
+                    report.payloads_ok += 1
+                    if verbose:
+                        echo(f"OK    {where}")
+            finally:
+                storage.sync_close(event_loop)
+    finally:
+        event_loop.close()
+
+
+def _fsck_orphan_scan(
+    local_dir: str, meta: SnapshotMetadata, report: FsckReport
+) -> None:
+    import os
+
+    from .snapshot import SNAPSHOT_FENCE_FNAME, SNAPSHOT_METADATA_FNAME
+
+    referenced = set()
+    for entry in meta.manifest.values():
+        for location, _, _, _, origin, _ in _entry_payloads_ex(entry):
+            if origin is None:
+                referenced.add(os.path.normpath(location))
+
+    internal_files = {SNAPSHOT_METADATA_FNAME, ".snapshot_telemetry"}
+    internal_prefixes = (".telemetry", ".fsck_quarantine")
+    for dirpath, dirnames, filenames in os.walk(local_dir):
+        rel_dir = os.path.relpath(dirpath, local_dir)
+        top = (rel_dir.split(os.sep, 1)[0] if rel_dir != "." else "")
+        if top in internal_prefixes:
+            dirnames[:] = []
+            continue
+        for fname in sorted(filenames):
+            rel = os.path.normpath(
+                os.path.join(rel_dir, fname) if rel_dir != "." else fname
+            )
+            if rel in referenced or rel in internal_files:
+                continue
+            if rel == SNAPSHOT_FENCE_FNAME:
+                report.add(
+                    "stale-fence", rel,
+                    "commit fence outlived a committed snapshot (interrupted "
+                    "fence cleanup, or a foreign in-flight take)",
+                )
+                continue
+            if ".tmp." in rel:
+                report.add(
+                    "temp-file", rel,
+                    "write temp file left behind by a dead writer",
+                )
+            else:
+                report.add("orphan", rel, "not referenced by the manifest")
+        if rel_dir != "." and not filenames and not dirnames:
+            report.add("orphan", rel_dir, "empty directory")
+
+
+def _fsck_repair(local_dir: str, report: FsckReport, echo) -> None:
+    """Quarantine repairable findings under ``.fsck_quarantine/``
+    (preserving relative paths) — never deletes, never touches payload
+    data, so a mistaken repair is always reversible by moving back."""
+    import os
+    import shutil
+
+    quarantine = os.path.join(local_dir, ".fsck_quarantine")
+    remaining: List[Tuple[str, str, str]] = []
+    for cls, where, what in report.findings:
+        if cls not in FsckReport.REPAIRABLE:
+            remaining.append((cls, where, what))
+            continue
+        src = os.path.join(local_dir, where)
+        dst = os.path.join(quarantine, where)
+        try:
+            os.makedirs(os.path.dirname(dst) or quarantine, exist_ok=True)
+            shutil.move(src, dst)
+            # Prune directories the move emptied — a leftover empty
+            # temp dir would re-surface as an orphan on the next fsck.
+            parent = os.path.dirname(src)
+            while (
+                os.path.realpath(parent) != os.path.realpath(local_dir)
+                and os.path.isdir(parent)
+                and not os.listdir(parent)
+            ):
+                os.rmdir(parent)
+                parent = os.path.dirname(parent)
+        except OSError as e:
+            remaining.append((cls, where, f"{what} (repair failed: {e})"))
+            continue
+        report.repaired.append((cls, where))
+        echo(f"QUARANTINED  {where} -> .fsck_quarantine/{where}")
+    report.findings = remaining
+
+
+def run_fsck(
+    path: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+    repair: bool = False,
+    verbose: bool = False,
+    echo=print,
+) -> Tuple[int, FsckReport]:
+    """Full snapshot consistency check. Returns (exit_code, report):
+    0 clean, 1 findings survived (corruption, orphans not repaired,
+    partial commit), 2 cannot-check (no snapshot there at all)."""
+    import os
+
+    from .manifest import CorruptSnapshotError
+    from .snapshot import (
+        SNAPSHOT_FENCE_FNAME,
+        SNAPSHOT_METADATA_FNAME,
+        Snapshot,
+    )
+
+    report = FsckReport()
+    local_dir = _fsck_local_dir(path)
+    try:
+        meta = Snapshot(path, storage_options=storage_options).metadata
+    except CorruptSnapshotError as e:
+        report.add("corrupt-metadata", SNAPSHOT_METADATA_FNAME, e.detail)
+        echo(f"CORRUPT  {SNAPSHOT_METADATA_FNAME}: {e.detail}")
+        echo(
+            "fsck: metadata unreadable — treat the snapshot as uncommitted "
+            "(payloads not checked)"
+        )
+        return 1, report
+    except Exception as e:  # noqa: BLE001
+        if not _is_not_found_error(e):
+            # Transport/auth/backend failure: we cannot tell anything
+            # about the snapshot — that's cannot-check (2), reported as
+            # a diagnosis through the caller's echo, never a traceback.
+            echo(
+                f"error: cannot read snapshot metadata at {path} "
+                f"({type(e).__name__}: {e})"
+            )
+            return 2, report
+        # No commit point. Distinguish "a dead writer's partial directory"
+        # (a finding) from "nothing resembling a snapshot" (cannot-check).
+        if local_dir is not None and not os.path.isdir(local_dir):
+            echo(f"error: {path} does not exist")
+            return 2, report
+        residue: List[str] = []
+        if local_dir is not None:
+            for dirpath, _, filenames in os.walk(local_dir):
+                for fname in filenames:
+                    residue.append(
+                        os.path.relpath(os.path.join(dirpath, fname), local_dir)
+                    )
+        if residue:
+            fence = SNAPSHOT_FENCE_FNAME in residue
+            report.add(
+                "partial-commit",
+                path,
+                f"{len(residue)} file(s) but no {SNAPSHOT_METADATA_FNAME}"
+                + (" (commit fence present: writer died mid-take)" if fence
+                   else ""),
+            )
+            echo(
+                f"PARTIAL  {path}: {len(residue)} file(s), no "
+                f"{SNAPSHOT_METADATA_FNAME} — an uncommitted take; the "
+                "snapshot never existed. Safe to delete (the manager "
+                "reclaims it on the next save)."
+            )
+            return 1, report
+        echo(f"error: no snapshot at {path}")
+        return 2, report
+
+    _fsck_payload_checks(path, meta, storage_options, report, echo, verbose)
+    if local_dir is not None:
+        _fsck_orphan_scan(local_dir, meta, report)
+    else:
+        echo("note: remote backend — orphan scan skipped (payload and "
+             "chain checks only)")
+
+    if repair and local_dir is not None and report.findings:
+        _fsck_repair(local_dir, report, echo)
+
+    for cls, where, what in report.findings:
+        echo(f"{cls.upper():18s} {where}: {what}")
+    echo(
+        f"fsck {path}: {report.payloads_ok} payload(s) verified, "
+        f"{report.payloads_skipped} without checksums, "
+        f"{len(report.findings)} finding(s)"
+        + (f", {len(report.repaired)} quarantined" if report.repaired else "")
+    )
+    return (1 if report.findings else 0), report
+
+
+def cmd_fsck(args: argparse.Namespace) -> int:
+    code, _ = run_fsck(
+        args.path,
+        repair=args.repair,
+        verbose=args.verbose,
+    )
+    return code
 
 
 def cmd_migrate(args: argparse.Namespace) -> int:
@@ -693,6 +1125,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("path")
     p.add_argument("-v", "--verbose", action="store_true")
     p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser(
+        "fsck",
+        help="full consistency check: payload existence/size/CRC, "
+             "incremental deps, orphans, partial commits "
+             "(exit 0 clean / 1 findings / 2 cannot-check)",
+    )
+    p.add_argument("path")
+    p.add_argument("--repair", action="store_true",
+                   help="quarantine orphans/temp files under "
+                        ".fsck_quarantine/ (never deletes payload data)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.set_defaults(fn=cmd_fsck)
 
     p = sub.add_parser(
         "stats",

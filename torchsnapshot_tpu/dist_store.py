@@ -24,9 +24,36 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import faultinject
+
 logger = logging.getLogger(__name__)
 
-DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+BARRIER_TIMEOUT_ENV_VAR = "TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT"
+
+
+def _read_barrier_timeout() -> float:
+    """Collective/barrier deadline (seconds). Env-configurable because
+    the 1800 s default is sized for pod-scale takes on slow durable
+    storage — a test rig or a latency-sensitive serving job wants rank
+    death during planning to fail EVERY rank fast, not half an hour
+    late. Read once at import (subprocess workers inherit the env)."""
+    raw = os.environ.get(BARRIER_TIMEOUT_ENV_VAR, "").strip()
+    if raw:
+        try:
+            value = float(raw)
+            if value > 0:
+                return value
+            logger.warning(
+                "ignoring non-positive %s=%r", BARRIER_TIMEOUT_ENV_VAR, raw
+            )
+        except ValueError:
+            logger.warning(
+                "ignoring non-numeric %s=%r", BARRIER_TIMEOUT_ENV_VAR, raw
+            )
+    return 1800.0
+
+
+DEFAULT_BARRIER_TIMEOUT_S = _read_barrier_timeout()
 # Client-side response deadlines: the store SERVER is itself a peer that
 # can die (it lives in rank 0's process — the same SPOF the reference's
 # rank-0-hosted TCPStore has, dist_store.py:53-88). A killed server
@@ -380,6 +407,10 @@ class TCPStore:
             if op_timeout is not None
             else STORE_RPC_TIMEOUT_S
         )
+        # OUTSIDE the lock/try: an injected transient store fault models a
+        # blip that failed one request, not a torn connection — the client
+        # must not latch dead (a permanent/kill plan models the latter).
+        faultinject.site("dist_store.rpc")
         with self._lock:
             if self._dead is not None:
                 # The connection is gone (and mid-message state would be
@@ -560,6 +591,7 @@ def send_peer_frame(sock: socket.socket, header: Dict[str, Any], payload=None) -
     (a lock per connection) — interleaved sendalls would corrupt the
     framing."""
     h = pickle.dumps(header)
+    payload = faultinject.mutate("peer.send_frame", payload)
     mv = memoryview(payload).cast("B") if payload is not None else None
     sock.sendall(_LEN.pack(len(h)) + h + _LEN.pack(mv.nbytes if mv is not None else 0))
     if mv is not None and mv.nbytes:
@@ -584,6 +616,7 @@ def recv_peer_frame(
     slab, so repeated sub-chunk receives don't pay first-touch page
     faults on every frame); default allocates a fresh bytearray. The
     returned view stays valid for as long as the caller holds it."""
+    faultinject.site("peer.recv_frame")
     (hlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     header = pickle.loads(_recv_exact(sock, hlen))
     (plen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))

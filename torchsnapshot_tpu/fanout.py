@@ -729,7 +729,13 @@ class CoopRestoreSession:
             key = unit_key(rr)
             if key is not None and key not in local:
                 local[key] = _unit_nbytes(rr)
-        gathered = pg_wrapper.all_gather_object(sorted(local.items()))
+        # The plan gather owns its own bounded deadline (the coop
+        # timeout, default 600 s) instead of inheriting the 1800 s
+        # barrier default: a rank dying mid-plan fails every rank fast,
+        # and the failure degrades the restore rather than hanging it.
+        gathered = pg_wrapper.all_gather_object(
+            sorted(local.items()), timeout=self._timeout
+        )
 
         requesters: Dict[str, List[int]] = {}
         sizes: Dict[str, int] = {}

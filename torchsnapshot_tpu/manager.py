@@ -136,11 +136,9 @@ class CheckpointManager:
     # ----------------------------------------------------------- paths
 
     def _local_dir(self) -> Optional[str]:
-        if self.root.startswith("fs://"):
-            return self.root[len("fs://"):]
-        if "://" in self.root:
-            return None
-        return self.root
+        from .storage_plugin import local_fs_root
+
+        return local_fs_root(self.root)
 
     def path_for(self, step: int) -> str:
         sep = "" if self.root.endswith("/") else "/"
@@ -362,6 +360,20 @@ class CheckpointManager:
             logger.info("step %d already has a committed snapshot; skipping", step)
             return False
 
+        self._gc_orphaned_partials(step)
+        # Order the GC BEFORE any peer's payload writes: rank 0 releases
+        # the peers only after its rmtree pass. Without this, the only
+        # ordering collective is the hostname all-gather inside
+        # get_process_memory_budget_bytes — which the MEMORY_BUDGET env
+        # var short-circuits, letting a peer land payloads in the step
+        # dir while rank 0's GC still sees it as uncommitted rubble and
+        # deletes them (a committed-but-unrestorable snapshot).
+        pg = PGWrapper(self.pg)
+        if pg.get_world_size() > 1:
+            try:
+                pg.broadcast_object("gc-done" if pg.get_rank() == 0 else None, src=0)
+            finally:
+                pg.retire()
         path = self.path_for(step)
         base = (
             self.path_for(self._last_committed)
@@ -401,6 +413,84 @@ class CheckpointManager:
             self.preemption.consume()
             logger.warning("emergency snapshot committed at step %d", step)
         return True
+
+    def _gc_orphaned_partials(self, step: int) -> None:
+        """Fenced GC: reclaim partial step directories a crashed writer
+        left behind (payloads, no ``.snapshot_metadata``) before taking
+        ``step``. Without this, every SIGKILLed save leaks a partial tree
+        that resume discovery must skip forever.
+
+        Safety comes from the commit-fence protocol, not from timing:
+
+        - only step directories ``<= step`` are touched — under the
+          manager's ordered-save contract nothing older can still be
+          in flight on a healthy world (a pending async save was drained
+          by ``save`` before this runs);
+        - a *resurrected* straggler of a reclaimed directory (the one
+          case ordering cannot exclude: an async commit thread from a
+          previous incarnation of this world) cannot commit into the
+          rubble — its generation fence is gone, so its commit aborts
+          with :class:`~torchsnapshot_tpu.snapshot.StaleCommitError`
+          (see snapshot.SNAPSHOT_FENCE_FNAME). The residual window is
+          one storage round trip — a straggler suspended between its
+          passing fence read and its metadata write; see
+          ``Snapshot._write_snapshot_metadata`` — and a splice through
+          it is fsck-detectable, never silently restorable.
+
+        The mirror tier is scanned too: each step mirrors into its own
+        subdirectory of ``mirror_url`` with its own metadata commit, so
+        a crashed mirrored save leaves a second partial tree there. The
+        fence argument covers it — a straggler's mirror metadata flush
+        happens only after its primary commit check passes, which the
+        reclaimed fence prevents. A mirror step dir is reclaimed ONLY
+        when the primary step is also uncommitted: the mirror's metadata
+        commit is deferred (and suppressed after any mirror write
+        failure), so a committed primary can legitimately own a
+        metadata-less mirror tree — that is degraded failover data for
+        the current resume point, not rubble.
+
+        Rank 0 only (the commit barrier already serializes saves), local
+        filesystem roots only (remote roots have no cheap scan — fsck
+        covers them on demand)."""
+        if PGWrapper(self.pg).get_rank() != 0:
+            return
+        from .storage_plugin import local_fs_root
+
+        primary_dir = self._local_dir()
+        roots = [primary_dir]
+        mirror_root = (self.storage_options or {}).get("mirror_url")
+        if mirror_root and primary_dir is not None:
+            # Without a scannable primary we cannot tell committed steps
+            # from rubble — leave the mirror tier alone.
+            roots.append(local_fs_root(mirror_root.rstrip("/")))
+        import shutil
+
+        for dirpath in roots:
+            if dirpath is None or not os.path.isdir(dirpath):
+                continue
+            for name in sorted(os.listdir(dirpath)):
+                m = _STEP_RE.match(name)
+                if not m or int(m.group(1)) > step:
+                    continue
+                partial = os.path.join(dirpath, name)
+                if not os.path.isdir(partial):
+                    continue
+                if os.path.exists(
+                    os.path.join(partial, ".snapshot_metadata")
+                ):
+                    continue
+                if dirpath is not primary_dir and os.path.exists(
+                    os.path.join(primary_dir, name, ".snapshot_metadata")
+                ):
+                    # Committed primary: this mirror tree is live (if
+                    # incomplete) failover redundancy, never reclaimed.
+                    continue
+                logger.warning(
+                    "reclaiming partial snapshot directory %s (no committed "
+                    "metadata; a previous writer died mid-save)",
+                    partial,
+                )
+                shutil.rmtree(partial, ignore_errors=True)
 
     def wait(self) -> None:
         """Drain a pending async save (no-op otherwise); re-raises its

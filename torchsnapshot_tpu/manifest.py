@@ -39,6 +39,35 @@ except ImportError:  # pragma: no cover
     from yaml import SafeDumper as _Dumper, SafeLoader as _Loader
 
 
+class CorruptSnapshotError(RuntimeError):
+    """``.snapshot_metadata`` exists but cannot be decoded.
+
+    Before this exception, a torn or zero-byte metadata file surfaced as
+    whatever the decoder tripped over first — ``JSONDecodeError``,
+    ``yaml.YAMLError``, ``KeyError: 'manifest'``, ``UnicodeDecodeError``
+    — none of which tell an operator the one thing that matters: the
+    snapshot should be treated as UNCOMMITTED. The commit protocol makes
+    this state near-impossible for the library's own writers (temp-file +
+    atomic rename), so a corrupt metadata file means out-of-band damage:
+    a non-atomic copy (``cp``/``rsync`` mid-write), storage-layer
+    truncation, or a foreign writer. ``fsck`` reports it as the
+    ``corrupt-metadata`` finding class.
+    """
+
+    def __init__(self, path: str, detail: str) -> None:
+        super().__init__(
+            f"Snapshot metadata at {path!r} is unreadable ({detail}). This "
+            "usually means a torn or partial commit reached the metadata "
+            "file through an out-of-band channel (non-atomic copy, storage "
+            "truncation) — the library's own commit is atomic. Treat the "
+            "snapshot as uncommitted and restore from the previous "
+            "committed snapshot; run `python -m torchsnapshot_tpu fsck` "
+            "for a full diagnosis."
+        )
+        self.path = path
+        self.detail = detail
+
+
 @dataclass
 class Entry:
     type: str

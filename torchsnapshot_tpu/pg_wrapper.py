@@ -261,11 +261,12 @@ class PGWrapper:
             payload = pickle.dumps(RuntimeError(repr(err)))
         self.pg.store.set(self._error_key(), payload)
 
-    def _wait(self, key: str) -> bytes:
+    def _wait(self, key: str, timeout: Optional[float] = None) -> bytes:
         """Wait for ``key``, racing it against the error channel and the
-        death channel."""
+        death channel. ``timeout`` overrides the store's default (the
+        barrier timeout) for collectives that own a tighter deadline."""
         got_key, value = self.pg.store.wait_any(
-            [key, self._error_key(), DEATH_KEY]
+            [key, self._error_key(), DEATH_KEY], timeout
         )
         if got_key != key:
             err = pickle.loads(value)
@@ -288,7 +289,9 @@ class PGWrapper:
             return obj
         return _loads(self._wait(key))
 
-    def all_gather_object(self, obj: Any) -> List[Any]:
+    def all_gather_object(
+        self, obj: Any, timeout: Optional[float] = None
+    ) -> List[Any]:
         """All ranks contribute; all ranks receive every contribution.
 
         Leader-assembled: peers post their pieces, rank 0 collects them in
@@ -297,7 +300,12 @@ class PGWrapper:
         the per-rank shards are highly redundant), and peers fetch that one
         key. Per-rank round trips are constant in world size, and the
         server never assembles a world-entry response per peer — the two
-        O(world²) behaviors a naive per-peer read loop has."""
+        O(world²) behaviors a naive per-peer read loop has.
+
+        ``timeout`` bounds THIS collective's wait (seconds) instead of the
+        store's default barrier timeout — collectives with a natural
+        tighter deadline (the cooperative-restore plan gather) fail fast
+        on rank death rather than inheriting the 1800 s commit budget."""
         if self.get_world_size() == 1:
             return [obj]
         ns = self._namespace()
@@ -310,6 +318,7 @@ class PGWrapper:
                 prefix,
                 self.get_world_size() - 1,
                 stop_keys=[self._error_key(), DEATH_KEY],
+                timeout=timeout,
             )
             if stopped is not None:
                 err = pickle.loads(items[stopped])
@@ -325,7 +334,7 @@ class PGWrapper:
             store.set(all_key, _dumps(assembled))
             return assembled
         store.set(f"{prefix}{self.get_rank()}", _dumps(obj))
-        return _loads(self._wait(all_key))
+        return _loads(self._wait(all_key, timeout))
 
     def scatter_object(self, objs: Optional[List[Any]], src: int = 0) -> Any:
         if self.get_world_size() == 1:

@@ -80,7 +80,7 @@ from typing import Dict, List, Optional, Set
 
 import psutil
 
-from . import telemetry
+from . import faultinject, telemetry
 from .io_types import (
     ReadIO,
     ReadReq,
@@ -466,6 +466,7 @@ class _WritePipeline:
             self.admission_cost_bytes = self.staging_cost_bytes
 
     async def stage_buffer(self, executor) -> "_WritePipeline":
+        faultinject.site("scheduler.stage")
         with telemetry.span(
             "stage", path=self.write_req.path, bytes=self.staging_cost_bytes
         ):
@@ -1172,6 +1173,12 @@ class _ReadPipeline:
             # IntegrityError a checksum mismatch of peer-delivered bytes
             # — storage may still hold good bytes, so re-read directly
             # (and surface storage's own error if it does not).
+            # The degraded-path exception is accounted exactly like a
+            # storage retry: classify_error kind + history attrs on the
+            # exception object, one taxonomy for every fallback.
+            from .storage_plugins.retry import attach_fallback_history
+
+            kind = attach_fallback_history(e)
             logger.warning(
                 "peer-fed read of %s from rank %s failed (%s: %s); falling "
                 "back to a direct storage read",
@@ -1181,6 +1188,14 @@ class _ReadPipeline:
                 e,
             )
             telemetry.counter_add("fanout_fallbacks", 1)
+            telemetry.event(
+                "fanout_fallback",
+                cat="retry",
+                kind=kind,
+                path=path,
+                source=role.owner,
+                error=type(e).__name__,
+            )
             self._recharge(budget)
             return False
 

@@ -48,7 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import faultinject, telemetry
 
 from .batcher import batch_read_requests, batch_write_requests, batching_enabled
 from .dist_store import DEFAULT_BARRIER_TIMEOUT_S, LinearBarrier
@@ -67,6 +67,7 @@ from .io_preparers.array import zero_copy_staging
 from .io_preparers.prepare import is_jax_array
 from .manifest import (
     ChunkedArrayEntry,
+    CorruptSnapshotError,
     Entry,
     Manifest,
     PrimitiveEntry,
@@ -125,6 +126,35 @@ class _PhaseTimer:
         )
 
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
+# Commit fence: written by rank 0 BEFORE any payload I/O with this take's
+# generation token, re-read at the commit point, deleted after a
+# successful commit. A resurrected straggler (an async commit thread that
+# outlived its world, a hung rank resuming after a restart re-took the
+# step) finds a foreign or missing token and aborts instead of committing
+# stale metadata over a newer snapshot. Committed snapshots carry no
+# fence; a fence without metadata marks an in-flight or abandoned take
+# (fsck's partial-commit signal).
+SNAPSHOT_FENCE_FNAME = ".snapshot_fence"
+
+
+class StaleCommitError(RuntimeError):
+    """The commit fence no longer carries this take's generation token —
+    a newer take claimed (or garbage-collection reclaimed) the snapshot
+    path while this take was in flight. Nothing was committed; the newer
+    snapshot, if any, is untouched."""
+
+    def __init__(self, path: str, expected: str, found: Optional[str]) -> None:
+        super().__init__(
+            f"Refusing to commit snapshot metadata at {path!r}: the commit "
+            f"fence holds {found!r}, not this take's generation "
+            f"{expected!r}. A newer take has claimed this path (or its "
+            "partial directory was garbage-collected); committing would "
+            "splice this take's manifest over the newer snapshot's "
+            "payloads. This take is aborted; nothing was committed."
+        )
+        self.path = path
+        self.expected = expected
+        self.found = found
 
 
 def _drain_background_storage(
@@ -234,13 +264,30 @@ class Snapshot:
                     device_digests=device_digests,
                     streaming=True,
                 )
-            pending_io_work.sync_complete(event_loop)
-            _drain_background_storage(storage, event_loop)
-            timer.mark("io_drain")
-            pg_wrapper.barrier()
-            if pg_wrapper.get_rank() == 0:
-                cls._write_snapshot_metadata(metadata, storage, event_loop)
-            pg_wrapper.barrier()
+            # Drain + commit, with the cross-rank error channel armed:
+            # staging errors ride the manifest gather inside _take_impl,
+            # but a storage write can also fail HERE — in the post-gather
+            # drain (an io task that was still in flight when the gather
+            # ran) or at the fenced metadata write. Without report_error,
+            # one rank raising in this phase deserts its peers at the
+            # commit barrier until the barrier timeout (the 1800 s hang
+            # class); with it, every blocked collective of this wrapper
+            # raises immediately. (async_take's LinearBarrier has its own
+            # error channel for the same phase.)
+            try:
+                pending_io_work.sync_complete(event_loop)
+                _drain_background_storage(storage, event_loop)
+                timer.mark("io_drain")
+                pg_wrapper.barrier()
+                if pg_wrapper.get_rank() == 0:
+                    cls._write_snapshot_metadata(metadata, storage, event_loop)
+                pg_wrapper.barrier()
+            except BaseException as e:  # noqa: B036
+                try:
+                    pg_wrapper.report_error(e)
+                except Exception:
+                    pass
+                raise
             timer.mark("commit")
             timer.log()
             # AFTER the commit barrier: a telemetry failure can degrade
@@ -554,6 +601,30 @@ class Snapshot:
             memory_budget = get_process_memory_budget_bytes(
                 pg_wrapper if world_size > 1 else None
             )
+            # Claim the snapshot path BEFORE any payload I/O: rank 0
+            # plants this take's generation token as the commit fence.
+            # The commit point re-reads it — see SNAPSHOT_FENCE_FNAME.
+            # Async takes plant here too, NOT in the background commit
+            # thread: a fence planted after async_take returns would be
+            # self-satisfying — a straggler suspended before its own
+            # plant, reclaimed by the manager's fenced GC and re-taken,
+            # would resume, plant its own token over the newer snapshot,
+            # pass its own commit check, and splice stale metadata. Only
+            # plant-before-return makes "its fence is gone" (the GC's
+            # safety argument) actually final. One small fence write on
+            # the staging path buys that; a storage failure here fails
+            # the take fast, before any staging work — captured, not
+            # raised: on a multi-rank take an immediate raise would
+            # desert the peers at the manifest gather below until the
+            # barrier timeout, so the failure rides the collective like
+            # every other stage-time error.
+            commit_gen = uuid.uuid4().hex
+            fence_exc: Optional[BaseException] = None
+            if rank == 0:
+                try:
+                    cls._write_fence(commit_gen, storage, event_loop)
+                except BaseException as e:  # noqa: B036
+                    fence_exc = e
             timer.mark("plan")
             # Gather AFTER execute_write_reqs returns: staging (the
             # consistency point) is complete by then, so stage-time entry
@@ -563,7 +634,7 @@ class Snapshot:
             # staging failure must still reach the collective (a deserted
             # all-gather hangs every peer), so the error rides it too and
             # is raised on every rank afterwards — no rank commits.
-            stage_exc: Optional[BaseException] = materialize_exc
+            stage_exc: Optional[BaseException] = materialize_exc or fence_exc
             pending_io_work = None
             if stage_exc is None:
                 try:
@@ -602,6 +673,11 @@ class Snapshot:
                 mirror_url=own_mirror,
                 origin_mirrors=origin_mirrors or None,
             )
+            # Runtime-only commit context (never serialized — to_yaml
+            # walks declared fields only): the fence token the commit
+            # point must still find, and the path for error reporting.
+            metadata._commit_gen = commit_gen
+            metadata._commit_path = path
             return pending_io_work, metadata
         finally:
             # Undo any RNG perturbation caused by state_dict materialization.
@@ -1429,13 +1505,73 @@ class Snapshot:
                 event_loop.close()
         return self._metadata
 
-    @staticmethod
     def _read_metadata(
-        storage: StoragePlugin, event_loop: asyncio.AbstractEventLoop
+        self, storage: StoragePlugin, event_loop: asyncio.AbstractEventLoop
     ) -> SnapshotMetadata:
         read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
         event_loop.run_until_complete(storage.read(read_io))
-        return SnapshotMetadata.from_yaml(bytes(read_io.buf).decode("utf-8"))
+        raw = bytes(read_io.buf)
+        # A zero-byte (or whitespace-only) metadata file and a torn one
+        # both mean the same operational thing — the commit never fully
+        # landed — but used to surface as whatever the decoder tripped
+        # over first (JSONDecodeError, YAMLError, KeyError, Unicode
+        # errors). Name the condition and the path instead.
+        if not raw.strip():
+            raise CorruptSnapshotError(self.path, "zero-byte metadata file")
+        try:
+            return SnapshotMetadata.from_yaml(raw.decode("utf-8"))
+        except Exception as e:  # noqa: BLE001 - any decode failure
+            raise CorruptSnapshotError(
+                self.path,
+                f"undecodable metadata: {type(e).__name__}: {e}",
+            ) from e
+
+    @staticmethod
+    def _write_fence(
+        gen: str,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+    ) -> None:
+        event_loop.run_until_complete(
+            storage.write(
+                WriteIO(
+                    path=SNAPSHOT_FENCE_FNAME,
+                    buf=json.dumps(
+                        {
+                            "gen": gen,
+                            "pid": os.getpid(),
+                            "version": __version__,
+                        }
+                    ).encode("utf-8"),
+                )
+            )
+        )
+
+    @staticmethod
+    def _read_fence_gen(
+        storage: StoragePlugin, event_loop: asyncio.AbstractEventLoop
+    ) -> Optional[str]:
+        """The generation token currently fencing this snapshot path, or
+        None when the fence is missing or torn (both mean: not ours — a
+        newer take reclaimed the path, or a foreign writer is mid-plant).
+
+        Only not-found and decode failures map to None: a TRANSPORT error
+        reading the fence propagates as itself, so the commit fails with
+        the real storage diagnosis instead of a misleading
+        StaleCommitError claiming a generation conflict."""
+        read_io = ReadIO(path=SNAPSHOT_FENCE_FNAME)
+        try:
+            event_loop.run_until_complete(storage.read(read_io))
+        except Exception as e:  # noqa: BLE001
+            from .storage_plugins.retry import is_not_found_error
+
+            if is_not_found_error(e):
+                return None
+            raise
+        try:
+            return json.loads(bytes(read_io.buf).decode("utf-8")).get("gen")
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return None  # torn fence: a foreign writer is mid-plant
 
     @staticmethod
     def _write_snapshot_metadata(
@@ -1443,14 +1579,46 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
     ) -> None:
-        event_loop.run_until_complete(
-            storage.write(
-                WriteIO(
-                    path=SNAPSHOT_METADATA_FNAME,
-                    buf=metadata.to_yaml().encode("utf-8"),
+        """The commit point. Generation-fenced when the metadata carries
+        a take's commit context (see SNAPSHOT_FENCE_FNAME): commit only
+        if the fence still holds THIS take's token, and clear the fence
+        once the metadata is durable. Callers without a fence (e.g.
+        ``consolidate`` materializing a chain) commit unfenced.
+
+        The check is check-then-act, not compare-and-swap (plain
+        filesystems and object stores offer no CAS): a straggler
+        suspended BETWEEN its passing fence read and its metadata write,
+        reclaimed and re-taken in that exact gap, can still splice. The
+        fence shrinks the unprotected window from the whole drain
+        (seconds to minutes) to one storage round trip; a splice that
+        threads that needle is checksum-detectable by fsck, not
+        silent-restorable."""
+        gen = getattr(metadata, "_commit_gen", None)
+        if gen is not None:
+            found = Snapshot._read_fence_gen(storage, event_loop)
+            if found != gen:
+                raise StaleCommitError(
+                    getattr(metadata, "_commit_path", "<unknown>"), gen, found
                 )
-            )
+        buf = faultinject.mutate(
+            "commit.metadata", metadata.to_yaml().encode("utf-8")
         )
+        event_loop.run_until_complete(
+            storage.write(WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=buf))
+        )
+        if gen is not None:
+            try:
+                event_loop.run_until_complete(
+                    storage.delete(SNAPSHOT_FENCE_FNAME)
+                )
+            except Exception:  # noqa: BLE001
+                # Committed but the fence lingers: harmless (fsck flags
+                # it as a stale fence; the next take overwrites it).
+                logger.warning(
+                    "committed, but could not remove the commit fence %s",
+                    SNAPSHOT_FENCE_FNAME,
+                    exc_info=True,
+                )
 
     # ------------------------------------------------------------- telemetry
 
@@ -1933,6 +2101,11 @@ class PendingSnapshot:
     ) -> None:
         barrier = None
         try:
+            # The commit fence was planted at plan time, before
+            # async_take returned (NOT here: a plant on this thread would
+            # be self-satisfying after a fenced-GC reclaim — see the
+            # plant site in _take_impl). The commit point below only
+            # re-checks the token.
             if pg_wrapper.get_world_size() > 1:
                 # Own store connection: the main thread keeps using the
                 # primary. Inside the try: a dead store host (clone raises

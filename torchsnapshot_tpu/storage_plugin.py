@@ -73,6 +73,17 @@ def url_to_storage_plugin(
     )
 
 
+def local_fs_root(url_path: str) -> Optional[str]:
+    """The local directory behind ``url_path`` when it resolves to the
+    filesystem plugin (``fs://`` or a bare path), else None. The one
+    shared scheme rule for every surface that needs a scannable local
+    tree (fsck's orphan scan/repair, the manager's discovery/retention/
+    partial-dir GC)."""
+    if url_path.startswith("fs://"):
+        return url_path[len("fs://"):]
+    return None if "://" in url_path else url_path
+
+
 def strip_mirror_options(
     storage_options: Optional[Dict[str, Any]]
 ) -> Optional[Dict[str, Any]]:

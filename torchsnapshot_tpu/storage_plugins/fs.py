@@ -31,6 +31,7 @@ except ImportError:
     # local-FS plugin must never be the backend that import-fails.
     from .. import _aio as aiofiles
 
+from .. import faultinject
 from ..io_types import ReadIO, ReadStream, StoragePlugin, WriteIO, WriteStream
 
 FSYNC_ENV_VAR = "TORCHSNAPSHOT_TPU_FSYNC"
@@ -84,9 +85,10 @@ class FSStoragePlugin(StoragePlugin):
         # the last completed replace wins a whole file, never a mix.
         tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
         loop = asyncio.get_running_loop()
+        buf = faultinject.mutate("fs.write", write_io.buf)
         try:
             async with aiofiles.open(tmp, "wb") as f:
-                await f.write(write_io.buf)
+                await f.write(buf)
                 if self._fsync:
                     await f.flush()
                     # Blocking flush latency belongs in the I/O thread pool,
@@ -114,7 +116,7 @@ class FSStoragePlugin(StoragePlugin):
         runs in an executor thread). Returns bytes written. pwrite never
         moves a shared file offset, so sub-chunk writes need no seek
         bookkeeping and tolerate future out-of-order producers."""
-        mv = memoryview(buf).cast("B")
+        mv = memoryview(faultinject.mutate("fs.pwrite", buf)).cast("B")
         written = 0
         while written < mv.nbytes:
             written += os.pwrite(fd, mv[written:], offset + written)
@@ -169,6 +171,16 @@ class FSStoragePlugin(StoragePlugin):
         gran = _mmap.ALLOCATIONGRANULARITY
         aligned = lo - (lo % gran)
         with open(path, "rb") as f:
+            # A truncated file must surface as EOFError (the taxonomy the
+            # buffered path below and the mirror failover both speak) —
+            # not CPython mmap's ValueError, and never a SIGBUS on first
+            # touch of a page past EOF.
+            fsize = os.fstat(f.fileno()).st_size
+            if lo + size > fsize:
+                raise EOFError(
+                    f"short read: {path} is {fsize} bytes; range "
+                    f"[{lo}, {lo + size}) extends past EOF"
+                )
             m = _mmap.mmap(
                 f.fileno(),
                 size + (lo - aligned),
@@ -203,28 +215,28 @@ class FSStoragePlugin(StoragePlugin):
             # keeps the buffer writable for zero-copy consumers without
             # ever dirtying the file.
             loop = asyncio.get_running_loop()
-            read_io.buf = await loop.run_in_executor(
+            buf = await loop.run_in_executor(
                 None, self._mmap_read, path, lo, size
             )
-            return
-        # Small payloads: readinto a preallocated bytearray (one page-cache
-        # copy). Like the mmap path the result is WRITABLE, so downstream
-        # zero-copy numpy views are writable arrays.
-        async with aiofiles.open(path, "rb") as f:
-            if lo:
-                await f.seek(lo)
-            buf = bytearray(size)
-            view = memoryview(buf)
-            got = 0
-            while got < size:
-                n = await f.readinto(view[got:])
-                if not n:
-                    raise EOFError(
-                        f"short read: {path} yielded {got} of {size} bytes "
-                        f"(offset {lo})"
-                    )
-                got += n
-            read_io.buf = buf
+        else:
+            # Small payloads: readinto a preallocated bytearray (one
+            # page-cache copy). Like the mmap path the result is WRITABLE,
+            # so downstream zero-copy numpy views are writable arrays.
+            async with aiofiles.open(path, "rb") as f:
+                if lo:
+                    await f.seek(lo)
+                buf = bytearray(size)
+                view = memoryview(buf)
+                got = 0
+                while got < size:
+                    n = await f.readinto(view[got:])
+                    if not n:
+                        raise EOFError(
+                            f"short read: {path} yielded {got} of {size} "
+                            f"bytes (offset {lo})"
+                        )
+                    got += n
+        read_io.buf = faultinject.mutate("fs.read", buf)
 
     @staticmethod
     def _pread_exact(fd: int, lo: int, hi: int):
@@ -253,7 +265,7 @@ class FSStoragePlugin(StoragePlugin):
                     f"(offset {lo})"
                 )
             got += n
-        return view
+        return memoryview(faultinject.mutate("fs.pread", view))
 
     async def read_stream(self, read_io: ReadIO, sub_chunk_bytes: int) -> ReadStream:
         """Streaming variant of ``read``: sub-chunk pread windows with a

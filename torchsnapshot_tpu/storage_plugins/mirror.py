@@ -46,6 +46,7 @@ import asyncio
 import logging
 from typing import Any, Dict, List, Optional, Set
 
+from .. import faultinject, telemetry
 from ..io_types import (
     ReadIO,
     ReadStream,
@@ -53,6 +54,7 @@ from ..io_types import (
     StreamRestartRequired,
     WriteIO,
 )
+from .retry import attach_fallback_history, classify_error
 
 logger = logging.getLogger(__name__)
 
@@ -128,13 +130,35 @@ class MirroredStoragePlugin(StoragePlugin):
         self._mirror_tasks.add(task)
         task.add_done_callback(self._mirror_tasks.discard)
 
+    @staticmethod
+    def _record_failover(primary_exc: BaseException, path: str) -> str:
+        """Account a primary-read failure the way storage retries are
+        accounted (retry.classify_error kinds + telemetry counters), so
+        degraded-path events are indistinguishable in dashboards from
+        retry events — one taxonomy for every fallback."""
+        kind = classify_error(primary_exc)
+        telemetry.counter_add("mirror_failovers", 1)
+        telemetry.event(
+            "mirror_failover",
+            cat="retry",
+            kind=kind,
+            path=path,
+            error=type(primary_exc).__name__,
+        )
+        return kind
+
     async def read(self, read_io: ReadIO) -> None:
         try:
+            faultinject.site("mirror.primary_read")
             await self.primary.read(read_io)
         except _PRIMARY_READ_FAILURES as primary_exc:
+            kind = self._record_failover(primary_exc, read_io.path)
             try:
                 await self.mirror.read(read_io)
             except BaseException:
+                # Both tiers failed: the propagating exception carries the
+                # same retry-history attrs a storage-retry exhaustion does.
+                attach_fallback_history(primary_exc, kind=kind)
                 raise primary_exc
             logger.info(
                 "read %s from the mirror (primary copy missing)", read_io.path
@@ -168,7 +192,8 @@ class MirroredStoragePlugin(StoragePlugin):
             primary_stream = await self.primary.read_stream(
                 read_io, sub_chunk_bytes
             )
-        except _PRIMARY_READ_FAILURES:
+        except _PRIMARY_READ_FAILURES as primary_exc:
+            self._record_failover(primary_exc, read_io.path)
             fallback = await self.mirror.read_stream(read_io, sub_chunk_bytes)
             logger.info(
                 "streaming %s from the mirror (primary copy missing)",
@@ -183,19 +208,23 @@ class MirroredStoragePlugin(StoragePlugin):
                     yield chunk
                     produced += memoryview(chunk).nbytes
             except _PRIMARY_READ_FAILURES as primary_exc:
+                kind = self._record_failover(primary_exc, read_io.path)
                 if produced:
-                    raise StreamRestartRequired(
-                        f"primary failed after streaming {produced} bytes of "
-                        f"{read_io.path!r}; re-read the entry from offset 0 "
-                        f"(mirror bytes are never spliced after primary "
-                        f"bytes)"
-                    ) from primary_exc
+                    restart = StreamRestartRequired(
+                        f"primary failed after streaming {produced} "
+                        f"bytes of {read_io.path!r}; re-read the entry "
+                        f"from offset 0 (mirror bytes are never spliced "
+                        f"after primary bytes)"
+                    )
+                    attach_fallback_history(restart, kind=kind)
+                    raise restart from primary_exc
                 try:
                     fallback = await self.mirror.read_stream(
                         ReadIO(path=read_io.path, byte_range=read_io.byte_range),
                         sub_chunk_bytes,
                     )
                 except BaseException:
+                    attach_fallback_history(primary_exc, kind=kind)
                     raise primary_exc
                 logger.info(
                     "streaming %s from the mirror (primary copy missing)",

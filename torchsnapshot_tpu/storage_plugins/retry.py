@@ -104,6 +104,21 @@ def is_transient_error(exc: BaseException) -> bool:
     return isinstance(exc, (ConnectionError, TimeoutError))
 
 
+def is_not_found_error(exc: BaseException) -> bool:
+    """True for any backend's flavor of not-found: the builtin types
+    plus cloud-SDK types (botocore NoSuchKey, google-api NotFound)
+    matched by TYPE NAME like :func:`classify_error`, so it needs none
+    of the optional SDKs installed. The commit fence reader and fsck
+    both classify through here — the two restore-equivalent surfaces
+    must never disagree on what counts as missing. KeyError stays in
+    the builtin set: KV-style fakes and stores (tests' FakeS3Client,
+    dict-backed plugins) surface a missing object as the missing key."""
+    if isinstance(exc, (FileNotFoundError, KeyError)):
+        return True
+    names = {t.__name__ for t in type(exc).__mro__}
+    return any("NotFound" in n or "NoSuchKey" in n for n in names)
+
+
 def classify_error(exc: BaseException) -> str:
     """Coarse error-kind label for telemetry and failure reports:
     ``throttle`` (429/SlowDown), ``server`` (5xx-style service faults),
@@ -180,6 +195,26 @@ def attach_retry_history(
         except TypeError:  # pragma: no cover - exotic BaseException subclass
             pass
     return exc
+
+
+def attach_fallback_history(exc: BaseException, kind: Optional[str] = None) -> str:
+    """Degraded-path accounting (mirror failover, peer-channel fallback):
+    give ``exc`` the same retry-history attrs a storage-retry exhaustion
+    carries — one attempt, zero backoff — UNLESS the storage layer
+    already attached real history (a retried-then-exhausted transfer
+    must not have its attempt counts zeroed by the fallback layer).
+    Returns the classified error kind for the caller's telemetry."""
+    kind = kind or classify_error(exc)
+    if getattr(exc, "retry_attempts", None) is None:
+        attach_retry_history(
+            exc,
+            attempts=1,
+            kind=kind,
+            backoff_slept_s=0.0,
+            fleet_attempts=0,
+            fleet_backoff_s=0.0,
+        )
+    return kind
 
 
 class CollectiveRetryStrategy:

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, Optional
 
 from .. import telemetry
 from ..io_types import ReadIO, ReadStream, StoragePlugin, WriteIO, WriteStream
+from .. import faultinject
 from .retry import (
     CollectiveRetryStrategy,
     cloud_io_executor,
@@ -118,14 +119,16 @@ class S3StoragePlugin(StoragePlugin):
             await self._multipart_upload(key, mv)
             return
 
-        # Stream without copying — bytearray slabs included.
-        stream = MemoryviewStream(mv)
-
         def put() -> None:
-            # Rewind before every attempt: a failed attempt may have
-            # consumed part of the stream (upload-recovery rewind).
-            stream.seek(0)
-            self.client.put_object(Bucket=self.bucket, Key=key, Body=stream)
+            # A fresh (possibly fault-mutated) stream per attempt; the
+            # injection point sits INSIDE the retried closure so injected
+            # transient faults exercise the real retry path. Rewinding is
+            # implicit — every attempt streams without copying from the
+            # start of the staged memoryview (bytearray slabs included).
+            body = MemoryviewStream(
+                memoryview(faultinject.mutate("s3.put", mv))
+            )
+            self.client.put_object(Bucket=self.bucket, Key=key, Body=body)
 
         await self._retrying(put)
 
@@ -260,7 +263,9 @@ class S3StoragePlugin(StoragePlugin):
                     Key=key,
                     UploadId=upload_id,
                     PartNumber=number,
-                    Body=MemoryviewStream(memoryview(payload)),
+                    Body=MemoryviewStream(
+                        memoryview(faultinject.mutate("s3.put_part", payload))
+                    ),
                 )
 
             async with sem:
@@ -345,7 +350,9 @@ class S3StoragePlugin(StoragePlugin):
             # ranges are short-circuited upstream (scheduler.read_and_consume)
 
         def get() -> bytes:
-            return self.client.get_object(**kwargs)["Body"].read()
+            return faultinject.mutate(
+                "s3.get", self.client.get_object(**kwargs)["Body"].read()
+            )
 
         buf = await self._retrying(get)
         if read_io.byte_range is not None:
