@@ -23,6 +23,10 @@ Two legs:
     save with the shim bypassed entirely (site/mutate monkeypatched to
     raw no-ops), and ASSERTS the best-vs-best delta is under 1% (with a
     50 ms absolute floor — bench.py's recipe for this bimodal host).
+    Also gates the coordination store's disabled-path overhead: with
+    replication off, the failover machinery's per-op bookkeeping
+    (idempotency stamps, dedup table) must stay under 1% of the KV
+    round-trip time (5 ms floor over 3000 mixed ops).
 
 Usage::
 
@@ -342,6 +346,80 @@ def overhead(trials: int = 5) -> None:
     )
 
 
+def store_overhead(trials: int = 5, ops: int = 3000) -> None:
+    """Disabled-path overhead of the store replication tier (ISSUE 6
+    acceptance): with replication OFF (no replicas joined — the shipping
+    single-host configuration), the client's (client_id, seq) stamp is
+    ALREADY skipped by design (it only arms once a failover target is
+    known), so the residual per-op cost is the server's log/dedup
+    bookkeeping and role/registry checks. Times ``ops`` mixed KV round
+    trips as shipped vs with that server bookkeeping bypassed
+    (``_MUTATING_OPS`` emptied — read per call), and asserts
+    best-vs-best delta < 1% with a 5 ms absolute floor (same
+    bimodal-host recipe as the injector gate above: loopback RTT noise
+    only ever inflates). The stamped path's cost is intentionally NOT
+    gated here — it only runs in replicated deployments, where one
+    extra µs per metadata op is noise against real network RTTs."""
+    from torchsnapshot_tpu import dist_store
+
+    store = dist_store.TCPStore("127.0.0.1", is_server=True, timeout=30.0)
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        for i in range(ops // 4):
+            k = f"k{i & 255}"
+            store.set(k, b"v")
+            store.add("ctr", 1)
+            store.check(k)
+            store.get(k)
+        return time.perf_counter() - t0
+
+    def bypassed(fn):
+        saved = dist_store._MUTATING_OPS
+        dist_store._MUTATING_OPS = frozenset()
+        try:
+            return fn()
+        finally:
+            dist_store._MUTATING_OPS = saved
+
+    try:
+        timed()  # warmup: connection buffers, dict growth, allocator
+        shipped_walls, bypass_walls = [], []
+        for pair in range(trials):
+            if pair % 2 == 0:
+                byp = bypassed(timed)
+                shp = timed()
+            else:
+                shp = timed()
+                byp = bypassed(timed)
+            bypass_walls.append(byp)
+            shipped_walls.append(shp)
+        bypass_best = min(bypass_walls)
+        shipped_best = min(shipped_walls)
+        budget_s = max(0.01 * bypass_best, 0.005)
+        delta = (shipped_best - bypass_best) / bypass_best
+        report(
+            "store_overhead",
+            {
+                "ops": ops,
+                "pairs": len(bypass_walls),
+                "bypass_trials_s": [round(t, 4) for t in bypass_walls],
+                "shipped_trials_s": [round(t, 4) for t in shipped_walls],
+                "bypass_best_s": round(bypass_best, 4),
+                "shipped_best_s": round(shipped_best, 4),
+                "overhead_pct": round(delta * 100, 3),
+                "per_op_us": round(shipped_best / ops * 1e6, 2),
+            },
+        )
+        assert (shipped_best - bypass_best) < budget_s, (
+            f"disabled-path store overhead {delta * 100:.2f}% over the 1% "
+            f"budget (bypass best {bypass_best:.4f}s vs shipped best "
+            f"{shipped_best:.4f}s, floor 5 ms)"
+        )
+    finally:
+        store.close()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--soak", action="store_true")
@@ -357,6 +435,7 @@ def main() -> None:
         soak(args.iterations, args.seed)
     if args.overhead:
         overhead(args.trials)
+        store_overhead(args.trials)
 
 
 if __name__ == "__main__":

@@ -42,6 +42,25 @@ from torchsnapshot_tpu.faultinject import KNOWN_SITES  # noqa: E402
 # The shim: the only attributes production code may use on the module.
 ALLOWED_ATTRS = {"site", "mutate"}
 
+# Coordination-plane sites are additionally pinned to their module: the
+# replication/lease protocol's injection points (ISSUE 6) only mean what
+# the chaos schedules assume while they live on the dist_store
+# boundaries — a site name drifting into another file would silently
+# change what "kill the store host at the Nth serve" drills.
+PINNED_SITE_FILES = {
+    "dist_store.rpc": "dist_store.py",
+    "dist_store.serve_op": "dist_store.py",
+    "dist_store.replica_rpc": "dist_store.py",
+    "dist_store.lease_renew": "dist_store.py",
+    "peer.send_frame": "dist_store.py",
+    "peer.recv_frame": "dist_store.py",
+}
+
+# Regression floor: the registry started at 15 sites (ISSUE 5) and grew
+# the replication/lease sites (ISSUE 6). Shrinking it means a drill
+# surface was silently unthreaded.
+MIN_SITES = 18
+
 
 def check_source(
     source: str, filename: str
@@ -137,6 +156,12 @@ def run(package_dir: str = PACKAGE) -> List[str]:
             rel = os.path.relpath(os.path.join(dirpath, fname), package_dir)
             if rel == "faultinject.py":
                 continue  # the shim itself
+            if rel == "test_utils.py":
+                # The test harness, not the pipeline: its subprocess
+                # launchers arm fault plans via configure() — exactly the
+                # "tests, benchmarks, and process bootstrap" audience the
+                # shim contract carves out.
+                continue
             path = os.path.join(dirpath, fname)
             with open(path, "r") as f:
                 source = f.read()
@@ -157,6 +182,19 @@ def run(package_dir: str = PACKAGE) -> List[str]:
         failures.append(
             f"site {name!r} is registered in faultinject.SITES but wired "
             "nowhere — remove the registration or thread the site"
+        )
+    for name, pinned_file in sorted(PINNED_SITE_FILES.items()):
+        for location in all_uses.get(name, []):
+            if not location.startswith(pinned_file + ":"):
+                failures.append(
+                    f"site {name!r} used at {location} but pinned to "
+                    f"{pinned_file} — coordination sites must not drift "
+                    "out of the store/peer plane"
+                )
+    if len(KNOWN_SITES) < MIN_SITES:
+        failures.append(
+            f"site registry shrank to {len(KNOWN_SITES)} (< {MIN_SITES}): "
+            "a drill surface was unthreaded"
         )
     return failures
 

@@ -558,6 +558,131 @@ def test_chaos_w2_rank_death_mid_plan_fails_fast(tmp_path, monkeypatch):
     assert elapsed < 100, elapsed
 
 
+# ------------------------------------------- store-host SIGKILL schedules
+#
+# The coordination-store leader runs in a DEDICATED host process (the
+# deployment whose death is survivable) and its fault plan SIGKILLs it at
+# the Nth client op it serves — deterministically mid-take. With one
+# replica (hosted by rank 1) the take must complete committed-bit-exact
+# through transparent client failover; with zero replicas the same
+# schedule must fail every rank within the bounded barrier deadline.
+
+STORE_KILL_PLAN = "dist_store.serve_op@14=kill;seed=601"
+
+
+def _store_kill_worker(rank: int, world_size: int, root: str):
+    import numpy as np
+
+    from torchsnapshot_tpu.pg_wrapper import get_default_pg
+
+    state = {
+        "model": StateDict(
+            w=np.random.default_rng(100 + rank)
+            .standard_normal(20_000)
+            .astype(np.float32),
+            step=np.array([rank], dtype=np.int64),
+        )
+    }
+    Snapshot.take(os.path.join(root, "cur"), state)
+    # Restore-verify inside the same world: the failed-over store also
+    # carries the restore's lockstep collectives.
+    dst = {
+        "model": StateDict(
+            w=np.zeros(20_000, np.float32), step=np.zeros(1, np.int64)
+        )
+    }
+    Snapshot(os.path.join(root, "cur")).restore(dst)
+    bit_exact = all(
+        np.array_equal(np.asarray(dst["model"][k]), np.asarray(state["model"][k]))
+        for k in state["model"]
+    )
+    return {
+        "failovers": get_default_pg().store.failovers,
+        "bit_exact": bit_exact,
+    }
+
+
+def test_chaos_store_host_kill_mid_take_fails_over_and_commits(tmp_path):
+    """The headline drill: SIGKILL the store leader mid-take at w2 with
+    1 replica — the take completes committed-bit-exact via failover and
+    each rank counts exactly one store failover."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _store_kill_worker,
+        2,
+        str(tmp_path),
+        timeout=180.0,
+        store_replicas=1,
+        store_lease_s=0.5,
+        external_store=True,
+        store_host_plan=STORE_KILL_PLAN,
+    )
+    assert set(results) == {0, 1}, results
+    for rank, out in results.items():
+        assert out["bit_exact"], (rank, out)
+        assert out["failovers"] == 1, (rank, out)
+    assert os.path.exists(tmp_path / "cur" / ".snapshot_metadata")
+    assert run_fsck(str(tmp_path / "cur"))[0] == 0
+
+
+def _store_kill_no_replica_worker(rank: int, world_size: int, root: str):
+    import numpy as np
+
+    from torchsnapshot_tpu.dist_store import StoreConnectionLostError
+
+    state = {
+        "model": StateDict(
+            w=np.random.default_rng(100 + rank)
+            .standard_normal(20_000)
+            .astype(np.float32),
+        )
+    }
+    import time as _time
+
+    t0 = _time.monotonic()
+    try:
+        Snapshot.take(os.path.join(root, "cur"), state)
+    except BaseException as e:  # noqa: B036
+        chain, cur, seen = [], e, set()
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            chain.append(cur)
+            cur = cur.__cause__ or cur.__context__
+        named = any(isinstance(c, StoreConnectionLostError) for c in chain)
+        return {"aborted": True, "named": named,
+                "elapsed": _time.monotonic() - t0}
+    return {"aborted": False, "named": False,
+            "elapsed": _time.monotonic() - t0}
+
+
+def test_chaos_store_host_kill_no_replicas_fails_bounded(tmp_path, monkeypatch):
+    """The SAME schedule with 0 replicas: every rank fails within the
+    bounded barrier deadline (naming the store), nothing commits."""
+    import time as _time
+
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT", "15")
+    t0 = _time.monotonic()
+    results = run_with_subprocesses(
+        _store_kill_no_replica_worker,
+        2,
+        str(tmp_path),
+        timeout=150.0,
+        external_store=True,
+        store_host_plan=STORE_KILL_PLAN,
+    )
+    elapsed = _time.monotonic() - t0
+    for rank, out in results.items():
+        assert out["aborted"], (rank, out)
+        assert out["named"], (rank, out)
+    assert not os.path.exists(tmp_path / "cur" / ".snapshot_metadata")
+    # Well under the 1800 s default; generous margin over the 15 s bound
+    # for process spawn + jax import.
+    assert elapsed < 120, elapsed
+
+
 def test_matrix_is_large_enough():
     """The acceptance floor: >= 30 deterministic schedules across
     backends and world sizes (kills and w2 drills included)."""
@@ -572,5 +697,6 @@ def test_matrix_is_large_enough():
         + len(KILL_PLANS)
         + len(W2_TAKE_PLANS)
         + 2  # w2 restore drill + rpc-death drill
+        + 2  # store-host SIGKILL: failover commit + no-replica bounded
     )
     assert n >= 30, n

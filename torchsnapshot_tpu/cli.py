@@ -33,6 +33,9 @@ on-call asks, so they get first-class commands here:
   ``.snapshot_metadata`` (phase walls, per-rank counters, fleet skew;
   see telemetry/ and docs/source/telemetry.rst). Answers "why was this
   take slow?" after the process is gone.
+- ``store-status`` — probe a live coordination-store node (leader or
+  standby): role, epoch, op-log position, per-replica lag and lease age
+  (dist_store replication tier; docs/source/fault_tolerance.rst).
 
 The inspection commands (``info``/``ls``/``cat``/``verify``) and
 ``consolidate`` work over any registered storage backend (fs://, s3://,
@@ -1098,6 +1101,60 @@ def cmd_consolidate(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_store_status(args: argparse.Namespace) -> int:
+    """Probe a coordination-store node (leader or standby) and print its
+    replication status: role, epoch, op-log position, per-replica lag and
+    lease age — the drill-debugging view of the failover tier
+    (docs/source/fault_tolerance.rst, "Coordination tier")."""
+    import json
+
+    from .dist_store import probe_store_status
+
+    try:
+        info = probe_store_status(args.addr, timeout=args.timeout)
+    except (ConnectionError, OSError, ValueError) as e:
+        print(
+            f"error: no store node answering at {args.addr} "
+            f"({type(e).__name__}: {e})",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps(info, indent=1, sort_keys=True))
+        return 0
+    role = info.get("role")
+    print(
+        f"{info.get('addr')}: role={role} epoch={info.get('epoch')} "
+        f"log_seq={info.get('log_seq')} keys={info.get('n_keys')} "
+        f"lease={info.get('lease_s')}s"
+    )
+    if role == "leader":
+        replicas = info.get("replicas") or []
+        if not replicas:
+            print(
+                "  no replicas joined — the store is a single point of "
+                "failure (set TORCHSNAPSHOT_TPU_STORE_REPLICAS to arm "
+                "failover)"
+            )
+        for rep in replicas:
+            print(
+                f"  replica[{rep.get('index')}] {rep.get('addr')}  "
+                f"acked_seq={rep.get('acked_seq')} lag={rep.get('lag')} "
+                f"lease_age={rep.get('lease_age_s')}s"
+            )
+    elif role == "standby":
+        print(
+            f"  following leader {info.get('leader')} "
+            f"(last leader message {info.get('leader_silence_s')}s ago)"
+        )
+    elif role == "deposed":
+        print(
+            "  DEPOSED ex-leader: a higher epoch exists; clients have "
+            "failed over to it"
+        )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m torchsnapshot_tpu",
@@ -1193,6 +1250,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="delete even when kept snapshots reference bases "
                         "that resolve to nothing in this directory")
     p.set_defaults(fn=cmd_prune)
+
+    p = sub.add_parser(
+        "store-status",
+        help="probe a coordination-store node: leader addr/epoch, "
+             "replica lag, lease age",
+    )
+    p.add_argument("addr", help='store node address, "host:port"')
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_store_status)
     return parser
 
 

@@ -414,10 +414,13 @@ class CoopRestoreSession:
     def _local_ip(pg_wrapper: Any) -> Optional[str]:
         """The address peers can reach this rank on: the local end of
         the store connection (the interface that already reaches the
-        coordination plane reaches the peer plane too). None when it
-        cannot be determined — the caller opts out, never guesses."""
+        coordination plane reaches the peer plane too). Uses the store's
+        ``local_ip()`` accessor, which reads the CURRENT connection under
+        the client lock — correct even while a leader failover is
+        swapping sockets underneath. None when it cannot be determined —
+        the caller opts out, never guesses."""
         try:
-            return pg_wrapper.pg.store._sock.getsockname()[0]
+            return pg_wrapper.pg.store.local_ip()
         except Exception:  # noqa: BLE001 - wrapped/alternative stores
             return None
 

@@ -93,6 +93,9 @@ SITES: Dict[str, str] = {
     "mirror.primary_read": "control",
     # coordination plane
     "dist_store.rpc": "control",  # every KV-store client round trip
+    "dist_store.serve_op": "control",  # server-side dispatch of one op
+    "dist_store.replica_rpc": "control",  # leader->replica op-log message
+    "dist_store.lease_renew": "control",  # leader lease-renewal round
     "peer.send_frame": "data",    # fan-out peer channel, sender side
     "peer.recv_frame": "control",  # fan-out peer channel, receiver side
     # pipeline

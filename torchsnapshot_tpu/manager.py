@@ -127,7 +127,14 @@ class CheckpointManager:
         self.save_dtype = save_dtype
         self.replicated = replicated
         self.storage_options = storage_options
-        self.pg = pg
+        # No explicit group: bootstrap the default one from the env
+        # (TORCHSNAPSHOT_TPU_STORE_ADDR + _STORE_REPLICAS) so a manager
+        # constructed in a launcher-less deployment still coordinates —
+        # and, with replicas configured, still survives a store-leader
+        # death mid-save. None (single-process) when the env is unset.
+        from .pg_wrapper import ensure_default_pg
+
+        self.pg = pg if pg is not None else ensure_default_pg()
         self.preemption = preemption
         self._pending: Optional[PendingSnapshot] = None
         self._pending_step: Optional[int] = None

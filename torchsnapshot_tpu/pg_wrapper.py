@@ -38,14 +38,16 @@ a long-running job snapshotting every N steps keeps the store bounded.
 
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import uuid
 import zlib
 from typing import Any, Dict, List, Optional
 
-from .dist_store import DEATH_KEY, TCPStore
+from .dist_store import DEATH_KEY, TCPStore, create_store
 
+STORE_ADDR_ENV_VAR = "TORCHSNAPSHOT_TPU_STORE_ADDR"
 _HANDSHAKE_SEQ_KEY = "pgw/seq"
 _HANDSHAKE_PREFIX = "pgw/handshake"
 # DEATH_KEY (dist_store): init_process_group registers each rank's
@@ -146,6 +148,40 @@ def get_default_pg() -> Optional[ProcessGroup]:
     return _default_pg
 
 
+def ensure_default_pg() -> Optional[ProcessGroup]:
+    """The default process group — bootstrapping one from the
+    environment on first use when none was initialized explicitly.
+
+    ``TORCHSNAPSHOT_TPU_STORE_ADDR`` names the coordination store
+    ("host:port"); process identity comes from ``jax.distributed``. The
+    bootstrap goes through :func:`dist_store.create_store`, so it
+    carries the replication tier too: with
+    ``TORCHSNAPSHOT_TPU_STORE_REPLICAS=N`` set, ranks 1..N host standby
+    replicas and every rank blocks until the full replica set has joined
+    before its first collective. Returns None (single-process semantics)
+    when neither an explicit group nor the env address exists."""
+    global _default_pg
+    if _default_pg is not None:
+        return _default_pg
+    addr = os.environ.get(STORE_ADDR_ENV_VAR, "").strip()
+    if not addr:
+        return None
+    import jax
+
+    rank = jax.process_index()
+    world_size = jax.process_count()
+    store = create_store(rank=rank, addr=addr) if world_size > 1 else None
+    return init_process_group(store=store, rank=rank, world_size=world_size)
+
+
+def _store_identity(store: TCPStore) -> str:
+    """Per-process bookkeeping key for a store: the BOOTSTRAP address,
+    stable across leader failovers (``store.addr`` tracks the current
+    leader and changes mid-job when the store host dies — keying the
+    handshake cursor on it would reset namespace sequencing)."""
+    return getattr(store, "bootstrap_addr", None) or store.addr
+
+
 # Per-process handshake cursors, keyed by store address: how many handshakes
 # this process has consumed against that store. Only bumped when a wrapper
 # actually performs its first collective.
@@ -193,7 +229,7 @@ class PGWrapper:
         with _handshake_lock:
             if self._ns is not None:  # re-check under the lock
                 return self._ns
-            cursor_key = store.addr
+            cursor_key = _store_identity(store)
             if self.get_rank() == 0:
                 self._gc_retired(store)
                 seq = store.add(_HANDSHAKE_SEQ_KEY, 1)
@@ -213,7 +249,7 @@ class PGWrapper:
         retirement. Runs at handshake time (never racing an in-flight op of
         the namespace being deleted: acks are each rank's final write)."""
         remaining: List[tuple] = []
-        for item in _retired_namespaces.get(store.addr, []):
+        for item in _retired_namespaces.get(_store_identity(store), []):
             ns, seq, world_size = item
             acked = all(
                 store.check(f"{ns}/retired/{r}") for r in range(world_size)
@@ -223,7 +259,7 @@ class PGWrapper:
                 store.delete_prefix(ns)
             else:
                 remaining.append(item)
-        _retired_namespaces[store.addr] = remaining
+        _retired_namespaces[_store_identity(store)] = remaining
 
     def retire(self) -> None:
         """Mark this wrapper's operation complete on this rank.
@@ -240,7 +276,7 @@ class PGWrapper:
             # May run on a background (commit) thread while the main thread
             # garbage-collects under the handshake lock.
             with _handshake_lock:
-                _retired_namespaces.setdefault(store.addr, []).append(
+                _retired_namespaces.setdefault(_store_identity(store), []).append(
                     (self._ns, self._handshake_seq, self.get_world_size())
                 )
 

@@ -76,7 +76,7 @@ from .manifest import (
     get_manifest_for_rank,
     is_container_entry,
 )
-from .pg_wrapper import PGWrapper, ProcessGroup
+from .pg_wrapper import PGWrapper, ProcessGroup, ensure_default_pg
 from .rng_state import RNGState
 from .scheduler import (
     PendingIOWork,
@@ -175,7 +175,13 @@ class Snapshot:
         storage_options: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.path = path
-        self.pg = pg
+        # No explicit group: bootstrap the default one from the
+        # environment (TORCHSNAPSHOT_TPU_STORE_ADDR + _STORE_REPLICAS,
+        # jax.distributed identity) — the bootstrap carries the store's
+        # replica set, so restores opened from a bare path get the same
+        # leader-failover coverage as launcher-managed worlds. Returns
+        # None (single-process) when the env is not configured.
+        self.pg = pg if pg is not None else ensure_default_pg()
         self._storage_options = storage_options
         self._metadata: Optional[SnapshotMetadata] = None
 
@@ -232,7 +238,7 @@ class Snapshot:
         cls._validate_app_state(app_state)
         cls._validate_save_dtype(save_dtype)
         event_loop = asyncio.new_event_loop()
-        pg_wrapper = PGWrapper(pg)
+        pg_wrapper = PGWrapper(pg if pg is not None else ensure_default_pg())
         path = cls._coalesce_path(path, pg_wrapper)
         storage = url_to_storage_plugin_in_event_loop(
             path, event_loop, storage_options
@@ -352,7 +358,7 @@ class Snapshot:
         cls._validate_app_state(app_state)
         cls._validate_save_dtype(save_dtype)
         event_loop = asyncio.new_event_loop()
-        pg_wrapper = PGWrapper(pg)
+        pg_wrapper = PGWrapper(pg if pg is not None else ensure_default_pg())
         path = cls._coalesce_path(path, pg_wrapper)
         storage = url_to_storage_plugin_in_event_loop(
             path, event_loop, storage_options

@@ -24,6 +24,22 @@ MAX_BACKOFF_S = 8.0
 STALL_TIMEOUT_S = 120.0
 
 
+def backoff_with_jitter(
+    attempt: int,
+    base_s: float = BASE_BACKOFF_S,
+    cap_s: float = MAX_BACKOFF_S,
+) -> float:
+    """The retry tier's jittered exponential backoff, as a plain
+    function: ``base * 2^attempt * (1 + rand)`` capped at ``cap``. Shared
+    by :class:`CollectiveRetryStrategy` and the coordination store's
+    connect/failover retries (dist_store) so every retry loop in the
+    system jitters the same way. The exponent is capped before
+    exponentiating: ``2**attempt`` overflows float conversion near
+    attempt ~1076 in a long-lived retry loop."""
+    raw = base_s * (2 ** min(attempt, 16)) * (1.0 + random.random())
+    return min(raw, cap_s)
+
+
 def named(fn: Callable[[], Any], op: str) -> Callable[[], Any]:
     """Label a transfer closure for retry telemetry: the plugins'
     ``_retrying`` wrappers read ``__name__`` as the op tag on
@@ -274,10 +290,9 @@ class CollectiveRetryStrategy:
         self.fleet_backoff_s = 0.0
 
     def backoff_s(self, attempt: int) -> float:
-        # Cap the exponent before exponentiating: 2**attempt overflows
-        # float conversion near attempt ~1076 in a long-lived retry loop.
-        raw = self._base_backoff_s * (2 ** min(attempt, 16)) * (1.0 + random.random())
-        return min(raw, self._max_backoff_s)
+        return backoff_with_jitter(
+            attempt, base_s=self._base_backoff_s, cap_s=self._max_backoff_s
+        )
 
     async def backoff_or_raise(
         self,

@@ -15,7 +15,7 @@ import os
 import queue as pyqueue
 import sys
 import traceback
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -124,6 +124,43 @@ def init_pod_world(rank: int, world_size: int, port: int, local_devices: int):
 # ---------------------------------------------------------------- launcher
 
 
+def _store_host_entry(
+    store_addr: str,
+    expected_replicas: int,
+    fault_plan: str,
+    lease_s: Optional[float] = None,
+) -> None:
+    """A dedicated store-host process (no rank identity): hosts the
+    leader at ``store_addr`` and serves until terminated. ``fault_plan``
+    arms deterministic faults IN THE HOST — e.g.
+    ``dist_store.serve_op@14=kill`` SIGKILLs the store leader at the
+    14th client op it serves, the chaos matrix's store-host-death
+    schedule."""
+    import time as _time
+
+    from . import faultinject
+    from .dist_store import TCPStore
+
+    if fault_plan:
+        faultinject.configure(fault_plan)
+    host, _, port = store_addr.rpartition(":")
+    server = TCPStore(
+        host,
+        int(port),
+        is_server=True,
+        expected_replicas=expected_replicas,
+        # The leader's lease is authoritative for the whole tier (the
+        # sync frame propagates it to standbys), so the launcher's knob
+        # must reach THIS process, not just the rank-side standbys.
+        lease_s=lease_s,
+    )
+    try:
+        while True:
+            _time.sleep(3600)
+    finally:  # pragma: no cover - terminated by the launcher
+        server.close()
+
+
 def _worker_entry(
     fn: Callable,
     rank: int,
@@ -131,6 +168,7 @@ def _worker_entry(
     store_addr: str,
     result_queue,
     args: Tuple,
+    store_cfg: Dict[str, Any],
 ) -> None:
     try:
         # Each subprocess is its own "host process": single CPU device.
@@ -139,7 +177,13 @@ def _worker_entry(
         from .dist_store import create_store
         from .pg_wrapper import init_process_group
 
-        store = create_store(rank=rank, addr=store_addr)
+        store = create_store(
+            rank=rank,
+            addr=store_addr,
+            replicas=store_cfg.get("replicas", 0),
+            host_server=(rank == 0 and not store_cfg.get("external", False)),
+            lease_s=store_cfg.get("lease_s"),
+        )
         init_process_group(store=store, rank=rank, world_size=world_size)
         try:
             result = fn(rank, world_size, *args)
@@ -191,6 +235,10 @@ def run_with_subprocesses(
     *args: Any,
     timeout: float = 180.0,
     expect_dead: Tuple[int, ...] = (),
+    store_replicas: int = 0,
+    store_lease_s: Optional[float] = None,
+    external_store: bool = False,
+    store_host_plan: str = "",
 ) -> Dict[int, Any]:
     """Run ``fn(rank, world_size, *args)`` in ``world_size`` subprocesses with
     a shared KV-store rendezvous. Returns {rank: result}; raises on any
@@ -202,18 +250,40 @@ def run_with_subprocesses(
     processes have exited (draining any report a doomed rank managed to
     enqueue first). An expected-dead rank's "ok" report is included in
     the results; its ERROR reports are dropped — a rank being killed is
-    expected to die messily, and its failure must not fail the test."""
+    expected to die messily, and its failure must not fail the test.
+
+    ``store_replicas``: ranks 1..N additionally host standby replicas of
+    the coordination store (dist_store replication tier). With
+    ``external_store=True`` the LEADER runs in a dedicated extra process
+    instead of rank 0 — the deployment shape whose death is survivable —
+    and ``store_host_plan`` arms a deterministic fault plan in that host
+    (e.g. ``dist_store.serve_op@14=kill`` for the store-host SIGKILL
+    drills). The host process is cleaned up by the launcher; its death
+    mid-run is the point, never an error."""
     import time as _time
 
     ctx = mp.get_context("spawn")
     result_queue = ctx.Queue()
     port = _find_free_port()
     store_addr = f"127.0.0.1:{port}"
+    store_cfg = {
+        "replicas": store_replicas,
+        "lease_s": store_lease_s,
+        "external": external_store,
+    }
+    store_host_proc = None
+    if external_store:
+        store_host_proc = ctx.Process(
+            target=_store_host_entry,
+            args=(store_addr, store_replicas, store_host_plan, store_lease_s),
+            daemon=True,
+        )
+        store_host_proc.start()
     procs = []
     for rank in range(world_size):
         p = ctx.Process(
             target=_worker_entry,
-            args=(fn, rank, world_size, store_addr, result_queue, args),
+            args=(fn, rank, world_size, store_addr, result_queue, args, store_cfg),
             daemon=False,
         )
         p.start()
@@ -260,6 +330,8 @@ def run_with_subprocesses(
             if _time.monotonic() > deadline:
                 for p in procs:
                     p.terminate()
+                if store_host_proc is not None:
+                    store_host_proc.terminate()
                 raise TimeoutError(
                     f"Multi-process test timed out after {timeout}s; "
                     f"got results from ranks {sorted(results)} of {world_size}."
@@ -270,6 +342,11 @@ def run_with_subprocesses(
         p.join(timeout=30)
         if p.is_alive():
             p.terminate()
+    if store_host_proc is not None:
+        # The dedicated store host has no result to report (and may have
+        # been deliberately killed mid-run by its fault plan).
+        store_host_proc.terminate()
+        store_host_proc.join(timeout=10)
     if errors:
         raise RuntimeError(
             "Worker failures:\n"
