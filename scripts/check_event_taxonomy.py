@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Lint: flight-recorder event names are registered literals, and the
+registry is fully wired.
+
+The flight recorder (torchsnapshot_tpu/telemetry/flightrec.py) is always
+on: its event stream is an operator interface — the ``blackbox`` CLI
+merges rank dumps by matching event names, runbooks grep for them, tests
+assert on them. Three properties keep that interface trustworthy, in the
+same lint culture as ``check_fault_sites.py``:
+
+1. **Registered names only.** Every ``flightrec.record(...)`` call in
+   the package must pass a STRING LITERAL present in
+   ``telemetry.events.FLIGHT_EVENTS`` — a typo'd name would record
+   events nothing can find.
+2. **No dead registry rows.** Every registered name must be recorded at
+   one or more call sites (unlike fault sites, multiplicity is fine:
+   ``collective.enter`` fires from every collective verb); a registered-
+   but-unwired name means a documented event that can never occur.
+3. **Literal-first calls.** The event name must be the literal first
+   argument — computed names are unlintable and ungreppable.
+
+Run: ``python scripts/check_event_taxonomy.py`` — exits 0 when clean, 1
+with a per-violation report. Enforced in tier-1 via
+tests/test_flightrec.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
+
+sys.path.insert(0, REPO)
+
+from torchsnapshot_tpu.telemetry.taxonomy import FLIGHT_EVENTS  # noqa: E402
+
+# Names a module may bind the flightrec module to. Calls are recognized
+# as ``<alias>.record(...)`` or ``telemetry.flightrec.record(...)``.
+_MODULE_NAME = "flightrec"
+
+# Regression floor: the taxonomy shipped with this many events (ISSUE 7).
+# Shrinking it means an operator-facing event class was silently dropped.
+MIN_EVENTS = 15
+
+
+def _is_flightrec_record(fn: ast.AST, aliases: set) -> bool:
+    """True for ``<alias>.record`` and ``<mod>.flightrec.record``."""
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "record"):
+        return False
+    val = fn.value
+    if isinstance(val, ast.Name) and val.id in aliases:
+        return True
+    return isinstance(val, ast.Attribute) and val.attr == _MODULE_NAME
+
+
+def check_source(
+    source: str, filename: str
+) -> Tuple[List[Tuple[int, str]], Dict[str, List[int]]]:
+    """Return (violations, {event_name: [lines]}) for one file."""
+    tree = ast.parse(source, filename=filename)
+    violations: List[Tuple[int, str]] = []
+    uses: Dict[str, List[int]] = {}
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[-1] == _MODULE_NAME:
+                    aliases.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == _MODULE_NAME:
+                    aliases.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_flightrec_record(node.func, aliases):
+            continue
+        if not node.args or not (
+            isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            violations.append(
+                (
+                    node.lineno,
+                    "flightrec.record(...) — the event name must be a "
+                    "string literal",
+                )
+            )
+            continue
+        name = node.args[0].value
+        if name not in FLIGHT_EVENTS:
+            violations.append(
+                (
+                    node.lineno,
+                    f"flightrec.record({name!r}) — event not registered in "
+                    "telemetry/taxonomy.py",
+                )
+            )
+            continue
+        uses.setdefault(name, []).append(node.lineno)
+    return violations, uses
+
+
+def run(package_dir: str = PACKAGE) -> List[str]:
+    failures: List[str] = []
+    wired: Dict[str, List[str]] = {}
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fname), package_dir)
+            if rel == os.path.join("telemetry", "flightrec.py"):
+                continue  # the shim itself
+            path = os.path.join(dirpath, fname)
+            with open(path, "r") as f:
+                source = f.read()
+            violations, uses = check_source(source, path)
+            for lineno, what in violations:
+                failures.append(f"{rel}:{lineno}: {what}")
+            for name, lines in uses.items():
+                for lineno in lines:
+                    wired.setdefault(name, []).append(f"{rel}:{lineno}")
+    # flight.dump is emitted by the dump machinery itself (the header
+    # record), not via record() — it is wired by construction.
+    wired.setdefault("flight.dump", ["telemetry/flightrec.py:dump"])
+    for name in sorted(FLIGHT_EVENTS - set(wired)):
+        failures.append(
+            f"event {name!r} is registered in telemetry/taxonomy.py but "
+            "recorded nowhere — remove the registration or wire the event"
+        )
+    if len(FLIGHT_EVENTS) < MIN_EVENTS:
+        failures.append(
+            f"event taxonomy shrank to {len(FLIGHT_EVENTS)} (< {MIN_EVENTS}): "
+            "an operator-facing event class was dropped"
+        )
+    return failures
+
+
+def main() -> int:
+    failures = run()
+    if failures:
+        print("flight-recorder event taxonomy lint failures:", file=sys.stderr)
+        for failure in sorted(failures):
+            print(f"  {failure}", file=sys.stderr)
+        return 1
+    print(f"event-taxonomy lint: clean ({len(FLIGHT_EVENTS)} events registered)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

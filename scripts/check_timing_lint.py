@@ -101,12 +101,28 @@ def _violations_in(path: str) -> list:
     return out
 
 
+# Files INSIDE telemetry/ that are clock CONSUMERS, not the clock's
+# owner: they must go through core.monotonic like the rest of the
+# package, so the lint covers them despite living in the exempt dir.
+# (core.py/export.py own the clock; history.py records calendar time.)
+TELEMETRY_COVERED = {"flightrec.py", "health.py"}
+
+
 def main() -> int:
     failures = []
     for dirpath, dirnames, filenames in os.walk(PACKAGE):
         rel_dir = os.path.relpath(dirpath, PACKAGE)
         if rel_dir.split(os.sep)[0] == "telemetry":
-            continue  # the one place the raw clock belongs
+            # The telemetry package owns the raw clock — EXCEPT its
+            # consumer modules (the flight recorder, the health plane),
+            # which are linted like everything else.
+            for name in sorted(filenames):
+                if name not in TELEMETRY_COVERED:
+                    continue
+                rel = os.path.normpath(os.path.join(rel_dir, name))
+                for lineno, what in _violations_in(os.path.join(dirpath, name)):
+                    failures.append((rel, lineno, what))
+            continue
         for name in filenames:
             if not name.endswith(".py"):
                 continue

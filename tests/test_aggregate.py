@@ -1,0 +1,123 @@
+"""Direct unit tests for telemetry/aggregate.py's fleet merge.
+
+The merge was previously exercised only through distributed-take tests;
+these pin its edge cases standalone: single-rank fleets, ranks
+contributing ``None`` (telemetry disabled there), skewed rank walls, and
+the degradation counters (store/mirror/fanout failovers) that the
+observability PR wired into the persisted summary.
+"""
+
+from __future__ import annotations
+
+from torchsnapshot_tpu.telemetry.aggregate import merge_summaries
+
+
+def _summary(rank, wall_s, counters=None):
+    return {
+        "op": "take",
+        "rank": rank,
+        "wall_s": wall_s,
+        "counters": counters or {},
+    }
+
+
+def test_single_rank_fleet():
+    fleet = merge_summaries([_summary(0, 1.5, {"bytes_written": 1000})])
+    assert fleet["world_size"] == 1
+    assert fleet["reporting"] == 1
+    assert fleet["slowest_rank"] == 0
+    assert fleet["fastest_rank"] == 0
+    assert fleet["skew_s"] == 0.0
+    assert fleet["aggregate"]["bytes_written"] == 1000
+    # Fleet bandwidth over the critical path (the one rank's wall).
+    assert abs(fleet["aggregate"]["write_gbps"] - 1000 / 1.5 / 1e9) < 1e-12
+
+
+def test_none_contributions_are_counted_not_crashed():
+    """A rank with telemetry disabled contributes None: the merge must
+    report world_size from the GATHER length and how many ranks actually
+    reported — never divide by the missing rank or misattribute its
+    slot."""
+    fleet = merge_summaries(
+        [None, _summary(1, 2.0, {"bytes_written": 500}), None]
+    )
+    assert fleet["world_size"] == 3
+    assert fleet["reporting"] == 1
+    # Rank identity comes from the gather SLOT, not the reporting order.
+    assert fleet["slowest_rank"] == 1
+    assert fleet["aggregate"]["bytes_written"] == 500
+
+
+def test_all_none_returns_none():
+    assert merge_summaries([None, None]) is None
+    assert merge_summaries([]) is None
+
+
+def test_skewed_walls_name_slowest_and_fastest():
+    """Rank walls are per-rank monotonic intervals (never cross-rank
+    clock comparisons): a heavily skewed fleet reports the skew and the
+    offenders by rank index."""
+    fleet = merge_summaries(
+        [
+            _summary(0, 1.0, {"bytes_written": 100}),
+            _summary(1, 61.0, {"bytes_written": 100}),
+            _summary(2, 2.0, {"bytes_written": 100}),
+        ]
+    )
+    assert fleet["slowest_rank"] == 1
+    assert fleet["fastest_rank"] == 0
+    assert fleet["skew_s"] == 60.0
+    assert fleet["wall_s_max"] == 61.0
+    # Fleet bandwidth is everyone's bytes over the SLOWEST wall — the
+    # time the training loop actually paid.
+    assert abs(fleet["aggregate"]["write_gbps"] - 300 / 61.0 / 1e9) < 1e-15
+
+
+def test_degradation_counters_sum_across_ranks():
+    """store_failovers / lease_renewals / fanout_fallbacks /
+    mirror_failovers aggregate like byte counters (the PR 6 counters the
+    persisted summary used to drop)."""
+    fleet = merge_summaries(
+        [
+            _summary(0, 1.0, {"store_failovers": 1, "fanout_fallbacks": 2}),
+            _summary(1, 1.1, {"store_failovers": 1, "mirror_failovers": 3,
+                              "lease_renewals": 40}),
+        ]
+    )
+    agg = fleet["aggregate"]
+    assert agg["store_failovers"] == 2
+    assert agg["fanout_fallbacks"] == 2
+    assert agg["mirror_failovers"] == 3
+    assert agg["lease_renewals"] == 40
+
+
+def test_zero_valued_counters_are_elided():
+    fleet = merge_summaries(
+        [_summary(0, 1.0, {"bytes_written": 0, "retry_attempts": 0})]
+    )
+    assert fleet["aggregate"] == {}
+
+
+def test_missing_wall_defaults_to_zero_not_crash():
+    fleet = merge_summaries([{"op": "take", "rank": 0, "counters": {}}])
+    assert fleet["wall_s_max"] == 0.0
+    assert fleet["skew_s"] == 0.0
+
+
+def test_render_includes_failover_lines():
+    """The stats rendering surfaces non-zero degradation counters."""
+    from torchsnapshot_tpu.telemetry.export import render_summary_document
+
+    doc = {
+        "op": "take",
+        "world_size": 2,
+        "ranks": [
+            _summary(0, 1.0, {"store_failovers": 1}),
+            _summary(1, 1.2, {"store_failovers": 1, "fanout_fallbacks": 2}),
+        ],
+    }
+    doc["fleet"] = merge_summaries(doc["ranks"])
+    text = render_summary_document(doc)
+    assert "failovers:" in text
+    assert "store=2" in text
+    assert "fanout=2" in text

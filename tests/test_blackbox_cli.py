@@ -1,0 +1,153 @@
+"""``blackbox`` CLI: merged cross-rank timelines from flight dumps.
+
+Covers the CLI surface (exit codes, rendering, --json) over hand-built
+dumps, and the store-failover chaos drill: SIGKILL the store leader
+mid-take at w2 with one replica (the PR 6 headline schedule) — the take
+commits through transparent failover, each rank spools its flight ring,
+and ``blackbox`` names the adopted epoch per rank.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.cli import main
+from torchsnapshot_tpu.telemetry import flightrec
+
+
+def _write_dump(root, rank, records):
+    d = os.path.join(root, ".flight")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"rank_{rank}.jsonl"), "w") as f:
+        f.write(json.dumps({"seq": 0, "t": 0.0, "ev": "flight.dump",
+                            "rank": rank, "reason": "test"}) + "\n")
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_blackbox_no_dumps_exits_2(tmp_path, capsys):
+    assert main(["blackbox", str(tmp_path)]) == 2
+    assert "no flight dumps" in capsys.readouterr().err
+
+
+def test_blackbox_renders_stale_commit_with_generation(tmp_path, capsys):
+    """A refused fenced commit is a finding that names the rank, both
+    generations, and exits 1."""
+    _write_dump(tmp_path, 0, [
+        {"seq": 1, "t": 1.0, "ev": "fence.plant", "gen": "aaaa1111"},
+        {"seq": 2, "t": 2.0, "ev": "commit.decision", "gen": "aaaa1111",
+         "found": "bbbb2222", "ok": False},
+        {"seq": 3, "t": 2.1, "ev": "op.abort", "op": "take",
+         "error": "StaleCommitError(...)", "gen": "aaaa1111"},
+    ])
+    assert main(["blackbox", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "STALE-COMMIT" in out
+    assert "rank 0" in out
+    assert "aaaa1111" in out and "bbbb2222" in out
+
+
+def test_blackbox_renders_store_failover_with_epoch(tmp_path, capsys):
+    _write_dump(tmp_path, 1, [
+        {"seq": 1, "t": 1.0, "ev": "store.failover", "epoch": 3,
+         "leader": "127.0.0.1:4242", "cause": "ConnectionResetError()"},
+    ])
+    assert main(["blackbox", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "STORE-FAILOVER" in out
+    assert "rank 1" in out
+    assert "epoch 3" in out
+    assert "127.0.0.1:4242" in out
+
+
+def test_blackbox_json_mode(tmp_path, capsys):
+    _write_dump(tmp_path, 0, [
+        {"seq": 1, "t": 1.0, "ev": "op.begin", "op": "take"},
+    ])
+    assert main(["blackbox", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ranks"] == [0]
+    assert doc["events"][0]["ev"] == "op.begin"
+    assert doc["findings"] == []
+
+
+def test_blackbox_clean_dump_exits_0(tmp_path, capsys):
+    """A committed take's forced dump (operator `flightrec.dump`) has no
+    findings: exit 0, timeline still rendered."""
+    flightrec.set_enabled(True)
+    flightrec.reset()
+    state = {"model": StateDict(w=np.arange(10_000, dtype=np.float32))}
+    cur = str(tmp_path / "cur")
+    Snapshot.take(cur, state)
+    flightrec.dump(cur, 0, "operator request")
+    assert main(["blackbox", cur]) == 0
+    out = capsys.readouterr().out
+    assert "op.begin" in out
+    assert "commit.decision" in out
+
+
+# ----------------------------------------------- store-failover drill
+
+
+STORE_KILL_PLAN = "dist_store.serve_op@14=kill;seed=601"
+
+
+def _failover_worker(rank: int, world_size: int, root: str):
+    from torchsnapshot_tpu.pg_wrapper import get_default_pg
+    from torchsnapshot_tpu.telemetry import flightrec as fr
+
+    fr.set_enabled(True)
+    fr.reset()
+    rng = np.random.default_rng(100 + rank)
+    state = {"model": StateDict(w=rng.standard_normal(20_000).astype(np.float32))}
+    path = os.path.join(root, "cur")
+    Snapshot.take(path, state)
+    # The take survived the leader kill via transparent failover — spool
+    # the ring anyway (the operator's "what just happened" request; the
+    # same dump an abort would have forced).
+    fr.dump(path, rank, "post-drill audit")
+    return {"failovers": get_default_pg().store.failovers}
+
+
+@pytest.mark.multiprocess
+def test_blackbox_names_store_failover_epoch_after_leader_kill(tmp_path, capsys):
+    """The PR 6 headline schedule through the observability plane:
+    SIGKILL the store leader at the 14th served op (w2, one replica);
+    the take commits through failover, and blackbox's merged timeline
+    names each rank's adopted epoch."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _failover_worker,
+        2,
+        str(tmp_path),
+        timeout=180.0,
+        store_replicas=1,
+        store_lease_s=0.5,
+        external_store=True,
+        store_host_plan=STORE_KILL_PLAN,
+    )
+    for rank, out in results.items():
+        assert out["failovers"] == 1, (rank, out)
+    cur = str(tmp_path / "cur")
+    assert os.path.exists(os.path.join(cur, ".snapshot_metadata"))
+    rc = main(["blackbox", cur, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1  # the failover IS a finding
+    failovers = [f for f in doc["findings"] if f["class"] == "store-failover"]
+    # Both ranks adopted the promoted leader, at the SAME (higher) epoch.
+    assert {f["rank"] for f in failovers} == {0, 1}, failovers
+    epochs = {f["epoch"] for f in failovers}
+    assert len(epochs) == 1 and min(epochs) >= 1, failovers
+    rc = main(["blackbox", cur, "-v"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "STORE-FAILOVER" in out
+    assert "rank 0 adopted leader" in out
+    assert "rank 1 adopted leader" in out
+    assert f"epoch {epochs.pop()}" in out

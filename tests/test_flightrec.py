@@ -1,0 +1,331 @@
+"""Flight recorder (telemetry/flightrec.py): ring semantics, abort
+dumps, the cross-rank merge, and the event-taxonomy lint.
+
+The headline drill is the PR 5 commit-barrier desertion schedule at
+world size 2: one rank's drain-phase fault deserts its peer at the
+commit barrier; both ranks must leave ``.flight/rank_<r>.jsonl`` dumps,
+and the merged blackbox timeline must name the failing rank, the
+desertion, and the commit generation — the "who deserted whom" question
+answered from the wreck alone.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+from torchsnapshot_tpu.cli import run_fsck
+from torchsnapshot_tpu.telemetry import flightrec
+from torchsnapshot_tpu.telemetry.taxonomy import FLIGHT_EVENTS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TAXONOMY_SCRIPT = os.path.join(REPO, "scripts", "check_event_taxonomy.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    flightrec.set_enabled(True)
+    flightrec.reset()
+    yield
+    flightrec.set_enabled(True)
+    flightrec.reset()
+
+
+# ------------------------------------------------------------------ ring
+
+
+def test_ring_is_bounded_and_ordered(monkeypatch):
+    monkeypatch.setenv(flightrec.RING_ENV_VAR, "32")
+    flightrec.refresh_from_env()
+    try:
+        for i in range(100):
+            flightrec.record("progress", op="take", done=i)
+        ring = flightrec.snapshot_ring()
+        assert len(ring) == 32
+        seqs = [r[0] for r in ring]
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == 100  # newest survives; oldest dropped
+        assert flightrec.recorded_total() == 100
+    finally:
+        monkeypatch.delenv(flightrec.RING_ENV_VAR)
+        flightrec.refresh_from_env()
+
+
+def test_disabled_records_nothing():
+    flightrec.set_enabled(False)
+    flightrec.record("phase", name="stage", op="take")
+    assert flightrec.snapshot_ring() == []
+    assert flightrec.dump(None, 0, "disabled") is None
+
+
+def test_enabled_by_default_env_gate(monkeypatch):
+    monkeypatch.setenv(flightrec.FLIGHTREC_ENV_VAR, "")
+    assert flightrec.refresh_from_env() is True  # always-on default
+    monkeypatch.setenv(flightrec.FLIGHTREC_ENV_VAR, "0")
+    assert flightrec.refresh_from_env() is False
+    monkeypatch.setenv(flightrec.FLIGHTREC_ENV_VAR, "1")
+    assert flightrec.refresh_from_env() is True
+
+
+# ----------------------------------------------------------------- dumps
+
+
+def test_abort_dump_written_next_to_snapshot(tmp_path):
+    """A faulted single-process take leaves a parseable dump with the
+    op lifecycle, the fault trip, and the abort — and the dump residue
+    never confuses fsck's orphan scan on a committed snapshot."""
+    state = {"model": StateDict(w=np.arange(50_000, dtype=np.float32))}
+    cur = str(tmp_path / "cur")
+    faultinject.configure("fs.write@1=permanent")
+    try:
+        with pytest.raises(OSError):
+            Snapshot.take(cur, state)
+    finally:
+        faultinject.disable()
+    dump_file = os.path.join(cur, ".flight", "rank_0.jsonl")
+    assert os.path.isfile(dump_file)
+    events = [json.loads(line) for line in open(dump_file)]
+    names = [e["ev"] for e in events]
+    assert names[0] == "flight.dump"
+    assert "op.begin" in names
+    assert "fault.trip" in names
+    assert "op.abort" in names
+    assert all(e["ev"] in FLIGHT_EVENTS for e in events)
+    # A later successful take into a fresh dir with a restore-abort dump
+    # inside stays fsck-clean (.flight is internal residue, not orphans).
+    good = str(tmp_path / "good")
+    Snapshot.take(good, state)
+    flightrec.dump(good, 0, "manual")
+    code, report = run_fsck(good, echo=lambda *a, **k: None)
+    assert code == 0, report.findings
+
+
+def test_dump_skips_remote_paths_without_spool(monkeypatch):
+    monkeypatch.delenv(flightrec.DUMP_DIR_ENV_VAR, raising=False)
+    flightrec.record("phase", name="x", op="take")
+    assert flightrec.dump("s3://bucket/snap", 0, "abort") is None
+
+
+def test_dump_spool_dir_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(flightrec.DUMP_DIR_ENV_VAR, str(tmp_path))
+    flightrec.record("phase", name="x", op="take")
+    out = flightrec.dump("s3://bucket/snap", 3, "abort")
+    assert out == str(tmp_path / ".flight" / "rank_3.jsonl")
+    assert os.path.isfile(out)
+
+
+# ------------------------------------------------------- merge machinery
+
+
+def _mk_dump(tmp_path, rank, records):
+    d = tmp_path / ".flight"
+    d.mkdir(exist_ok=True)
+    with open(d / f"rank_{rank}.jsonl", "w") as f:
+        f.write(json.dumps({"seq": 0, "t": 0.0, "ev": "flight.dump",
+                            "rank": rank, "reason": "test"}) + "\n")
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_merge_aligns_clocks_on_shared_collective(tmp_path):
+    """Rank clocks with wildly different epochs align on the shared
+    (ns, cseq) anchor; the deserter is named from the causal keys."""
+    ns = "pgw/ns/1-abc"
+    _mk_dump(tmp_path, 0, [
+        {"seq": 1, "t": 1000.0, "ev": "collective.enter", "kind": "barrier",
+         "ns": ns, "cseq": 1},
+        {"seq": 2, "t": 1000.1, "ev": "collective.exit", "kind": "barrier",
+         "ns": ns, "cseq": 1, "ok": True},
+        {"seq": 3, "t": 1005.0, "ev": "collective.enter", "kind": "barrier",
+         "ns": ns, "cseq": 2},
+        {"seq": 4, "t": 1012.0, "ev": "collective.exit", "kind": "barrier",
+         "ns": ns, "cseq": 2, "ok": False, "error": "TimeoutError('8s')"},
+        {"seq": 5, "t": 1012.1, "ev": "op.abort", "op": "take",
+         "error": "RuntimeError('peer died')"},
+    ])
+    _mk_dump(tmp_path, 1, [
+        {"seq": 1, "t": 50.0, "ev": "collective.enter", "kind": "barrier",
+         "ns": ns, "cseq": 1},
+        {"seq": 2, "t": 50.1, "ev": "collective.exit", "kind": "barrier",
+         "ns": ns, "cseq": 1, "ok": True},
+        # rank 1 never reaches barrier #2: it is the deserter
+        {"seq": 3, "t": 50.2, "ev": "op.abort", "op": "take",
+         "error": "InjectedTransientError('boom')"},
+    ])
+    merged = flightrec.merge_timeline(flightrec.load_dumps(str(tmp_path)))
+    assert merged["aligned"] is True
+    desertions = [f for f in merged["findings"] if f["class"] in
+                  ("desertion", "collective-error")]
+    assert desertions, merged["findings"]
+    d = desertions[0]
+    assert d["cseq"] == 2
+    assert d["never_arrived"] == [1]
+    assert d["errored"] == [0]
+    text = flightrec.render_timeline(merged)
+    assert "DESERTION" in text
+    assert "rank(s) 1 never arrived" in text
+    assert "InjectedTransientError" in text
+
+
+def test_merge_tolerates_torn_lines_and_single_rank(tmp_path):
+    d = tmp_path / ".flight"
+    d.mkdir()
+    with open(d / "rank_0.jsonl", "w") as f:
+        f.write(json.dumps({"seq": 1, "t": 1.0, "ev": "op.begin",
+                            "op": "take"}) + "\n")
+        f.write('{"seq": 2, "t": 1.5, "ev": "pha')  # torn mid-write
+    merged = flightrec.merge_timeline(flightrec.load_dumps(str(tmp_path)))
+    assert merged["ranks"] == [0]
+    assert len(merged["events"]) == 1
+
+
+# ------------------------------------------------- w2 desertion drill
+
+
+def _desertion_worker(rank: int, world_size: int, root: str):
+    from torchsnapshot_tpu import faultinject as fi
+    from torchsnapshot_tpu.telemetry import flightrec as fr
+
+    fr.set_enabled(True)
+    fr.reset()
+    rng = np.random.default_rng(1000 * rank)
+    state = {"model": StateDict(w=rng.standard_normal(8_000).astype(np.float32))}
+    if rank == 0:
+        # The PR 5 drain-phase desertion schedule: the delay parks rank
+        # 0's write past the manifest gather, the transient fires inside
+        # its post-gather sync_complete — deserting rank 1 at the commit
+        # barrier (bounded by the wrapper error channel).
+        fi.configure("fs.write@2=delay:0.3;fs.write@2=transient")
+    err = None
+    try:
+        Snapshot.take(os.path.join(root, "cur"), state)
+    except BaseException as e:  # noqa: B036
+        err = repr(e)
+    finally:
+        fi.disable()
+    return {"err": err}
+
+
+@pytest.mark.multiprocess
+def test_w2_desertion_drill_dumps_and_blackbox_names_the_deserter(tmp_path):
+    """The acceptance drill: the commit-barrier desertion schedule at w2
+    ends with BOTH ranks' .flight dumps on disk, and the merged timeline
+    names the failing rank, the desertion, and the commit generation."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    results = run_with_subprocesses(
+        _desertion_worker, 2, str(tmp_path), timeout=180.0
+    )
+    for rank, out in results.items():
+        assert out["err"] is not None, rank
+    cur = str(tmp_path / "cur")
+    for rank in (0, 1):
+        assert os.path.isfile(
+            os.path.join(cur, ".flight", f"rank_{rank}.jsonl")
+        ), f"rank {rank} left no flight dump"
+    dumps = flightrec.load_dumps(cur)
+    merged = flightrec.merge_timeline(dumps)
+    text = flightrec.render_timeline(merged, verbose=True)
+    # The failing rank (0, the injected one) is named in an abort finding
+    # with the injected error class.
+    aborts = [f for f in merged["findings"] if f["class"] == "abort"]
+    assert any(
+        f["rank"] == 0 and "InjectedTransientError" in str(f["error"])
+        for f in aborts
+    ), aborts
+    # The desertion itself: a collective some ranks never finished.
+    assert "DESERTION" in text or any(
+        f["class"] in ("desertion", "collective-error")
+        for f in merged["findings"]
+    ), text
+    # The commit generation is in the timeline (rank 0 planted the fence).
+    assert "gen=" in text
+    # The fault trip that caused it all is named with its site.
+    assert "fs.write" in text
+
+
+# ----------------------------------------------------------- preemption
+
+
+def test_sigterm_records_event_and_optionally_dumps(tmp_path, monkeypatch):
+    """The preemption watcher records ``preempt.signal`` from the handler
+    (a single GIL-atomic append — handler-safe) and, with
+    TORCHSNAPSHOT_TPU_FLIGHTREC_SIGTERM=1, spools the ring to the
+    FLIGHTREC_DIR on the next normal-control-flow call."""
+    from torchsnapshot_tpu.preemption import (
+        PreemptionWatcher,
+        simulate_preemption_now,
+    )
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_FLIGHTREC_SIGTERM", "1")
+    monkeypatch.setenv(flightrec.DUMP_DIR_ENV_VAR, str(tmp_path))
+    watcher = PreemptionWatcher()
+    try:
+        simulate_preemption_now()
+        assert watcher.preempted
+        names = [r[2] for r in flightrec.snapshot_ring()]
+        assert "preempt.signal" in names
+        assert watcher.should_save() is True  # triggers the deferred dump
+        dumped = tmp_path / ".flight" / "rank_0.jsonl"
+        assert dumped.is_file()
+        recs = [json.loads(line) for line in open(dumped)]
+        assert recs[0]["reason"] == "sigterm"
+        assert any(r["ev"] == "preempt.signal" for r in recs)
+    finally:
+        watcher.close()
+
+
+# ------------------------------------------------------------- taxonomy
+
+
+def test_taxonomy_lint_is_clean():
+    r = subprocess.run(
+        [sys.executable, TAXONOMY_SCRIPT], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_taxonomy_lint_detects_unregistered_and_computed_names(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "check_event_taxonomy", TAXONOMY_SCRIPT
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    violations, uses = lint.check_source(
+        "from .telemetry import flightrec\n"
+        "flightrec.record('not.an.event', a=1)\n"
+        "flightrec.record(name_var, a=1)\n"
+        "flightrec.record('phase', name='x')\n",
+        "bad.py",
+    )
+    whats = "\n".join(w for _, w in violations)
+    assert "not registered" in whats
+    assert "string literal" in whats
+    assert uses == {"phase": [4]}
+
+
+def test_taxonomy_registry_matches_module():
+    assert "collective.enter" in FLIGHT_EVENTS
+    assert "store.failover" in FLIGHT_EVENTS
+    assert len(FLIGHT_EVENTS) >= 15
+
+
+def test_timing_lint_covers_flightrec():
+    """Satellite: the ad-hoc-timing lint walks telemetry/flightrec.py
+    (a clock consumer) even though the telemetry package owns the raw
+    clock."""
+    spec = importlib.util.spec_from_file_location(
+        "check_timing_lint",
+        os.path.join(REPO, "scripts", "check_timing_lint.py"),
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    assert "flightrec.py" in lint.TELEMETRY_COVERED

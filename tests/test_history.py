@@ -1,0 +1,249 @@
+"""Checkpoint history (telemetry/history.py): the crash-safe journal,
+p50 regression detection, the ``stats --trend`` gate, and the
+OpenMetrics export."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.cli import main
+from torchsnapshot_tpu.telemetry import history
+from torchsnapshot_tpu.telemetry.export import render_openmetrics
+
+
+def _seed(root, walls, gbps=None):
+    for i, w in enumerate(walls):
+        rec = {"ts": time.time(), "op": "take", "snapshot": f"step_{i:010d}",
+               "world_size": 2, "wall_s": w}
+        if gbps is not None:
+            rec["write_gbps"] = gbps[i]
+        assert history.append_record(str(root), rec)
+
+
+# ----------------------------------------------------------- journal
+
+
+def test_append_is_one_line_and_reader_skips_torn_lines(tmp_path):
+    _seed(tmp_path, [1.0, 1.1])
+    path = history.history_path(str(tmp_path))
+    with open(path, "a") as f:
+        f.write('{"ts": 1, "op": "take", "wall_s": 1.2')  # torn: no newline
+    records = history.load_history(str(tmp_path))
+    assert [r["wall_s"] for r in records] == [1.0, 1.1]
+    # The journal accepts appends after a torn tail (O_APPEND line model).
+    assert history.append_record(
+        str(tmp_path), {"ts": 2, "op": "take", "wall_s": 1.3}
+    )
+    # The torn fragment merges with the next line — exactly one record
+    # is lost, never the journal.
+    records = history.load_history(str(tmp_path))
+    assert records[0]["wall_s"] == 1.0
+
+
+def test_append_refuses_missing_root(tmp_path):
+    assert not history.append_record(str(tmp_path / "nope"), {"wall_s": 1})
+
+
+def test_committed_take_appends_history(tmp_path):
+    """Every committed take appends a record to the snapshot ROOT —
+    with the telemetry bus OFF (the default): wall time and identity
+    always record."""
+    state = {"model": StateDict(w=np.arange(10_000, dtype=np.float32))}
+    Snapshot.take(str(tmp_path / "step_0000000001"), state)
+    Snapshot.take(str(tmp_path / "step_0000000002"), state)
+    records = history.load_history(str(tmp_path))
+    assert len(records) == 2
+    assert records[0]["snapshot"] == "step_0000000001"
+    assert records[1]["snapshot"] == "step_0000000002"
+    assert all(r["wall_s"] > 0 for r in records)
+    assert all(r["op"] == "take" for r in records)
+
+
+def test_aborted_take_appends_nothing(tmp_path):
+    from torchsnapshot_tpu import faultinject
+
+    state = {"model": StateDict(w=np.arange(10_000, dtype=np.float32))}
+    faultinject.configure("fs.write@1=permanent")
+    try:
+        with pytest.raises(OSError):
+            Snapshot.take(str(tmp_path / "step_0000000001"), state)
+    finally:
+        faultinject.disable()
+    assert history.load_history(str(tmp_path)) == []
+
+
+def test_manager_history_carries_step(tmp_path):
+    from torchsnapshot_tpu import CheckpointManager
+
+    state = {"model": StateDict(w=np.arange(1000, dtype=np.float32))}
+    from torchsnapshot_tpu import telemetry
+
+    telemetry.set_enabled(True)
+    try:
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        mgr.save(0, state)
+        mgr.save(1, state)
+    finally:
+        telemetry.set_enabled(False)
+        telemetry.reset()
+    records = history.load_history(str(tmp_path))
+    assert [r.get("step") for r in records] == [0, 1]
+    # With the bus on, counters ride along.
+    assert records[-1].get("bytes_written", 0) > 0
+
+
+# ---------------------------------------------------------- regression
+
+
+def test_detect_regression_flags_slowdown():
+    records = [{"wall_s": 1.0 + 0.01 * i} for i in range(10)]
+    records += [{"wall_s": 1.6} for _ in range(5)]
+    v = history.detect_regression(records, threshold=0.25)
+    assert v["regressed"] is True
+    assert v["recent_p50"] == 1.6
+    assert v["ratio"] > 1.5
+
+
+def test_detect_regression_ok_within_threshold():
+    records = [{"wall_s": 1.0} for _ in range(10)] + [{"wall_s": 1.1}] * 5
+    v = history.detect_regression(records, threshold=0.25)
+    assert v["regressed"] is False
+
+
+def test_detect_regression_throughput_metric_lower_is_worse():
+    records = [{"write_gbps": 2.3} for _ in range(8)] + [
+        {"write_gbps": 1.0} for _ in range(4)
+    ]
+    v = history.detect_regression(records, metric="write_gbps", threshold=0.25)
+    assert v["regressed"] is True
+
+
+def test_detect_regression_insufficient_history_never_fails_ci():
+    v = history.detect_regression([{"wall_s": 1.0}, {"wall_s": 9.0}])
+    assert v["regressed"] is False
+    assert v["reason"] == "insufficient history"
+
+
+def test_threshold_env(monkeypatch):
+    monkeypatch.setenv(history.TREND_THRESHOLD_ENV_VAR, "0.5")
+    assert history.trend_threshold() == 0.5
+    monkeypatch.setenv(history.TREND_THRESHOLD_ENV_VAR, "junk")
+    assert history.trend_threshold() == 0.25
+
+
+# ------------------------------------------------------- stats --trend
+
+
+def test_stats_trend_detects_injected_regression_and_exits_1(tmp_path, capsys):
+    _seed(tmp_path, [1.0] * 8 + [1.8] * 5, gbps=[2.3] * 8 + [1.2] * 5)
+    rc = main(["stats", str(tmp_path), "--trend"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSED" in out
+    assert "history: 13 committed take(s)" in out
+
+
+def test_stats_trend_ok_exits_0(tmp_path, capsys):
+    _seed(tmp_path, [1.0] * 10)
+    assert main(["stats", str(tmp_path), "--trend"]) == 0
+    assert "trend[wall_s]: ok" in capsys.readouterr().out
+
+
+def test_stats_trend_threshold_flag(tmp_path):
+    _seed(tmp_path, [1.0] * 8 + [1.2] * 4)  # +20%
+    assert main(["stats", str(tmp_path), "--trend"]) == 0  # default 25%
+    assert main(
+        ["stats", str(tmp_path), "--trend", "--trend-threshold", "0.1"]
+    ) == 1
+
+
+def test_stats_trend_no_history_exits_2(tmp_path, capsys):
+    assert main(["stats", str(tmp_path), "--trend"]) == 2
+    assert "no usable checkpoint history" in capsys.readouterr().err
+
+
+# -------------------------------------------------------- openmetrics
+
+
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$"
+)
+_META_LINE = re.compile(r"^# (TYPE|HELP|EOF)")
+
+
+def test_openmetrics_format_sanity(tmp_path, capsys):
+    from torchsnapshot_tpu import telemetry
+
+    telemetry.set_enabled(True)
+    try:
+        state = {"model": StateDict(w=np.arange(10_000, dtype=np.float32))}
+        cur = str(tmp_path / "cur")
+        Snapshot.take(cur, state)
+    finally:
+        telemetry.set_enabled(False)
+        telemetry.reset()
+    assert main(["stats", cur, "--openmetrics"]) == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert lines[-1] == "# EOF"
+    for line in lines:
+        if line.startswith("#"):
+            assert _META_LINE.match(line) or line.startswith("# HELP"), line
+        else:
+            assert _METRIC_LINE.match(line), line
+    # Counter SAMPLES end in _total while the TYPE line names the bare
+    # family, per the OpenMetrics spec; samples are labeled with the op.
+    assert "# TYPE torchsnapshot_tpu_bytes_written counter" in out
+    assert "torchsnapshot_tpu_bytes_written_total{" in out
+    assert 'op="take"' in out
+    assert 'rank="0"' in out
+    # The authoritative check, when the reference parser is available:
+    # a strict OpenMetrics parser must accept the exposition whole.
+    try:
+        from prometheus_client.openmetrics import parser
+    except ImportError:
+        return
+    families = list(parser.text_string_to_metric_families(out))
+    names = {f.name for f in families}
+    assert "torchsnapshot_tpu_bytes_written" in names
+
+
+def test_openmetrics_escapes_label_values():
+    doc = {
+        "op": 'ta"ke\n',
+        "world_size": 1,
+        "ranks": [{"op": "take", "rank": 0, "wall_s": 1.0,
+                   "counters": {"bytes_written": 10}}],
+    }
+    from torchsnapshot_tpu.telemetry.aggregate import merge_summaries
+
+    doc["fleet"] = merge_summaries(doc["ranks"])
+    out = render_openmetrics(doc)
+    assert '\\"' in out
+    assert "\\n" in out
+    assert out.endswith("# EOF\n")
+
+
+def test_openmetrics_json_roundtrip_document(tmp_path):
+    """render_openmetrics works from a re-loaded persisted document (the
+    exact bytes `stats` reads), not just in-memory dicts."""
+    from torchsnapshot_tpu import telemetry
+
+    telemetry.set_enabled(True)
+    try:
+        state = {"model": StateDict(w=np.arange(1000, dtype=np.float32))}
+        cur = str(tmp_path / "cur")
+        Snapshot.take(cur, state)
+    finally:
+        telemetry.set_enabled(False)
+        telemetry.reset()
+    doc = json.loads(open(os.path.join(cur, ".snapshot_telemetry")).read())
+    out = render_openmetrics(doc)
+    assert out.splitlines()[-1] == "# EOF"

@@ -32,7 +32,19 @@ on-call asks, so they get first-class commands here:
 - ``stats``    — render the telemetry summary a take persisted next to
   ``.snapshot_metadata`` (phase walls, per-rank counters, fleet skew;
   see telemetry/ and docs/source/telemetry.rst). Answers "why was this
-  take slow?" after the process is gone.
+  take slow?" after the process is gone. ``--trend`` renders the
+  checkpoint history journal of a ROOT directory and exits non-zero on
+  a p50 regression; ``--openmetrics`` emits the summary in OpenMetrics
+  text format for scrape pipelines.
+- ``blackbox`` — merge the per-rank flight-recorder dumps an aborted
+  operation left under ``<snapshot>/.flight/`` into one causal
+  cross-rank timeline: who deserted whom at which barrier, store
+  failovers with epochs, refused (stale) commits with generations
+  (telemetry/flightrec.py; always on by default).
+- ``watch``    — live fleet view of an in-flight take/restore from the
+  heartbeat keys every rank publishes through the coordination store:
+  per-rank phase/bytes/ETA, stalled-rank flags, and skew — visible
+  BEFORE the barrier timeout turns a stall into an abort.
 - ``store-status`` — probe a live coordination-store node (leader or
   standby): role, epoch, op-log position, per-replica lag and lease age
   (dist_store replication tier; docs/source/fault_tolerance.rst).
@@ -554,7 +566,7 @@ def _fsck_orphan_scan(
                 referenced.add(os.path.normpath(location))
 
     internal_files = {SNAPSHOT_METADATA_FNAME, ".snapshot_telemetry"}
-    internal_prefixes = (".telemetry", ".fsck_quarantine")
+    internal_prefixes = (".telemetry", ".fsck_quarantine", ".flight")
     for dirpath, dirnames, filenames in os.walk(local_dir):
         rel_dir = os.path.relpath(dirpath, local_dir)
         top = (rel_dir.split(os.sep, 1)[0] if rel_dir != "." else "")
@@ -1040,18 +1052,50 @@ def cmd_prune(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_trend(args: argparse.Namespace) -> int:
+    """``stats --trend``: render the checkpoint-history trajectory for a
+    ROOT directory (the parent of the step snapshots) and exit non-zero
+    on a p50 regression — CI-pluggable perf-regression detection from
+    the journal every committed take appends."""
+    from .telemetry import history
+
+    records = history.load_history(args.path)
+    if not records:
+        print(
+            f"error: no usable checkpoint history at {args.path} (expected "
+            f"{history.HISTORY_FNAME} in the snapshot ROOT directory — it "
+            "is appended by every committed take)",
+            file=sys.stderr,
+        )
+        return 2
+    threshold = args.trend_threshold
+    verdicts = [
+        history.detect_regression(
+            records, metric=args.trend_metric, threshold=threshold
+        )
+    ]
+    print(history.render_trend(records, verdicts))
+    return 1 if any(v.get("regressed") for v in verdicts) else 0
+
+
 def cmd_stats(args: argparse.Namespace) -> int:
     """Render the telemetry summary a take persisted next to its
     metadata (telemetry/export.py) — "why was this take slow?" answered
-    after the fact, from any registered storage backend."""
+    after the fact, from any registered storage backend. ``--trend``
+    switches to the checkpoint-history view (see cmd_trend);
+    ``--openmetrics`` emits the summary as an OpenMetrics exposition."""
     import json
 
     from .storage_plugin import url_to_storage_plugin_in_event_loop
     from .telemetry import (
         TELEMETRY_SUMMARY_FNAME,
         merge_summaries,
+        render_openmetrics,
         render_summary_document,
     )
+
+    if args.trend:
+        return cmd_trend(args)
 
     event_loop = asyncio.new_event_loop()
     storage = url_to_storage_plugin_in_event_loop(args.path, event_loop, None)
@@ -1088,6 +1132,9 @@ def cmd_stats(args: argparse.Namespace) -> int:
         # Documents written by future/foreign producers may omit the
         # merged view; re-derive it so the rendering stays complete.
         doc["fleet"] = merge_summaries(doc.get("ranks") or [])
+    if args.openmetrics:
+        sys.stdout.write(render_openmetrics(doc))
+        return 0
     print(render_summary_document(doc, verbose=args.verbose))
     return 0
 
@@ -1099,6 +1146,96 @@ def cmd_consolidate(args: argparse.Namespace) -> int:
     print(f"consolidated {args.src} -> {args.dst} ({n} payloads copied; "
           "no base snapshots required)")
     return 0
+
+
+def cmd_blackbox(args: argparse.Namespace) -> int:
+    """Merge the per-rank flight-recorder dumps of an aborted operation
+    into one causal cross-rank timeline: who deserted whom at which
+    barrier, which rank adopted which store epoch, which commit was
+    refused at which generation (telemetry/flightrec.py;
+    docs/source/telemetry.rst, "Flight recorder"). Exit codes: 0 dumps
+    found with no findings, 1 findings, 2 no dumps."""
+    import json
+
+    from .telemetry import flightrec
+
+    dumps = flightrec.load_dumps(args.path)
+    if not dumps:
+        print(
+            f"error: no flight dumps under {args.path}/{flightrec.FLIGHT_DIR}/ "
+            "— dumps are written per rank when an operation aborts (the "
+            "flight recorder is on by default; "
+            "TORCHSNAPSHOT_TPU_FLIGHTREC=0 disables it)",
+            file=sys.stderr,
+        )
+        return 2
+    merged = flightrec.merge_timeline(dumps)
+    if args.json:
+        print(json.dumps(merged, indent=1, default=repr))
+    else:
+        print(flightrec.render_timeline(merged, verbose=args.verbose))
+    return 1 if merged.get("findings") else 0
+
+
+def cmd_watch(args: argparse.Namespace) -> int:
+    """Render the in-flight fleet from the heartbeat keys ranks publish
+    through the coordination store (telemetry/health.py): per-rank
+    phase/bytes/ETA, stalled-rank flags, and skew — BEFORE the barrier
+    timeout turns a stall into an abort. Survives a store-leader
+    failover the same way every client does (transparent adoption);
+    with the whole tier down it degrades to a retry line, never a
+    crash."""
+    import time as _time  # frame pacing, not measurement
+
+    from .dist_store import TCPStore
+    from .telemetry import health
+
+    host, _, port_str = args.addr.rpartition(":")
+    if not host or not port_str.isdigit():
+        print(f'error: --addr must be "host:port", got {args.addr!r}',
+              file=sys.stderr)
+        return 2
+    tracker = health.FleetTracker(stall_s=args.stall)
+    store = None
+    ticks = 0
+    while True:
+        try:
+            if store is None:
+                store = TCPStore(
+                    host,
+                    int(port_str),
+                    is_server=False,
+                    timeout=max(args.interval * 2, 5.0),
+                    connect_retries=0,
+                )
+            fleet = health.read_fleet(store)
+            ages = tracker.observe(fleet)
+            frame = health.render_fleet(fleet, ages, args.stall)
+        except Exception as e:  # noqa: BLE001 - degrade, keep watching
+            # Keep the store object when we have one: its cached replica
+            # set is what makes the NEXT poll fail over transparently. A
+            # dead bootstrap connection is rebuilt from scratch.
+            if store is not None and getattr(store, "_dead", None) is not None:
+                try:
+                    store.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                store = None
+            frame = (
+                f"store unreachable at {args.addr} "
+                f"({type(e).__name__}: {e}); retrying"
+            )
+        ticks += 1
+        print(f"--- watch {args.addr} tick {ticks}")
+        print(frame, flush=True)
+        if args.ticks and ticks >= args.ticks:
+            if store is not None:
+                try:
+                    store.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            return 0
+        _time.sleep(args.interval)
 
 
 def cmd_store_status(args: argparse.Namespace) -> int:
@@ -1199,13 +1336,57 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser(
         "stats",
         help="render the persisted telemetry summary of a take "
-             "(requires TORCHSNAPSHOT_TPU_TELEMETRY=1 at save time)",
+             "(requires TORCHSNAPSHOT_TPU_TELEMETRY=1 at save time); "
+             "--trend renders the checkpoint history of a ROOT directory "
+             "and exits 1 on a p50 regression; --openmetrics emits the "
+             "summary as an OpenMetrics exposition",
     )
     p.add_argument("path")
     p.add_argument("--json", action="store_true", help="dump the raw document")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="include all spans and measured rates")
+    p.add_argument("--trend", action="store_true",
+                   help="render .telemetry_history.jsonl of a snapshot ROOT "
+                        "and gate on p50 regression (exit 1)")
+    p.add_argument("--trend-metric", default="wall_s",
+                   choices=["wall_s", "write_gbps", "read_gbps"],
+                   help="history metric to gate on (default wall_s). "
+                        "Constrained: a typo'd metric would match no "
+                        "records and silently disarm the CI gate")
+    p.add_argument("--trend-threshold", type=float, default=None,
+                   help="p50 regression threshold as a fraction (default "
+                        "TORCHSNAPSHOT_TPU_TREND_THRESHOLD or 0.25)")
+    p.add_argument("--openmetrics", action="store_true",
+                   help="emit the summary in OpenMetrics text format")
     p.set_defaults(fn=cmd_stats)
+
+    p = sub.add_parser(
+        "blackbox",
+        help="merge per-rank flight-recorder dumps (<snapshot>/.flight/) "
+             "into one causal cross-rank timeline with findings "
+             "(exit 0 clean / 1 findings / 2 no dumps)",
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true", help="dump the merged view")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="show the full timeline (default: last 200 events)")
+    p.set_defaults(fn=cmd_blackbox)
+
+    p = sub.add_parser(
+        "watch",
+        help="live fleet view of an in-flight take/restore from the "
+             "coordination store's heartbeat keys: per-rank phase/bytes/"
+             "ETA, stalled ranks, skew",
+    )
+    p.add_argument("addr", help='coordination store address, "host:port"')
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between frames (default 1.0)")
+    p.add_argument("--stall", type=float, default=5.0,
+                   help="flag a rank STALLED after this many seconds "
+                        "without heartbeat progress (default 5.0)")
+    p.add_argument("--ticks", type=int, default=0,
+                   help="render N frames then exit (0 = forever)")
+    p.set_defaults(fn=cmd_watch)
 
     p = sub.add_parser(
         "migrate", help="convert a reference-format snapshot to native format"

@@ -776,6 +776,9 @@ class _StoreServer:
             link.close()
         self._replicas = []
         self._cond.notify_all()
+        from .telemetry import flightrec
+
+        flightrec.record("store.epoch", epoch=self._epoch, role="deposed")
 
     def _accept_replica(self, conn: socket.socket, req: Dict[str, Any]) -> bool:
         """A standby joined: full-sync it under the link lock (so no
@@ -947,6 +950,12 @@ class _StoreServer:
                 # the whole sync; they get the stream once flushed).
                 links = [l for l in self._replicas if not l.syncing]
                 msg = {"op": "lease_renew", "epoch": self._epoch}
+            if links:
+                from .telemetry import flightrec
+
+                flightrec.record(
+                    "store.lease", epoch=self._epoch, replicas=len(links)
+                )
             for link in links:
                 try:
                     link.send(msg, timeout=self._replica_timeout())
@@ -1161,6 +1170,12 @@ class _StoreServer:
                 self._epoch,
                 self._log_seq,
                 len(self._data),
+            )
+            from .telemetry import flightrec
+
+            flightrec.record(
+                "store.epoch", epoch=self._epoch, role="leader",
+                log_seq=self._log_seq,
             )
             self._ensure_lease_thread()
             return
@@ -1680,8 +1695,12 @@ class TCPStore:
             self._rsv = rs.get("rsv", self._rsv)
         self.failovers += 1
         from . import telemetry
+        from .telemetry import flightrec
 
         telemetry.counter_add("store_failovers", 1)
+        flightrec.record(
+            "store.failover", epoch=epoch, leader=cand, cause=repr(cause)
+        )
         logger.warning(
             "coordination store failover #%d: adopted leader %s (epoch %d) "
             "after %s",

@@ -309,6 +309,9 @@ class CoopKeyPlan:
                 # Known-dead owner at dispatch time: skip the wait, read
                 # directly — cheaper than a poisoned-inbox round trip.
                 telemetry.counter_add("fanout_fallbacks", 1)
+                telemetry.flightrec.record(
+                    "fanout.fallback", key=key, owner=owner
+                )
                 return None
             return RecvRole(self._session, key, owner)
         return None

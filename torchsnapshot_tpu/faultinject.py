@@ -270,6 +270,18 @@ class FaultPlan:
             for i, rule in enumerate(fired):
                 if rule.action == "corrupt" and rule.arg is None:
                     offsets[i] = self._rng.randrange(1 << 30)
+        # Flight-record the trip BEFORE the action runs: a kill/raise
+        # below must leave the trip in the ring (and in any dump peers
+        # trigger). Lazy import — the disabled path (no plan) never
+        # reaches here, and the injector stays stdlib-importable.
+        from .telemetry import flightrec
+
+        flightrec.record(
+            "fault.trip",
+            site=name,
+            hit=hit,
+            action=",".join(r.action for r in fired),
+        )
         raiser: Optional[_Rule] = None
         for i, rule in enumerate(fired):
             if rule.action == "delay":

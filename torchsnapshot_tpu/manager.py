@@ -410,6 +410,9 @@ class CheckpointManager:
             mode="emergency" if emergency else ("async" if use_async else "sync"),
             incremental_base=base,
         )
+        # The live health plane's step field (watch renders it); survives
+        # the publisher's per-op reset like the annotation above.
+        telemetry.health.update(step=step)
         if use_async:
             self._pending = Snapshot.async_take(path, app_state, **kwargs)
             self._pending_step = step

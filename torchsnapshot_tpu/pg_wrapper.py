@@ -38,6 +38,7 @@ a long-running job snapshotting every N steps keeps the store bounded.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import threading
@@ -46,6 +47,7 @@ import zlib
 from typing import Any, Dict, List, Optional
 
 from .dist_store import DEATH_KEY, TCPStore, create_store
+from .telemetry import flightrec
 
 STORE_ADDR_ENV_VAR = "TORCHSNAPSHOT_TPU_STORE_ADDR"
 _HANDSHAKE_SEQ_KEY = "pgw/seq"
@@ -315,15 +317,39 @@ class PGWrapper:
 
     # -- object collectives over the KV store ------------------------------
 
+    @contextlib.contextmanager
+    def _recorded(self, kind: str, seq: int, timeout: Optional[float] = None):
+        """Flight-record one collective's enter/exit around its body.
+
+        ``(ns, cseq)`` is the cross-rank causal key: every rank of one
+        collective records the same pair, so the blackbox merge can name
+        who deserted whom at which barrier without comparable clocks.
+        The deadline is recorded when the collective owns one (else it
+        inherits the store's barrier timeout)."""
+        ns = self._ns  # caller resolved the namespace already
+        flightrec.record(
+            "collective.enter", kind=kind, ns=ns, cseq=seq, deadline_s=timeout
+        )
+        try:
+            yield
+        except BaseException as e:  # noqa: B036
+            flightrec.record(
+                "collective.exit", kind=kind, ns=ns, cseq=seq, ok=False,
+                error=repr(e),
+            )
+            raise
+        flightrec.record("collective.exit", kind=kind, ns=ns, cseq=seq, ok=True)
+
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         if self.get_world_size() == 1:
             return obj
         ns = self._namespace()
         key = f"{ns}/bcast/{self._next_seq()}"
-        if self.get_rank() == src:
-            self.pg.store.set(key, _dumps(obj))
-            return obj
-        return _loads(self._wait(key))
+        with self._recorded("broadcast", self._seq):
+            if self.get_rank() == src:
+                self.pg.store.set(key, _dumps(obj))
+                return obj
+            return _loads(self._wait(key))
 
     def all_gather_object(
         self, obj: Any, timeout: Optional[float] = None
@@ -349,28 +375,29 @@ class PGWrapper:
         prefix = f"{ns}/gather/{seq}/"
         all_key = f"{ns}/gather/{seq}-all"
         store = self.pg.store
-        if self.get_rank() == 0:
-            stopped, items = store.collect(
-                prefix,
-                self.get_world_size() - 1,
-                stop_keys=[self._error_key(), DEATH_KEY],
-                timeout=timeout,
-            )
-            if stopped is not None:
-                err = pickle.loads(items[stopped])
-                raise RuntimeError(
-                    "A peer rank died during a collective."
-                    if stopped == DEATH_KEY
-                    else "A peer rank reported an error during a collective."
-                ) from err
-            assembled = [obj] + [
-                _loads(items[f"{prefix}{r}"])
-                for r in range(1, self.get_world_size())
-            ]
-            store.set(all_key, _dumps(assembled))
-            return assembled
-        store.set(f"{prefix}{self.get_rank()}", _dumps(obj))
-        return _loads(self._wait(all_key, timeout))
+        with self._recorded("all_gather", seq, timeout=timeout):
+            if self.get_rank() == 0:
+                stopped, items = store.collect(
+                    prefix,
+                    self.get_world_size() - 1,
+                    stop_keys=[self._error_key(), DEATH_KEY],
+                    timeout=timeout,
+                )
+                if stopped is not None:
+                    err = pickle.loads(items[stopped])
+                    raise RuntimeError(
+                        "A peer rank died during a collective."
+                        if stopped == DEATH_KEY
+                        else "A peer rank reported an error during a collective."
+                    ) from err
+                assembled = [obj] + [
+                    _loads(items[f"{prefix}{r}"])
+                    for r in range(1, self.get_world_size())
+                ]
+                store.set(all_key, _dumps(assembled))
+                return assembled
+            store.set(f"{prefix}{self.get_rank()}", _dumps(obj))
+            return _loads(self._wait(all_key, timeout))
 
     def scatter_object(self, objs: Optional[List[Any]], src: int = 0) -> Any:
         if self.get_world_size() == 1:
@@ -379,13 +406,14 @@ class PGWrapper:
         ns = self._namespace()
         seq = self._next_seq()
         rank = self.get_rank()
-        if rank == src:
-            assert objs is not None and len(objs) == self.get_world_size()
-            self.pg.store.mset(
-                {f"{ns}/scatter/{seq}/{r}": _dumps(o) for r, o in enumerate(objs)}
-            )
-            return objs[src]
-        return _loads(self._wait(f"{ns}/scatter/{seq}/{rank}"))
+        with self._recorded("scatter", seq):
+            if rank == src:
+                assert objs is not None and len(objs) == self.get_world_size()
+                self.pg.store.mset(
+                    {f"{ns}/scatter/{seq}/{r}": _dumps(o) for r, o in enumerate(objs)}
+                )
+                return objs[src]
+            return _loads(self._wait(f"{ns}/scatter/{seq}/{rank}"))
 
     def barrier(self) -> None:
         if self.get_world_size() == 1:
@@ -393,7 +421,8 @@ class PGWrapper:
         ns = self._namespace()
         seq = self._next_seq()
         store = self.pg.store
-        arrived = store.add(f"{ns}/barrier/{seq}/count", 1)
-        if arrived == self.get_world_size():
-            store.set(f"{ns}/barrier/{seq}/done", b"1")
-        self._wait(f"{ns}/barrier/{seq}/done")
+        with self._recorded("barrier", seq):
+            arrived = store.add(f"{ns}/barrier/{seq}/count", 1)
+            if arrived == self.get_world_size():
+                store.set(f"{ns}/barrier/{seq}/done", b"1")
+            self._wait(f"{ns}/barrier/{seq}/done")

@@ -58,8 +58,14 @@ import threading
 from typing import Optional, Sequence
 
 from .pg_wrapper import PGWrapper, ProcessGroup
+from .telemetry import flightrec
 
 logger = logging.getLogger(__name__)
+
+
+def _sigterm_dump_enabled() -> bool:
+    raw = os.environ.get("TORCHSNAPSHOT_TPU_FLIGHTREC_SIGTERM", "").strip().lower()
+    return raw in ("1", "on", "true", "yes")
 
 # Distinguishes "caller passed pg explicitly (even None)" from "caller
 # did not pass pg": an explicit pg — CheckpointManager always passes its
@@ -96,7 +102,10 @@ class PreemptionWatcher:
         # hit stream-reentrancy RuntimeErrors mid-write — aborting the
         # training loop at the exact moment the watcher exists to protect
         # — so the signal is recorded here and logged lazily from the
-        # next should_save()/consume() call.
+        # next should_save()/consume() call. The flight-recorder append
+        # is a single GIL-atomic deque op (no lock, no I/O), so it is
+        # handler-safe; the DUMP is deferred to _log_pending.
+        flightrec.record("preempt.signal", signum=signum)
         self._signums.append(signum)
         self._flagged.set()
         prev = self._prev.get(signum)
@@ -106,11 +115,26 @@ class PreemptionWatcher:
         # to the caller's loop, which breaks after the committed save.
 
     def _log_pending(self) -> None:
+        dump_now = bool(self._signums) and _sigterm_dump_enabled()
         while self._signums:
             logger.warning(
                 "received signal %d: flagged for emergency checkpoint",
                 self._signums.pop(0),
             )
+        if dump_now:
+            # Opt-in (TORCHSNAPSHOT_TPU_FLIGHTREC_SIGTERM=1): spool the
+            # flight ring on the first normal-control-flow call after the
+            # signal — the grace window may be too short for the
+            # emergency save to reach its own dump-on-abort path. Target
+            # dir comes from TORCHSNAPSHOT_TPU_FLIGHTREC_DIR (there is no
+            # snapshot path yet at signal time).
+            try:
+                from .pg_wrapper import PGWrapper
+
+                rank = PGWrapper(self._pg_raw).get_rank()
+            except Exception:  # noqa: BLE001
+                rank = 0
+            flightrec.dump(None, rank, "sigterm")
 
     @property
     def preempted(self) -> bool:

@@ -76,7 +76,7 @@ import os
 import socket
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set
 
 import psutil
 
@@ -532,7 +532,15 @@ class _ProgressReporter:
     scheduler.py:96-175): stage counts, bytes staged/written, budget
     remaining, and RSS delta — the observability needed to diagnose a stall
     on a real pod save. Runs as an asyncio task on the pipeline's loop;
-    logs at INFO every ``interval_s``."""
+    logs at INFO every ``interval_s``.
+
+    One sampler, three sinks: each tick emits the log table, a
+    flight-recorder ``progress`` event (so an abort dump shows where the
+    pipeline was, tick by tick), and the live health plane's byte/queue
+    fields (telemetry.health — what ``watch`` renders). The read and
+    write pipelines share ONE assembly: the read pipeline has no staging
+    phase, so its staging columns are simply absent — not a second
+    format string that drifts."""
 
     def __init__(
         self,
@@ -540,11 +548,24 @@ class _ProgressReporter:
         rank: int,
         total: int,
         budget: "_MemoryBudget",
-        interval_s: float = 5.0,
+        interval_s: Optional[float] = None,
     ) -> None:
+        if interval_s is None:
+            # TORCHSNAPSHOT_TPU_PROGRESS_S tunes the sampling cadence —
+            # the log table, the flight-recorder progress events, and the
+            # heartbeat byte feed all tick together (an operator watching
+            # a short take wants sub-second frames; default 5 s).
+            raw = os.environ.get("TORCHSNAPSHOT_TPU_PROGRESS_S", "").strip()
+            try:
+                interval_s = float(raw) if raw else 5.0
+            except ValueError:
+                interval_s = 5.0
         self.op = op
         self.rank = rank
         self.total = total
+        # Total payload bytes for this pipeline, when the caller knows it
+        # (feeds the heartbeat's ETA; 0 = unknown).
+        self.total_bytes = 0
         self.budget = budget
         self.interval_s = interval_s
         self.staged_count = 0
@@ -590,42 +611,51 @@ class _ProgressReporter:
         telemetry.gauge_set(f"{self.op}_inflight_staging", self.inflight_staging)
         telemetry.gauge_set(f"{self.op}_inflight_io", self.inflight_io)
         telemetry.gauge_set("budget_free_bytes", self.budget.available)
-        if self.op == "read":
-            # The read pipeline has no staging phase: report in-flight and
-            # consumed counts with read-appropriate wording.
-            logger.info(
-                "[rank %d] read progress +%.0fs | reqs: %d total, %d in "
-                "flight, %d consumed | %.2f GB consumed | budget free "
-                "%.2f/%.2f GB | rss delta %+.2f GB",
-                self.rank,
-                elapsed,
-                self.total,
-                self.inflight_io,
-                self.completed_count,
-                self.completed_bytes / 1e9,
-                self.budget.available / 1e9,
-                self.budget.budget_bytes / 1e9,
-                rss_delta / 1e9,
-            )
-            return
+        is_read = self.op == "read"
+        done_word = "consumed" if is_read else "written"
+        cols = [f"{self.total} total"]
+        if not is_read:
+            cols.append(f"{self.inflight_staging} staging")
+            cols.append(f"{self.staged_count} staged")
+        cols.append(f"{self.inflight_io} in {'flight' if is_read else 'io'}")
+        cols.append(f"{self.completed_count} {done_word}")
+        vols = [] if is_read else [f"{self.staged_bytes / 1e9:.2f} GB staged"]
+        vols.append(f"{self.completed_bytes / 1e9:.2f} GB {done_word}")
         logger.info(
-            "[rank %d] %s progress +%.0fs | reqs: %d total, %d staging, "
-            "%d staged, %d in io, %d written | %.2f GB staged, %.2f GB "
-            "written | budget free %.2f/%.2f GB | rss delta %+.2f GB",
+            "[rank %d] %s progress +%.0fs | reqs: %s | %s | budget free "
+            "%.2f/%.2f GB | rss delta %+.2f GB",
             self.rank,
             self.op,
             elapsed,
-            self.total,
-            self.inflight_staging,
-            self.staged_count,
-            self.inflight_io,
-            self.completed_count,
-            self.staged_bytes / 1e9,
-            self.completed_bytes / 1e9,
+            ", ".join(cols),
+            ", ".join(vols),
             self.budget.available / 1e9,
             self.budget.budget_bytes / 1e9,
             rss_delta / 1e9,
         )
+        telemetry.flightrec.record(
+            "progress",
+            op=self.op,
+            total=self.total,
+            done=self.completed_count,
+            done_bytes=self.completed_bytes,
+            staged_bytes=self.staged_bytes,
+            inflight_staging=self.inflight_staging,
+            inflight_io=self.inflight_io,
+        )
+        fields: Dict[str, Any] = {
+            "total_entries": self.total,
+            "done_entries": self.completed_count,
+            "inflight_io": self.inflight_io,
+        }
+        if self.total_bytes:
+            fields["total_bytes"] = self.total_bytes
+        if is_read:
+            fields["read_bytes"] = self.completed_bytes
+        else:
+            fields["staged_bytes"] = self.staged_bytes
+            fields["written_bytes"] = self.completed_bytes
+        telemetry.health.update(**fields)
 
 
 class _Throughput:
@@ -815,6 +845,7 @@ async def execute_write_reqs(
         _WritePipeline(req, sub_chunk_bytes=sub_chunk, storage=storage)
         for req in write_reqs
     ]
+    reporter.total_bytes = sum(p.staging_cost_bytes for p in ready_for_staging)
     # Stage large requests first: improves budget packing and overlaps the
     # slowest DtoH copies with I/O of everything else.
     ready_for_staging.sort(key=lambda p: p.staging_cost_bytes, reverse=True)
@@ -1188,6 +1219,9 @@ class _ReadPipeline:
                 e,
             )
             telemetry.counter_add("fanout_fallbacks", 1)
+            telemetry.flightrec.record(
+                "fanout.fallback", key=path, owner=role.owner, kind=kind
+            )
             telemetry.event(
                 "fanout_fallback",
                 cat="retry",
@@ -1435,6 +1469,7 @@ async def execute_read_reqs(
         )
         for req in read_reqs
     ]
+    reporter.total_bytes = sum(p.consuming_cost_bytes for p in pending)
     # Peer-fed entries dispatch first (no storage I/O; draining inboxes
     # early bounds receiver-side buffering), then owned/forwarding
     # entries (peers are waiting on them), then plain reads — and within

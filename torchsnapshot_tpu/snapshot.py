@@ -114,6 +114,13 @@ class _PhaseTimer:
         # Phase boundaries double as trace markers: the exported Chrome
         # trace shows where materialize/plan/stage/commit begin and end.
         telemetry.event(f"phase:{name}", cat="phase", op=self.op, dur_s=now - self._t)
+        # ...and as the flight recorder's phase-transition events (what
+        # an abort dump anchors on) and the live heartbeat's phase field
+        # (what `watch` renders as "where is this rank").
+        telemetry.flightrec.record(
+            "phase", name=name, op=self.op, dur_s=round(now - self._t, 6)
+        )
+        telemetry.health.update(phase=name)
         self._t = now
 
     def log(self) -> None:
@@ -245,6 +252,10 @@ class Snapshot:
         )
         timer = _PhaseTimer("Snapshot.take")
         recorder = telemetry.begin_op("take", pg_wrapper.get_rank())
+        telemetry.flightrec.record(
+            "op.begin", op="take", rank=pg_wrapper.get_rank(), path=path
+        )
+        heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
         body_ok = False
         try:
             # Synchronous take blocks the caller until I/O drains, so staged
@@ -302,10 +313,30 @@ class Snapshot:
             # env skew can never desync the collective order.
             cls._publish_telemetry(
                 "take", recorder, timer, pg_wrapper, storage, event_loop,
-                persist=True,
+                persist=True, path=path,
             )
             body_ok = True
+        except BaseException as e:  # noqa: B036
+            # The flight recorder's moment: record the abort and dump the
+            # ring next to the snapshot BEFORE the exception propagates —
+            # StaleCommitError, a barrier timeout, a peer desertion, and
+            # plain storage failures all unwind through here. The dump
+            # never raises (it must not mask the abort).
+            telemetry.flightrec.record(
+                "op.abort", op="take", error=repr(e), kind=type(e).__name__
+            )
+            telemetry.flightrec.dump(
+                path, pg_wrapper.get_rank(),
+                f"take aborted: {type(e).__name__}",
+            )
+            # The recorder never reaches finish() on this path; release
+            # it so it stops pinning the telemetry event buffer (the
+            # abort's traceback cycle can outlive this frame by a lot).
+            recorder.abandon()
+            raise
         finally:
+            if heartbeat is not None:
+                heartbeat.stop()
             # A success flag, NOT sys.exc_info(): in a finally block
             # exc_info also reports an AMBIENT exception the caller is
             # currently handling (take() inside an except block), which
@@ -365,21 +396,38 @@ class Snapshot:
         )
         timer = _PhaseTimer("Snapshot.async_take")
         recorder = telemetry.begin_op("take", pg_wrapper.get_rank())
-        pending_io_work, metadata = cls._take_impl(
-            path=path,
-            app_state=app_state,
-            replicated=replicated or [],
-            pg_wrapper=pg_wrapper,
-            storage=storage,
-            event_loop=event_loop,
-            timer=timer,
-            incremental_base=incremental_base,
-            record_digests=record_digests,
-            storage_options=storage_options,
-            compression=compression,
-            save_dtype=save_dtype,
-            device_digests=device_digests,
+        telemetry.flightrec.record(
+            "op.begin", op="take", rank=pg_wrapper.get_rank(), path=path
         )
+        heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
+        try:
+            pending_io_work, metadata = cls._take_impl(
+                path=path,
+                app_state=app_state,
+                replicated=replicated or [],
+                pg_wrapper=pg_wrapper,
+                storage=storage,
+                event_loop=event_loop,
+                timer=timer,
+                incremental_base=incremental_base,
+                record_digests=record_digests,
+                storage_options=storage_options,
+                compression=compression,
+                save_dtype=save_dtype,
+                device_digests=device_digests,
+            )
+        except BaseException as e:  # noqa: B036
+            telemetry.flightrec.record(
+                "op.abort", op="take", error=repr(e), kind=type(e).__name__
+            )
+            telemetry.flightrec.dump(
+                path, pg_wrapper.get_rank(),
+                f"async_take staging aborted: {type(e).__name__}",
+            )
+            recorder.abandon()
+            if heartbeat is not None:
+                heartbeat.stop()
+            raise
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
             path=path,
@@ -391,6 +439,7 @@ class Snapshot:
             storage_options=storage_options,
             timer=timer,
             recorder=recorder,
+            heartbeat=heartbeat,
         )
 
     @classmethod
@@ -767,6 +816,10 @@ class Snapshot:
         )
         timer = _PhaseTimer("Snapshot.restore")
         recorder = telemetry.begin_op("restore", rank)
+        telemetry.flightrec.record(
+            "op.begin", op="restore", rank=rank, path=self.path
+        )
+        heartbeat = telemetry.health.maybe_start(pg_wrapper, "restore", self.path)
         coop_session = None
         try:
             metadata = self._read_metadata(storage, event_loop)
@@ -952,7 +1005,18 @@ class Snapshot:
             if exc is not None:
                 raise exc
             timer.log()
+        except BaseException as e:  # noqa: B036
+            telemetry.flightrec.record(
+                "op.abort", op="restore", error=repr(e), kind=type(e).__name__
+            )
+            telemetry.flightrec.dump(
+                self.path, rank, f"restore aborted: {type(e).__name__}"
+            )
+            recorder.abandon()
+            raise
         finally:
+            if heartbeat is not None:
+                heartbeat.stop()
             if coop_session is not None:
                 try:
                     # Clean shutdown (bye frames) so this rank's exit is
@@ -1538,6 +1602,7 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
     ) -> None:
+        telemetry.flightrec.record("fence.plant", gen=gen)
         event_loop.run_until_complete(
             storage.write(
                 WriteIO(
@@ -1602,6 +1667,9 @@ class Snapshot:
         gen = getattr(metadata, "_commit_gen", None)
         if gen is not None:
             found = Snapshot._read_fence_gen(storage, event_loop)
+            telemetry.flightrec.record(
+                "commit.decision", gen=gen, found=found, ok=found == gen
+            )
             if found != gen:
                 raise StaleCommitError(
                     getattr(metadata, "_commit_path", "<unknown>"), gen, found
@@ -1638,10 +1706,14 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         persist: bool,
+        path: Optional[str] = None,
     ) -> None:
         """Finish this rank's per-op telemetry summary, gather every
         rank's over the KV store, merge the fleet view, and (takes only)
         persist the document + per-rank Chrome traces into the snapshot.
+        ``path`` (takes) additionally appends one compact record to the
+        parent directory's ``.telemetry_history.jsonl`` — the checkpoint
+        history the ``stats --trend`` regression gate reads.
 
         COLLECTIVE CONTRACT: when world_size > 1 the gather runs
         UNCONDITIONALLY — a telemetry-disabled rank contributes None — so
@@ -1676,6 +1748,12 @@ class Snapshot:
                 gathered = [summary]
             fleet = telemetry.merge_summaries(gathered)
             telemetry.set_last_fleet(fleet)
+            if persist and path is not None and pg_wrapper.get_rank() == 0:
+                # History works with the bus OFF too (fleet None): wall
+                # time and identity always record; counters/rates ride
+                # along when telemetry contributed a fleet view. rank 0
+                # only; crash-safe append (telemetry/history.py).
+                cls._append_history(op, path, timer, pg_wrapper, fleet, summary)
             if fleet is None:
                 return  # telemetry off everywhere: zero residue
             agg = fleet.get("aggregate") or {}
@@ -1730,6 +1808,42 @@ class Snapshot:
             logger.exception(
                 "telemetry persistence failed; the snapshot is unaffected"
             )
+
+    @staticmethod
+    def _append_history(
+        op: str,
+        path: str,
+        timer: Optional[_PhaseTimer],
+        pg_wrapper: PGWrapper,
+        fleet: Optional[Dict[str, Any]],
+        summary: Optional[Dict[str, Any]],
+    ) -> None:
+        """Append this committed take to ``<parent>/.telemetry_history
+        .jsonl`` (local roots only; guarded — history must never fail a
+        committed snapshot)."""
+        try:
+            from .storage_plugin import local_fs_root
+
+            local = local_fs_root(path)
+            if local is None:
+                return
+            root = os.path.dirname(os.path.abspath(local.rstrip("/")))
+            wall = (
+                sum(dt for _, dt in timer.phases) if timer is not None else 0.0
+            )
+            step = ((summary or {}).get("annotations") or {}).get("step")
+            record = telemetry.history.build_record(
+                op=op,
+                path=path,
+                wall_s=wall,
+                world_size=pg_wrapper.get_world_size(),
+                fleet=fleet,
+                rank_summary=summary,
+                step=step,
+            )
+            telemetry.history.append_record(root, record)
+        except Exception:  # noqa: BLE001
+            logger.exception("history append failed; the snapshot is unaffected")
 
     # --------------------------------------------------------------- helpers
 
@@ -2063,11 +2177,13 @@ class PendingSnapshot:
         barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
         timer: Optional[_PhaseTimer] = None,
         recorder: Optional["telemetry.OpRecorder"] = None,
+        heartbeat: Optional[Any] = None,
     ) -> None:
         self.path = path
         self.pg = pg_wrapper.pg
         self._timer = timer
         self._recorder = recorder
+        self._heartbeat = heartbeat
         self._storage_options = storage_options
         self._done_event = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -2145,7 +2261,7 @@ class PendingSnapshot:
                 # runs no further collectives after async_take returned.
                 Snapshot._publish_telemetry(
                     "take", self._recorder, self._timer, pg_wrapper,
-                    storage, event_loop, persist=True,
+                    storage, event_loop, persist=True, path=self.path,
                 )
             snapshot = Snapshot(self.path, self.pg, self._storage_options)
             snapshot._metadata = metadata
@@ -2157,8 +2273,25 @@ class PendingSnapshot:
                 except Exception:
                     pass
             self._exc = e
+            # Background-thread aborts are the flight recorder's hardest
+            # case — no caller stack survives; the dump is the artifact.
+            telemetry.flightrec.record(
+                "op.abort", op="take", error=repr(e), kind=type(e).__name__,
+                gen=getattr(metadata, "_commit_gen", None),
+            )
+            telemetry.flightrec.dump(
+                self.path, pg_wrapper.get_rank(),
+                f"async commit aborted: {type(e).__name__}",
+            )
+            if self._recorder is not None:
+                self._recorder.abandon()
             logger.exception("async_take failed; snapshot was not committed.")
         finally:
+            if self._heartbeat is not None:
+                try:
+                    self._heartbeat.stop()
+                except Exception:  # noqa: BLE001
+                    pass
             try:
                 # Final act on this rank: ack namespace retirement so rank 0
                 # can reclaim this operation's store keys later.

@@ -145,6 +145,7 @@ class MirroredStoragePlugin(StoragePlugin):
             path=path,
             error=type(primary_exc).__name__,
         )
+        telemetry.flightrec.record("mirror.failover", path=path, kind=kind)
         return kind
 
     async def read(self, read_io: ReadIO) -> None:

@@ -330,6 +330,9 @@ class CollectiveRetryStrategy:
                 fleet_attempts=self.fleet_attempts,
                 fleet_backoff_s=round(self.fleet_backoff_s, 3),
             )
+            telemetry.flightrec.record(
+                "retry.exhausted", kind=kind, op=op, attempts=attempt + 1
+            )
             raise attach_retry_history(
                 exc,
                 attempts=attempt + 1,
@@ -349,6 +352,10 @@ class CollectiveRetryStrategy:
             kind=kind,
             op=op,
             attempt=attempt,
+            backoff_s=round(backoff, 3),
+        )
+        telemetry.flightrec.record(
+            "retry.attempt", kind=kind, op=op, attempt=attempt,
             backoff_s=round(backoff, 3),
         )
         logger.warning("Transient storage error (%s); retrying in %.1fs", exc, backoff)

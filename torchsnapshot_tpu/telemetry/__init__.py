@@ -46,8 +46,19 @@ from .export import (  # noqa: F401
     chrome_trace,
     chrome_trace_json,
     fmt_bytes,
+    render_openmetrics,
     render_summary_document,
     trace_path_for_rank,
     write_chrome_trace,
 )
 from .aggregate import merge_summaries  # noqa: F401
+# The always-on observability planes (ISSUE 7): the flight recorder
+# (bounded ring + abort dumps + blackbox merge, event registry in
+# taxonomy.py), the live health plane (heartbeats over the coordination
+# store), and the per-root checkpoint history (trend/regression
+# detection). Imported as submodules — their APIs are namespaced
+# (flightrec.record, health.update, ...), matching how the pipeline
+# calls them. NOTE the registry module is named ``taxonomy`` (not
+# ``events``) so it can never shadow the ``events()`` scrape function
+# exported from core above.
+from . import flightrec, health, history, taxonomy  # noqa: F401, E402

@@ -25,12 +25,20 @@ _SUMMED_COUNTERS = (
     "bytes_read",
     "bytes_staged",
     "bytes_deduped",
+    "bytes_to_peers",
     "entries_written",
     "entries_streamed",
     "entries_read",
     "retry_attempts",
     "retry_backoff_s",
     "budget_defers",
+    # Degradation counters (PR 4/6 machinery): a fleet that failed over
+    # mid-take must SAY so in the persisted summary — these existed on
+    # the bus but vanished post-hoc until the observability PR.
+    "store_failovers",
+    "lease_renewals",
+    "fanout_fallbacks",
+    "mirror_failovers",
 )
 
 

@@ -446,6 +446,17 @@ class OpRecorder:
         _set_last_summary(summary)
         return summary
 
+    def abandon(self) -> None:
+        """Release this recorder WITHOUT producing a summary (abort
+        paths). A recorder abandoned merely by dropping the reference
+        stops pinning the event buffer only when the cyclic GC collects
+        it — and an abort's exception/traceback cycle can keep the frame
+        (and so the recorder) alive arbitrarily long, during which every
+        later op's begin_op trims nothing and the buffer runs into the
+        cap. Explicit release closes that window; idempotent, and safe
+        to call after finish()."""
+        _live_recorders.discard(self)
+
     def events(self) -> List[Dict[str, Any]]:
         """Events recorded since this op began (for per-op trace export).
 

@@ -193,8 +193,29 @@ def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
             lines.append(f"read:        {_fmt_bytes(agg['bytes_read'])} aggregate")
         if agg.get("bytes_deduped"):
             lines.append(f"deduped:     {_fmt_bytes(agg['bytes_deduped'])} skipped")
+        if agg.get("bytes_to_peers"):
+            lines.append(
+                f"peer bytes:  {_fmt_bytes(agg['bytes_to_peers'])} redistributed"
+            )
         if agg.get("retry_attempts"):
             lines.append(f"retries:     {agg['retry_attempts']:.0f} attempts")
+        # Degradation counters: zero is the healthy (and silent) case;
+        # any non-zero value is the headline of a post-mortem.
+        degraded = [
+            f"{label}={agg[key]:.0f}"
+            for key, label in (
+                ("store_failovers", "store"),
+                ("mirror_failovers", "mirror"),
+                ("fanout_fallbacks", "fanout"),
+            )
+            if agg.get(key)
+        ]
+        if degraded:
+            lines.append(f"failovers:   {', '.join(degraded)}")
+        if agg.get("lease_renewals"):
+            lines.append(
+                f"lease:       {agg['lease_renewals']:.0f} renewal round(s)"
+            )
     for summary in ranks:
         lines.append("")
         lines.append(
@@ -228,3 +249,70 @@ def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
         if summary.get("dropped_events"):
             lines.append(f"  dropped_events: {summary['dropped_events']}")
     return "\n".join(lines)
+
+
+# -------------------------------------------------------------- openmetrics
+
+_METRIC_PREFIX = "torchsnapshot_tpu"
+
+
+def _om_escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def render_openmetrics(doc: Dict[str, Any]) -> str:
+    """Render a persisted summary document in OpenMetrics text format
+    (``stats --openmetrics``), so a scrape sidecar can lift a take's
+    counters into Prometheus without parsing our JSON.
+
+    Counter families end in ``_total`` per the spec; per-rank samples
+    carry a ``rank`` label; the exposition ends with ``# EOF``."""
+    lines: List[str] = []
+    op = doc.get("op") or "unknown"
+    fleet = doc.get("fleet") or {}
+    agg = fleet.get("aggregate") or {}
+    ranks = [r for r in (doc.get("ranks") or []) if isinstance(r, dict)]
+
+    counter_keys = sorted(
+        k for k, v in agg.items()
+        if isinstance(v, (int, float)) and not k.endswith("_gbps")
+    )
+    for key in counter_keys:
+        # Per the OpenMetrics spec the TYPE/HELP lines name the metric
+        # FAMILY (no suffix); only the sample carries ``_total``. Strict
+        # parsers (prometheus_client) reject a _total-suffixed family as
+        # a name clash with its own sample.
+        family = f"{_METRIC_PREFIX}_{key}"
+        lines.append(f"# TYPE {family} counter")
+        lines.append(f"# HELP {family} fleet-summed {key} for the last {op}")
+        lines.append(f'{family}_total{{op="{_om_escape(op)}"}} {agg[key]:g}')
+    gauge_rows = [
+        ("fleet_wall_seconds", fleet.get("wall_s_max")),
+        ("fleet_skew_seconds", fleet.get("skew_s")),
+        ("fleet_write_gbps", agg.get("write_gbps")),
+        ("fleet_read_gbps", agg.get("read_gbps")),
+        ("world_size", doc.get("world_size")),
+        ("reporting_ranks", fleet.get("reporting")),
+    ]
+    for key, value in gauge_rows:
+        if value is None:
+            continue
+        name = f"{_METRIC_PREFIX}_{key}"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f'{name}{{op="{_om_escape(op)}"}} {value:g}')
+    if ranks:
+        name = f"{_METRIC_PREFIX}_rank_wall_seconds"
+        lines.append(f"# TYPE {name} gauge")
+        for summary in ranks:
+            lines.append(
+                f'{name}{{op="{_om_escape(op)}",'
+                f'rank="{summary.get("rank", 0)}"}} '
+                f"{summary.get('wall_s', 0):g}"
+            )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,56 @@
+"""The flight-recorder event taxonomy: every recordable event, by name.
+
+The flight recorder (flightrec.py) is always on, so its event stream is
+an OPERATOR INTERFACE, not debug logging: the ``blackbox`` CLI merges
+rank dumps by matching these names, post-mortem runbooks grep for them,
+and tests assert on them. A name invented ad hoc at a call site would be
+invisible to all three — so the taxonomy is pinned here, and
+``scripts/check_event_taxonomy.py`` (tier-1, the same lint culture as
+``check_fault_sites.py``) verifies every ``flightrec.record(...)`` call
+in the package uses a registered string literal, and that every
+registered name is actually wired somewhere.
+
+Unlike fault-injection sites, one event name MAY have several call sites
+(``collective.enter`` fires from every collective verb); what must be
+unique is the meaning, which the registry row documents.
+
+Causal keys: events carry whatever coordination identity the layer has —
+``ns``/``cseq`` (the PGWrapper namespace + collective sequence, shared
+by all ranks of one collective), ``epoch`` (store leadership), ``gen``
+(the commit-fence generation) — so the cross-rank merge can align
+timelines without comparable clocks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+EVENTS: Dict[str, str] = {
+    # operation lifecycle (snapshot.py)
+    "op.begin": "a take/restore began on this rank (op, rank, path)",
+    "op.abort": "a take/restore raised (op, error, kind) — triggers a dump",
+    "phase": "op phase transition (_PhaseTimer.mark: name, op, dur_s)",
+    "progress": "periodic pipeline progress sample (scheduler reporter)",
+    # collectives (pg_wrapper.py)
+    "collective.enter": "entered a KV-store collective (kind, ns, cseq, deadline_s)",
+    "collective.exit": "left a collective (kind, ns, cseq, ok[, error])",
+    # coordination store (dist_store.py)
+    "store.failover": "client adopted a new store leader (epoch, leader, cause)",
+    "store.epoch": "a standby assumed leadership / a leader was deposed (epoch, role)",
+    "store.lease": "leader lease renewal round (epoch, replicas)",
+    # storage degradation (storage_plugins/)
+    "retry.attempt": "transient storage error scheduled for retry (kind, op, attempt)",
+    "retry.exhausted": "retry budget exhausted; error propagates (kind, op, attempts)",
+    "mirror.failover": "primary-tier read failed over to the mirror (path, kind)",
+    # cooperative restore (fanout.py)
+    "fanout.fallback": "peer-fed unit degraded to a direct storage read (key, owner)",
+    # commit protocol (snapshot.py)
+    "fence.plant": "rank 0 planted the commit fence (gen)",
+    "commit.decision": "fenced commit decision (gen, found, ok) — StaleCommitError when not ok",
+    # cross-cutting
+    "fault.trip": "a fault-injection rule fired (site, hit, action)",
+    "preempt.signal": "a termination signal was observed (signum)",
+    "flight.dump": "ring dump header (rank, reason, events, dropped)",
+}
+
+FLIGHT_EVENTS = frozenset(EVENTS)
