@@ -34,6 +34,15 @@ Two legs:
     the same 50 ms floor. The recorder records tens of events per save,
     never per-sub-chunk samples, so the gate has enormous margin — it
     exists to keep that invariant pinned.
+    And gates the latency-histogram instrument (ISSUE 8): the same
+    2 GiB save with the telemetry bus ENABLED and the histograms fully
+    wired (per-sub-chunk and per-entry observations recording) vs the
+    same enabled bus with ``histogram_observe`` bypassed to a raw
+    no-op, best-vs-best < 1% with the 50 ms floor — the marginal cost
+    of the distribution metric on top of the already-gated bus must be
+    bucket math plus one uncontended lock, nothing more. (The DISABLED
+    path needs no new gate: with the bus off every observation site is
+    one flag check, the exact shape the injector gate above pins.)
 
 Usage::
 
@@ -437,6 +446,112 @@ def flightrec_overhead(trials: int = 5) -> None:
     )
 
 
+def histogram_overhead(trials: int = 5) -> None:
+    """Histogram-instrument overhead on a ~2 GiB save with the telemetry
+    bus ENABLED (the configuration where the instruments actually fire):
+    fully wired (shipping ``histogram_observe`` — bucket math + one
+    uncontended lock per observation, per sub-chunk and per entry) vs
+    the same enabled bus with the instrument bypassed to a raw no-op.
+    Asserts best-vs-best delta < 1% with a 50 ms floor (ISSUE 8
+    acceptance; same paired/alternating bimodal-host recipe as the legs
+    above — noise only ever inflates a wall time, so each leg's min is
+    its honest cost)."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, telemetry
+
+    nbytes = 2 << 30
+    n_arrays = 8
+    per = nbytes // n_arrays // 4
+    state = {
+        "model": StateDict(
+            **{
+                f"p{i}": np.random.default_rng(i)
+                .standard_normal(per)
+                .astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+    }
+
+    observed = [0]
+
+    def timed_save() -> float:
+        root = tempfile.mkdtemp(prefix="hist_overhead_")
+        try:
+            t0 = time.perf_counter()
+            Snapshot.take(os.path.join(root, "s"), state)
+            return time.perf_counter() - t0
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+            observed[0] = max(
+                observed[0],
+                sum(
+                    h["count"]
+                    for by_key in telemetry.histograms().values()
+                    for h in by_key.values()
+                ),
+            )
+            telemetry.reset()  # drop the op's events between trials
+
+    def bypassed(fn):
+        # Call sites resolve ``telemetry.histogram_observe`` at call
+        # time, so patching the package attribute bypasses every wired
+        # instrument (scheduler, retry tier, pg_wrapper) at once.
+        saved = telemetry.histogram_observe
+        telemetry.histogram_observe = lambda name, seconds, key=None: None
+        try:
+            return fn()
+        finally:
+            telemetry.histogram_observe = saved
+
+    telemetry.set_enabled(True)
+    try:
+        timed_save()  # discarded warmup (staging-pool first-touch faults)
+        on_walls, off_walls = [], []
+        max_pairs = 2 * trials
+        for pair in range(max_pairs):
+            if pair % 2 == 0:
+                off = bypassed(timed_save)
+                on = timed_save()
+            else:
+                on = timed_save()
+                off = bypassed(timed_save)
+            on_walls.append(on)
+            off_walls.append(off)
+            budget_s = max(0.01 * min(off_walls), 0.05)
+            if pair + 1 >= trials and (
+                min(on_walls) - min(off_walls)
+            ) < budget_s:
+                break
+        n_observations = observed[0]
+    finally:
+        telemetry.set_enabled(False)
+        telemetry.reset()
+    off_best, on_best = min(off_walls), min(on_walls)
+    budget_s = max(0.01 * off_best, 0.05)
+    delta = (on_best - off_best) / off_best
+    report(
+        "histogram_overhead",
+        {
+            "gib": round(nbytes / (1 << 30), 2),
+            "pairs": len(on_walls),
+            "bypassed_trials_s": [round(t, 3) for t in off_walls],
+            "wired_trials_s": [round(t, 3) for t in on_walls],
+            "bypassed_best_s": round(off_best, 3),
+            "wired_best_s": round(on_best, 3),
+            "overhead_pct": round(delta * 100, 3),
+            "observations_last_save": n_observations,
+        },
+        data_bytes=nbytes,
+    )
+    assert (on_best - off_best) < budget_s, (
+        f"histogram-instrument overhead {delta * 100:.2f}% over the 1% "
+        f"budget (bypassed best {off_best:.3f}s vs wired best "
+        f"{on_best:.3f}s, floor 50 ms)"
+    )
+
+
 def store_overhead(trials: int = 5, ops: int = 3000) -> None:
     """Disabled-path overhead of the store replication tier (ISSUE 6
     acceptance): with replication OFF (no replicas joined — the shipping
@@ -527,6 +642,7 @@ def main() -> None:
     if args.overhead:
         overhead(args.trials)
         flightrec_overhead(args.trials)
+        histogram_overhead(args.trials)
         store_overhead(args.trials)
 
 

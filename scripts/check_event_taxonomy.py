@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""Lint: flight-recorder event names are registered literals, and the
-registry is fully wired.
+"""Lint: flight-recorder event names AND histogram instrument names are
+registered literals, and both registries are fully wired.
 
 The flight recorder (torchsnapshot_tpu/telemetry/flightrec.py) is always
 on: its event stream is an operator interface — the ``blackbox`` CLI
@@ -19,6 +19,14 @@ same lint culture as ``check_fault_sites.py``:
 3. **Literal-first calls.** The event name must be the literal first
    argument — computed names are unlintable and ungreppable.
 
+The latency-histogram instrument (``telemetry.histogram_observe``, ISSUE
+8) gets the same treatment against ``taxonomy.HISTOGRAM_NAMES``: fleet
+merges sum bucket-wise BY NAME and the /metrics exposition names
+families by it, so a typo'd instrument would silently fork a family no
+dashboard watches. Every ``histogram_observe(...)`` call in the package
+must pass a registered literal first argument, and every registered name
+must be observed somewhere.
+
 Run: ``python scripts/check_event_taxonomy.py`` — exits 0 when clean, 1
 with a per-violation report. Enforced in tier-1 via
 tests/test_flightrec.py.
@@ -36,7 +44,10 @@ PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
 
 sys.path.insert(0, REPO)
 
-from torchsnapshot_tpu.telemetry.taxonomy import FLIGHT_EVENTS  # noqa: E402
+from torchsnapshot_tpu.telemetry.taxonomy import (  # noqa: E402
+    FLIGHT_EVENTS,
+    HISTOGRAMS,
+)
 
 # Names a module may bind the flightrec module to. Calls are recognized
 # as ``<alias>.record(...)`` or ``telemetry.flightrec.record(...)``.
@@ -45,6 +56,8 @@ _MODULE_NAME = "flightrec"
 # Regression floor: the taxonomy shipped with this many events (ISSUE 7).
 # Shrinking it means an operator-facing event class was silently dropped.
 MIN_EVENTS = 15
+# Same floor for histogram instruments (ISSUE 8).
+MIN_HISTOGRAMS = 5
 
 
 def _is_flightrec_record(fn: ast.AST, aliases: set) -> bool:
@@ -57,13 +70,23 @@ def _is_flightrec_record(fn: ast.AST, aliases: set) -> bool:
     return isinstance(val, ast.Attribute) and val.attr == _MODULE_NAME
 
 
+def _is_histogram_observe(fn: ast.AST) -> bool:
+    """True for ``<anything>.histogram_observe`` and a bare
+    ``histogram_observe`` name (``from ... import histogram_observe``)."""
+    if isinstance(fn, ast.Attribute) and fn.attr == "histogram_observe":
+        return True
+    return isinstance(fn, ast.Name) and fn.id == "histogram_observe"
+
+
 def check_source(
     source: str, filename: str
-) -> Tuple[List[Tuple[int, str]], Dict[str, List[int]]]:
-    """Return (violations, {event_name: [lines]}) for one file."""
+) -> Tuple[List[Tuple[int, str]], Dict[str, List[int]], Dict[str, List[int]]]:
+    """Return (violations, {event_name: [lines]}, {hist_name: [lines]})
+    for one file."""
     tree = ast.parse(source, filename=filename)
     violations: List[Tuple[int, str]] = []
     uses: Dict[str, List[int]] = {}
+    hist_uses: Dict[str, List[int]] = {}
     aliases = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -76,6 +99,31 @@ def check_source(
                     aliases.add(alias.asname or alias.name)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
+            continue
+        if _is_histogram_observe(node.func):
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                violations.append(
+                    (
+                        node.lineno,
+                        "histogram_observe(...) — the instrument name must "
+                        "be a string literal",
+                    )
+                )
+                continue
+            name = node.args[0].value
+            if name not in HISTOGRAMS:
+                violations.append(
+                    (
+                        node.lineno,
+                        f"histogram_observe({name!r}) — instrument not "
+                        "registered in telemetry/taxonomy.py",
+                    )
+                )
+                continue
+            hist_uses.setdefault(name, []).append(node.lineno)
             continue
         if not _is_flightrec_record(node.func, aliases):
             continue
@@ -102,28 +150,35 @@ def check_source(
             )
             continue
         uses.setdefault(name, []).append(node.lineno)
-    return violations, uses
+    return violations, uses, hist_uses
 
 
 def run(package_dir: str = PACKAGE) -> List[str]:
     failures: List[str] = []
     wired: Dict[str, List[str]] = {}
+    hist_wired: Dict[str, List[str]] = {}
     for dirpath, _dirnames, filenames in os.walk(package_dir):
         for fname in sorted(filenames):
             if not fname.endswith(".py"):
                 continue
             rel = os.path.relpath(os.path.join(dirpath, fname), package_dir)
-            if rel == os.path.join("telemetry", "flightrec.py"):
-                continue  # the shim itself
+            if rel in (
+                os.path.join("telemetry", "flightrec.py"),
+                os.path.join("telemetry", "core.py"),
+            ):
+                continue  # the shims themselves
             path = os.path.join(dirpath, fname)
             with open(path, "r") as f:
                 source = f.read()
-            violations, uses = check_source(source, path)
+            violations, uses, hist_uses = check_source(source, path)
             for lineno, what in violations:
                 failures.append(f"{rel}:{lineno}: {what}")
             for name, lines in uses.items():
                 for lineno in lines:
                     wired.setdefault(name, []).append(f"{rel}:{lineno}")
+            for name, lines in hist_uses.items():
+                for lineno in lines:
+                    hist_wired.setdefault(name, []).append(f"{rel}:{lineno}")
     # flight.dump is emitted by the dump machinery itself (the header
     # record), not via record() — it is wired by construction.
     wired.setdefault("flight.dump", ["telemetry/flightrec.py:dump"])
@@ -132,10 +187,22 @@ def run(package_dir: str = PACKAGE) -> List[str]:
             f"event {name!r} is registered in telemetry/taxonomy.py but "
             "recorded nowhere — remove the registration or wire the event"
         )
+    for name in sorted(HISTOGRAMS - set(hist_wired)):
+        failures.append(
+            f"histogram {name!r} is registered in telemetry/taxonomy.py but "
+            "observed nowhere — remove the registration or wire the "
+            "instrument"
+        )
     if len(FLIGHT_EVENTS) < MIN_EVENTS:
         failures.append(
             f"event taxonomy shrank to {len(FLIGHT_EVENTS)} (< {MIN_EVENTS}): "
             "an operator-facing event class was dropped"
+        )
+    if len(HISTOGRAMS) < MIN_HISTOGRAMS:
+        failures.append(
+            f"histogram registry shrank to {len(HISTOGRAMS)} "
+            f"(< {MIN_HISTOGRAMS}): an operator-facing latency family was "
+            "dropped"
         )
     return failures
 
@@ -147,7 +214,10 @@ def main() -> int:
         for failure in sorted(failures):
             print(f"  {failure}", file=sys.stderr)
         return 1
-    print(f"event-taxonomy lint: clean ({len(FLIGHT_EVENTS)} events registered)")
+    print(
+        f"event-taxonomy lint: clean ({len(FLIGHT_EVENTS)} events, "
+        f"{len(HISTOGRAMS)} histograms registered)"
+    )
     return 0
 
 

@@ -105,7 +105,9 @@ def _violations_in(path: str) -> list:
 # owner: they must go through core.monotonic like the rest of the
 # package, so the lint covers them despite living in the exempt dir.
 # (core.py/export.py own the clock; history.py records calendar time.)
-TELEMETRY_COVERED = {"flightrec.py", "health.py"}
+# critpath.py consumes recorded span timestamps and promexp.py serves
+# scrapes — neither may ever grow a private clock.
+TELEMETRY_COVERED = {"flightrec.py", "health.py", "critpath.py", "promexp.py"}
 
 
 def main() -> int:

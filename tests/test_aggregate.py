@@ -121,3 +121,51 @@ def test_render_includes_failover_lines():
     assert "failovers:" in text
     assert "store=2" in text
     assert "fanout=2" in text
+
+
+# ------------------------------------------------------------- histograms
+
+
+def _hist(counts, total=None, s=0.0):
+    return {"counts": counts, "count": total if total is not None
+            else sum(counts), "sum": s}
+
+
+def test_merge_histograms_bucketwise_sum():
+    from torchsnapshot_tpu.telemetry.aggregate import merge_histograms
+
+    a = _summary(0, 1.0)
+    a["histograms"] = {
+        "write.entry_s": {"FS": _hist([1, 0, 2], s=0.5)},
+        "collective.wait_s": {"barrier": _hist([1], s=0.1)},
+    }
+    b = _summary(1, 1.0)
+    b["histograms"] = {"write.entry_s": {"FS": _hist([0, 3, 1], s=0.25)}}
+    merged = merge_histograms([a, b, None])
+    fs = merged["write.entry_s"]["FS"]
+    assert fs["counts"] == [1, 3, 3]
+    assert fs["count"] == 7
+    assert fs["sum"] == 0.75
+    # A family only one rank contributed survives untouched.
+    assert merged["collective.wait_s"]["barrier"]["counts"] == [1]
+
+
+def test_merge_histograms_pads_short_counts():
+    from torchsnapshot_tpu.telemetry.aggregate import merge_histograms
+
+    a = _summary(0, 1.0)
+    a["histograms"] = {"write.entry_s": {"": _hist([1])}}
+    b = _summary(1, 1.0)
+    b["histograms"] = {"write.entry_s": {"": _hist([0, 0, 5])}}
+    merged = merge_histograms([a, b])
+    assert merged["write.entry_s"][""]["counts"] == [1, 0, 5]
+
+
+def test_fleet_view_carries_histograms():
+    a = _summary(0, 1.0, {"bytes_written": 10})
+    a["histograms"] = {"write.entry_s": {"FS": _hist([2])}}
+    fleet = merge_summaries([a, _summary(1, 2.0)])
+    assert fleet["histograms"]["write.entry_s"]["FS"]["count"] == 2
+    # No histograms anywhere -> the key is absent, not an empty dict.
+    fleet = merge_summaries([_summary(0, 1.0)])
+    assert "histograms" not in fleet

@@ -299,7 +299,7 @@ def test_taxonomy_lint_detects_unregistered_and_computed_names(tmp_path):
     )
     lint = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(lint)
-    violations, uses = lint.check_source(
+    violations, uses, hist_uses = lint.check_source(
         "from .telemetry import flightrec\n"
         "flightrec.record('not.an.event', a=1)\n"
         "flightrec.record(name_var, a=1)\n"
@@ -310,12 +310,43 @@ def test_taxonomy_lint_detects_unregistered_and_computed_names(tmp_path):
     assert "not registered" in whats
     assert "string literal" in whats
     assert uses == {"phase": [4]}
+    assert hist_uses == {}
+
+
+def test_taxonomy_lint_covers_histogram_instruments():
+    """ISSUE 8 satellite: histogram instrument names are pinned the
+    same way flight events are — literal-first, registered-only, and
+    every registered family wired somewhere."""
+    spec = importlib.util.spec_from_file_location(
+        "check_event_taxonomy", TAXONOMY_SCRIPT
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    violations, _uses, hist_uses = lint.check_source(
+        "from . import telemetry\n"
+        "telemetry.histogram_observe('no.such_hist', 0.1)\n"
+        "telemetry.histogram_observe(computed_name, 0.1)\n"
+        "telemetry.histogram_observe('write.entry_s', 0.1, key='FS')\n",
+        "bad.py",
+    )
+    whats = "\n".join(w for _, w in violations)
+    assert "no.such_hist" in whats
+    assert "string literal" in whats
+    assert hist_uses == {"write.entry_s": [4]}
+    # The registry floor is enforced.
+    assert lint.MIN_HISTOGRAMS >= 5
 
 
 def test_taxonomy_registry_matches_module():
     assert "collective.enter" in FLIGHT_EVENTS
     assert "store.failover" in FLIGHT_EVENTS
+    assert "governor.elect" in FLIGHT_EVENTS
     assert len(FLIGHT_EVENTS) >= 15
+    from torchsnapshot_tpu.telemetry.taxonomy import HISTOGRAMS
+
+    assert "write.sub_chunk_s" in HISTOGRAMS
+    assert "collective.wait_s" in HISTOGRAMS
+    assert len(HISTOGRAMS) >= 5
 
 
 def test_timing_lint_covers_flightrec():
@@ -329,3 +360,6 @@ def test_timing_lint_covers_flightrec():
     lint = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(lint)
     assert "flightrec.py" in lint.TELEMETRY_COVERED
+    # ISSUE 8 satellite: the new clock consumers are covered too.
+    assert "critpath.py" in lint.TELEMETRY_COVERED
+    assert "promexp.py" in lint.TELEMETRY_COVERED

@@ -1,0 +1,194 @@
+"""Live OpenMetrics exporter (telemetry/promexp.py): exposition
+validity, the env-gated lifecycle, and the ISSUE 8 acceptance — a live
+/metrics scrape DURING an in-flight take parses clean under
+prometheus_client's strict OpenMetrics parser and includes at least one
+histogram family.
+"""
+
+import asyncio
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, telemetry
+from torchsnapshot_tpu.telemetry import promexp
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(promexp.METRICS_PORT_ENV_VAR, raising=False)
+    telemetry.set_enabled(False)
+    telemetry.reset()
+    telemetry.health.clear()
+    yield
+    promexp.stop_exporter()
+    telemetry.set_enabled(False)
+    telemetry.reset()
+    telemetry.health.clear()
+
+
+def _parse_openmetrics(text):
+    """Families via prometheus_client's strict parser, or None when the
+    package is absent (the regex-free authoritative check)."""
+    try:
+        from prometheus_client.openmetrics import parser
+    except ImportError:
+        return None
+    return list(parser.text_string_to_metric_families(text))
+
+
+# -------------------------------------------------------------- rendering
+
+
+def test_render_live_empty_is_valid():
+    out = promexp.render_live()
+    assert out.endswith("# EOF\n")
+    families = _parse_openmetrics(out)
+    if families is not None:
+        assert families == []
+
+
+def test_render_live_counters_gauges_histograms_heartbeat():
+    telemetry.set_enabled(True)
+    telemetry.counter_add("bytes_written", 123)
+    telemetry.gauge_set("budget_free_bytes", 7.5)
+    telemetry.histogram_observe("write.entry_s", 0.02, key="FSStoragePlugin")
+    telemetry.health.update(
+        op="take", phase="stage", written_bytes=64, binding="storage_write"
+    )
+    out = promexp.render_live(rank=3)
+    assert 'torchsnapshot_tpu_bytes_written_total{rank="3"} 123' in out
+    assert 'torchsnapshot_tpu_budget_free_bytes{rank="3"} 7.5' in out
+    assert "torchsnapshot_tpu_write_entry_s_bucket" in out
+    assert 'key="FSStoragePlugin"' in out
+    assert 'phase="stage"' in out
+    assert 'binding="storage_write"' in out
+    assert 'torchsnapshot_tpu_heartbeat_written_bytes{rank="3"} 64' in out
+    families = _parse_openmetrics(out)
+    if families is not None:
+        by_name = {f.name: f for f in families}
+        assert by_name["torchsnapshot_tpu_write_entry_s"].type == "histogram"
+        assert by_name["torchsnapshot_tpu_bytes_written"].type == "counter"
+
+
+# --------------------------------------------------------------- lifecycle
+
+
+def test_maybe_start_requires_env():
+    assert promexp.maybe_start() is None
+    assert promexp.active_exporter() is None
+
+
+def test_maybe_start_bad_port_value(monkeypatch):
+    monkeypatch.setenv(promexp.METRICS_PORT_ENV_VAR, "not-a-port")
+    assert promexp.maybe_start() is None
+
+
+def test_exporter_serves_and_is_idempotent(monkeypatch):
+    monkeypatch.setenv(promexp.METRICS_PORT_ENV_VAR, "0")  # ephemeral
+    exporter = promexp.maybe_start(rank=0)
+    assert exporter is not None
+    assert exporter.port > 0
+    again = promexp.maybe_start(rank=0)
+    assert again is exporter  # one exporter per process
+    telemetry.set_enabled(True)
+    telemetry.counter_add("bytes_written", 1)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{exporter.port}/metrics", timeout=10
+    ).read().decode("utf-8")
+    assert "torchsnapshot_tpu_bytes_written_total" in body
+    assert body.endswith("# EOF\n")
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/nope", timeout=10
+        )
+
+
+# ------------------------------------------------------------- acceptance
+
+
+class _SlowFS:
+    """Factory: an fs plugin whose payload writes pause, keeping the
+    take in flight long enough to scrape mid-save."""
+
+    @staticmethod
+    def build(delay_s: float):
+        from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+        class SlowFS(FSStoragePlugin):
+            supports_streaming = False
+
+            async def write(self, write_io):
+                if not write_io.path.startswith(".snapshot"):
+                    await asyncio.sleep(delay_s)
+                await super().write(write_io)
+
+        return SlowFS
+
+
+def test_live_scrape_during_in_flight_take(tmp_path, monkeypatch):
+    """Acceptance: a /metrics scrape while a take is IN FLIGHT parses
+    clean under prometheus_client's parser (when importable) and
+    includes at least one histogram family."""
+    monkeypatch.setattr(
+        "torchsnapshot_tpu.storage_plugins.fs.FSStoragePlugin",
+        _SlowFS.build(0.25),
+    )
+    monkeypatch.setenv(promexp.METRICS_PORT_ENV_VAR, "0")
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_PROGRESS_S", "0.05")
+    telemetry.set_enabled(True)
+    state = {
+        "m": StateDict(
+            **{
+                f"p{i}": np.random.default_rng(i)
+                .standard_normal(300_000)
+                .astype(np.float32)
+                for i in range(4)
+            }
+        )
+    }
+    snap = str(tmp_path / "snap")
+    failures = []
+
+    def run_take():
+        try:
+            Snapshot.take(snap, state)
+        except BaseException as e:  # noqa: BLE001
+            failures.append(e)
+
+    taker = threading.Thread(target=run_take)
+    taker.start()
+    try:
+        # Wait for the op itself to arm the exporter (promexp.maybe_start
+        # runs at op begin), then scrape while writes are still pausing.
+        deadline = 100
+        exporter = None
+        while exporter is None and deadline > 0:
+            exporter = promexp.active_exporter()
+            deadline -= 1
+            threading.Event().wait(0.05)
+        assert exporter is not None, "take never armed the exporter"
+        body = None
+        for _ in range(60):
+            if not taker.is_alive():
+                break
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/metrics", timeout=10
+            ).read().decode("utf-8")
+            if "_bucket{" in text:
+                body = text
+                break
+            threading.Event().wait(0.05)
+        assert body is not None, "no in-flight scrape carried a histogram"
+    finally:
+        taker.join(timeout=120)
+    assert not failures, failures
+    assert os.path.isfile(os.path.join(snap, ".snapshot_metadata"))
+    assert "# EOF" in body
+    families = _parse_openmetrics(body)
+    if families is not None:
+        assert any(f.type == "histogram" for f in families)

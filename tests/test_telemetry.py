@@ -102,9 +102,11 @@ def test_disabled_mode_is_noop():
     telemetry.event("x", k=1)
     telemetry.counter_add("c", 5)
     telemetry.gauge_set("g", 7)
+    telemetry.histogram_observe("write.entry_s", 0.1)
     assert telemetry.events() == []
     assert telemetry.counters() == {}
     assert telemetry.gauges() == {}
+    assert telemetry.histograms() == {}
     # An op bracketing a fully-disabled window summarizes to None.
     rec = telemetry.begin_op("take", rank=0)
     assert rec.finish() is None
@@ -118,6 +120,94 @@ def test_disabled_rates_still_feed_governor():
     telemetry.record_rate("write", "LintTestPlugin", 10_000_000, 0.01)
     assert io_governor().write_bps("LintTestPlugin") == pytest.approx(1e9)
     assert telemetry.events() == []  # but nothing was recorded
+
+
+# -------------------------------------------------------------- histograms
+
+
+def test_histogram_log2_bucketing():
+    """Observations land in the smallest power-of-two upper bound >=
+    the value; sub-1µs values collapse into bucket 0 and huge values
+    into the +Inf overflow slot."""
+    from torchsnapshot_tpu.telemetry.core import HISTOGRAM_BOUNDS
+
+    telemetry.set_enabled(True)
+    telemetry.histogram_observe("write.entry_s", 0.0)        # floor
+    telemetry.histogram_observe("write.entry_s", 1e-9)       # floor
+    telemetry.histogram_observe("write.entry_s", 0.05)       # le=0.0625
+    telemetry.histogram_observe("write.entry_s", 0.0625)     # le=0.0625 (==)
+    telemetry.histogram_observe("write.entry_s", 0.07)       # le=0.125
+    telemetry.histogram_observe("write.entry_s", 1e9)        # +Inf overflow
+    hist = telemetry.histograms()["write.entry_s"][""]
+    counts = hist["counts"]
+    assert hist["count"] == 6
+    assert counts[0] == 2
+    assert counts[HISTOGRAM_BOUNDS.index(0.0625)] == 2
+    assert counts[HISTOGRAM_BOUNDS.index(0.125)] == 1
+    assert counts[len(HISTOGRAM_BOUNDS)] == 1  # the overflow slot
+    assert hist["sum"] == pytest.approx(0.0625 + 0.05 + 0.07 + 1e9)
+
+
+def test_histogram_keys_are_separate_series():
+    telemetry.set_enabled(True)
+    telemetry.histogram_observe("storage.op_s", 0.01, key="S3.put")
+    telemetry.histogram_observe("storage.op_s", 0.02, key="S3.get_range")
+    by_key = telemetry.histograms()["storage.op_s"]
+    assert set(by_key) == {"S3.put", "S3.get_range"}
+    assert by_key["S3.put"]["count"] == 1
+
+
+def test_histogram_quantile_approximation():
+    telemetry.set_enabled(True)
+    for _ in range(9):
+        telemetry.histogram_observe("write.entry_s", 0.01)
+    telemetry.histogram_observe("write.entry_s", 1.5)
+    hist = telemetry.histograms()["write.entry_s"][""]
+    # p50 lands in 0.01's bucket (le=0.015625); p99 in the tail's.
+    assert telemetry.histogram_quantile(hist, 0.5) == pytest.approx(0.015625)
+    assert telemetry.histogram_quantile(hist, 0.99) == pytest.approx(2.0)
+    assert telemetry.histogram_quantile({"count": 0, "counts": []}, 0.5) is None
+
+
+def test_op_recorder_histogram_deltas():
+    """A summary reports only the histograms observed DURING the op —
+    the previous op's tail must not leak in — while the process-level
+    view keeps accumulating."""
+    telemetry.set_enabled(True)
+    telemetry.histogram_observe("write.entry_s", 0.01, key="FS")
+    rec = telemetry.begin_op("take", rank=0)
+    telemetry.histogram_observe("write.entry_s", 0.02, key="FS")
+    telemetry.histogram_observe("read.entry_s", 0.03, key="FS")
+    summary = rec.finish()
+    hist = summary["histograms"]
+    assert hist["write.entry_s"]["FS"]["count"] == 1  # not 2
+    assert hist["read.entry_s"]["FS"]["count"] == 1
+    assert telemetry.histograms()["write.entry_s"]["FS"]["count"] == 2
+    # An op with no observations elides the key entirely.
+    rec = telemetry.begin_op("take", rank=0)
+    assert "histograms" not in rec.finish()
+
+
+def test_histogram_thread_safety_no_lost_updates():
+    import threading
+
+    telemetry.set_enabled(True)
+    n, threads = 2000, 8
+
+    def pound():
+        for i in range(n):
+            telemetry.histogram_observe(
+                "collective.wait_s", 1e-6 * (i % 7 + 1), key="barrier"
+            )
+
+    ts = [threading.Thread(target=pound) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    hist = telemetry.histograms()["collective.wait_s"]["barrier"]
+    assert hist["count"] == n * threads
+    assert sum(hist["counts"]) == n * threads
 
 
 # ------------------------------------------------------------ counters/ops

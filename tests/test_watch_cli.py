@@ -65,6 +65,22 @@ def test_render_fleet_empty():
     assert "no in-flight" in health.render_fleet({}, {}, 5.0)
 
 
+def test_render_fleet_shows_binding_resource():
+    """ISSUE 8 satellite: a heartbeat carrying the binding-resource
+    hint renders it, so a STALLED row says WHAT the rank is stuck on;
+    ranks without one show a placeholder."""
+    fleet = {
+        0: {"op": "take", "phase": "stage", "written_bytes": 1 << 20,
+            "seq": 3, "wall_s": 2.0, "binding": "storage_write"},
+        1: {"op": "take", "phase": "begin", "seq": 2, "wall_s": 2.1},
+    }
+    out = health.render_fleet(fleet, {0: 9.0, 1: 0.1}, stall_s=5.0)
+    assert "bound on" in out  # the column header
+    assert "storage_write" in out
+    stalled_row = [ln for ln in out.splitlines() if "STALLED" in ln][0]
+    assert "storage_write" in stalled_row
+
+
 def test_publisher_noop_without_store():
     class _PG:
         pg = None

@@ -36,6 +36,12 @@ on-call asks, so they get first-class commands here:
   checkpoint history journal of a ROOT directory and exits non-zero on
   a p50 regression; ``--openmetrics`` emits the summary in OpenMetrics
   text format for scrape pipelines.
+- ``explain``  — critical-path attribution of a take/restore
+  (telemetry/critpath.py): which resource (staging copy, hash, storage
+  write/read, decode, collective wait) bound the wall clock, on which
+  rank, at what measured rate, and what to tune. Exit code 1 means
+  storage-bound, 0 pipeline-bound — benches assert the ROADMAP claim
+  with it.
 - ``blackbox`` — merge the per-rank flight-recorder dumps an aborted
   operation left under ``<snapshot>/.flight/`` into one causal
   cross-rank timeline: who deserted whom at which barrier, store
@@ -565,7 +571,11 @@ def _fsck_orphan_scan(
             if origin is None:
                 referenced.add(os.path.normpath(location))
 
-    internal_files = {SNAPSHOT_METADATA_FNAME, ".snapshot_telemetry"}
+    internal_files = {
+        SNAPSHOT_METADATA_FNAME,
+        ".snapshot_telemetry",
+        ".snapshot_critpath",
+    }
     internal_prefixes = (".telemetry", ".fsck_quarantine", ".flight")
     for dirpath, dirnames, filenames in os.walk(local_dir):
         rel_dir = os.path.relpath(dirpath, local_dir)
@@ -1139,6 +1149,86 @@ def cmd_stats(args: argparse.Namespace) -> int:
     return 0
 
 
+def _read_snapshot_json(
+    path: str, fname: str
+) -> Tuple[Optional[Dict[str, Any]], Optional[BaseException]]:
+    """Load one JSON control file from a snapshot over its storage
+    plugin (any backend). Returns ``(doc, None)`` on success,
+    ``(None, None)`` when the file simply is not there (or is not a
+    JSON object), and ``(None, error)`` on a TRANSPORT/auth/parse
+    failure — callers must surface the latter instead of folding it
+    into "not recorded" (the cmd_stats lesson: a genuine backend error
+    disguised as a telemetry hint sends the on-call the wrong way)."""
+    import json
+
+    from .storage_plugins.retry import is_not_found_error
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin_in_event_loop(path, event_loop, None)
+        try:
+            read_io = ReadIO(path=fname)
+            event_loop.run_until_complete(storage.read(read_io))
+            doc = json.loads(bytes(read_io.buf).decode("utf-8"))
+            return (doc, None) if isinstance(doc, dict) else (None, None)
+        finally:
+            storage.sync_close(event_loop)
+    except Exception as e:  # noqa: BLE001
+        if is_not_found_error(e):
+            return None, None
+        return None, e
+    finally:
+        event_loop.close()
+
+
+def cmd_explain(args: argparse.Namespace) -> int:
+    """Render a take/restore's critical-path attribution: the chain of
+    per-rank segments that gated commit, the binding resource with its
+    achieved rate (cross-checked against the governor's measured rates),
+    the straggler delta, and a tuning hint (telemetry/critpath.py).
+
+    Exit codes: 0 pipeline/coordination-bound, 1 STORAGE-bound, 2 no
+    attribution available — so a bench can assert the ROADMAP
+    "pipeline-bound" claim with one subprocess call."""
+    import json
+
+    from .telemetry import TELEMETRY_SUMMARY_FNAME, critpath
+
+    doc, err = _read_snapshot_json(args.path, critpath.ATTRIBUTION_FNAME)
+    if doc is None or not doc.get("fleet"):
+        # Fallback: re-derive from the telemetry summary document's
+        # per-rank attribution blobs (older takes, or a rank-0 persist
+        # failure that still landed the summary).
+        tel, tel_err = _read_snapshot_json(args.path, TELEMETRY_SUMMARY_FNAME)
+        err = err or tel_err
+        doc = critpath.derive_document_from_telemetry(tel) if tel else None
+    if doc is None or not doc.get("fleet"):
+        if err is not None:
+            # A transport/auth/corruption failure is NOT "telemetry was
+            # off" — surface the real error so the on-call fixes the
+            # backend instead of re-running a save.
+            print(
+                f"error: cannot read attribution records at {args.path} "
+                f"({type(err).__name__}: {err})",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            f"error: no critical-path attribution at {args.path} (expected "
+            f"{critpath.ATTRIBUTION_FNAME} next to .snapshot_metadata). "
+            "Attribution is recorded when the take/restore ran with "
+            "TORCHSNAPSHOT_TPU_TELEMETRY=1.",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(critpath.render_attribution(doc, verbose=args.verbose))
+    return critpath.binding_exit_code(doc)
+
+
 def cmd_consolidate(args: argparse.Namespace) -> int:
     from .dedup import consolidate
 
@@ -1359,6 +1449,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--openmetrics", action="store_true",
                    help="emit the summary in OpenMetrics text format")
     p.set_defaults(fn=cmd_stats)
+
+    p = sub.add_parser(
+        "explain",
+        help="critical-path attribution of a take/restore: binding "
+             "resource + measured rate, per-segment critical path, "
+             "straggler delta, tuning hint (exit 0 pipeline-bound / "
+             "1 storage-bound / 2 no attribution)",
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw attribution document")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="include the governor's recorded elections")
+    p.set_defaults(fn=cmd_explain)
 
     p = sub.add_parser(
         "blackbox",

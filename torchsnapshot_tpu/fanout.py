@@ -387,12 +387,21 @@ class CoopRestoreSession:
             return _Offer(None, None)
         mode = coop_restore_mode()
         opt_in = False
+        read_bps = None
         if mode == "always":
             opt_in = True
         elif mode == "auto":
             from .scheduler import io_governor
 
             opt_in = io_governor().should_coop_restore(plugin_name)
+            read_bps = io_governor().read_bps(plugin_name)
+        telemetry.record_election(
+            site="coop_restore",
+            plugin=plugin_name,
+            mode=mode,
+            opt_in=opt_in,
+            read_bps=read_bps,
+        )
         if not opt_in:
             return _Offer(None, None)
         ip = cls._local_ip(pg_wrapper)

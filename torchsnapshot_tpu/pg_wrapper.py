@@ -46,6 +46,7 @@ import uuid
 import zlib
 from typing import Any, Dict, List, Optional
 
+from . import telemetry
 from .dist_store import DEATH_KEY, TCPStore, create_store
 from .telemetry import flightrec
 
@@ -330,14 +331,30 @@ class PGWrapper:
         flightrec.record(
             "collective.enter", kind=kind, ns=ns, cseq=seq, deadline_s=timeout
         )
+        # With the bus on, the collective ALSO records a ``collective_wait``
+        # span (cat="collective", carrying the same (ns, cseq) causal key)
+        # — the segment boundary the critical-path attribution engine
+        # stitches ranks on — and a wait-time histogram sample per verb.
+        # With it off (the default) both are one flag check.
+        t0 = telemetry.monotonic() if telemetry.enabled() else None
+        span = telemetry.span(
+            "collective_wait", cat="collective", kind=kind, ns=ns, cseq=seq
+        )
+        span.__enter__()
         try:
             yield
         except BaseException as e:  # noqa: B036
+            span.__exit__(None, None, None)
             flightrec.record(
                 "collective.exit", kind=kind, ns=ns, cseq=seq, ok=False,
                 error=repr(e),
             )
             raise
+        span.__exit__(None, None, None)
+        if t0 is not None:
+            telemetry.histogram_observe(
+                "collective.wait_s", telemetry.monotonic() - t0, key=kind
+            )
         flightrec.record("collective.exit", kind=kind, ns=ns, cseq=seq, ok=True)
 
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:

@@ -483,6 +483,35 @@ class _WritePipeline:
             telemetry.counter_add("bytes_staged", self.buf_size_bytes)
         return self
 
+    @staticmethod
+    async def _timed_write_chunks(chunks, plugin_key: str):
+        """Per-sub-chunk latency sampler on the streamed write path: the
+        time from requesting a sub-chunk to handing it to the plugin is
+        one pipeline step (stage of N+1 overlapping write of N), exactly
+        the distribution a stall diagnosis needs — a p99 spike here with
+        a flat p50 is the signature of periodic reclaim/throttle stalls
+        that averages hide. Installed only while telemetry is enabled."""
+        try:
+            while True:
+                t0 = telemetry.monotonic()
+                try:
+                    chunk = await chunks.__anext__()
+                except StopAsyncIteration:
+                    return
+                telemetry.histogram_observe(
+                    "write.sub_chunk_s",
+                    telemetry.monotonic() - t0,
+                    key=plugin_key,
+                )
+                yield chunk
+        finally:
+            # stream_write's cleanup acloses THIS wrapper; the inner
+            # stager stream must unwind with it (pooled staging buffers
+            # are released in its finally blocks).
+            aclose = getattr(chunks, "aclose", None)
+            if aclose is not None:
+                await aclose()
+
     async def stream_write(
         self, storage: StoragePlugin, executor
     ) -> "_WritePipeline":
@@ -494,6 +523,8 @@ class _WritePipeline:
         so it never enters ready_for_io."""
         stager = self.write_req.buffer_stager
         chunks = stager.stage_stream(executor, self.sub_chunk_bytes)
+        if telemetry.enabled():
+            chunks = self._timed_write_chunks(chunks, type(storage).__name__)
         try:
             with telemetry.span(
                 "stream_write",
@@ -519,10 +550,17 @@ class _WritePipeline:
 
     async def write_buffer(self, storage: StoragePlugin) -> "_WritePipeline":
         assert self.buf is not None
+        t0 = telemetry.monotonic() if telemetry.enabled() else None
         with telemetry.span(
             "storage_write", path=self.write_req.path, bytes=self.buf_size_bytes
         ):
             await storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        if t0 is not None:
+            telemetry.histogram_observe(
+                "write.entry_s",
+                telemetry.monotonic() - t0,
+                key=type(storage).__name__,
+            )
         self.buf = None  # release the staged buffer eagerly
         return self
 
@@ -583,6 +621,10 @@ class _ProgressReporter:
         except Exception:  # pragma: no cover
             self._rss_begin = 0
         self._task: Optional[asyncio.Task] = None
+        # Live binding-resource hint (critpath.live_binding over the bus
+        # events recorded since the last tick) — fed into the heartbeat
+        # so `watch` shows WHAT a straggler is stuck on.
+        self._binding_since_id = 0
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -655,7 +697,31 @@ class _ProgressReporter:
         else:
             fields["staged_bytes"] = self.staged_bytes
             fields["written_bytes"] = self.completed_bytes
+        binding = self._live_binding(is_read)
+        if binding is not None:
+            fields["binding"] = binding
         telemetry.health.update(**fields)
+
+    def _live_binding(self, is_read: bool) -> Optional[str]:
+        """What this rank is currently bound on, for the heartbeat.
+        With the bus on, the attribution engine's window estimate over
+        the spans since the last tick; with it off, a coarse queue-shape
+        heuristic — a straggler's `watch` row should say "storage_write",
+        not just "stalled"."""
+        if telemetry.enabled():
+            from .telemetry import critpath
+
+            evs = telemetry.events(since_id=self._binding_since_id)
+            if evs:
+                self._binding_since_id = max(e.get("id", 0) for e in evs)
+                binding = critpath.live_binding(evs)
+                if binding is not None:
+                    return binding
+        if self.inflight_io > 0 and self.inflight_staging == 0:
+            return "storage_read" if is_read else "storage_write"
+        if self.inflight_staging > 0 and self.inflight_io == 0:
+            return "stage_copy" if not is_read else None
+        return None
 
 
 class _Throughput:
@@ -858,6 +924,19 @@ async def execute_write_reqs(
             len(ready_for_staging),
             (sub_chunk or 0) >> 20,
         )
+    # Record the governor's write-path election (what was chosen and the
+    # rates it saw): the flight recorder carries the always-on copy for
+    # abort dumps/`blackbox`, the bus instant rides the per-op summary
+    # for `explain`.
+    telemetry.record_election(
+        site="write",
+        plugin=plugin_key,
+        streaming=sub_chunk is not None,
+        streamed_entries=n_streamed,
+        sub_chunk_bytes=sub_chunk,
+        io_concurrency=io_concurrency,
+        write_bps=governor.write_bps(plugin_key),
+    )
     staging_tasks: Set[asyncio.Task] = set()
     io_tasks: Set[asyncio.Task] = set()
     ready_for_io: List[_WritePipeline] = []
@@ -1120,12 +1199,20 @@ class _ReadPipeline:
         source = role.stream()
 
         async def counted():
+            observe = telemetry.enabled()
             while True:
+                t0 = telemetry.monotonic() if observe else None
                 with telemetry.span("peer_recv", cat="fanout"):
                     try:
                         chunk = await source.__anext__()
                     except StopAsyncIteration:
                         return
+                if t0 is not None:
+                    telemetry.histogram_observe(
+                        "read.sub_chunk_s",
+                        telemetry.monotonic() - t0,
+                        key="peer",
+                    )
                 n = memoryview(chunk).nbytes
                 throughput.add(n)
                 telemetry.counter_add("bytes_read", n)
@@ -1271,10 +1358,24 @@ class _ReadPipeline:
         send = role if (role is not None and role.is_send) else None
         sent = {"n": 0, "bytes": 0}
 
+        plugin_key = type(storage).__name__
+
         async def counted(chunks):
             pending_send = None
+            observe = telemetry.enabled()
             try:
-                async for chunk in chunks:
+                while True:
+                    t0 = telemetry.monotonic() if observe else None
+                    try:
+                        chunk = await chunks.__anext__()
+                    except StopAsyncIteration:
+                        break
+                    if t0 is not None:
+                        telemetry.histogram_observe(
+                            "read.sub_chunk_s",
+                            telemetry.monotonic() - t0,
+                            key=plugin_key,
+                        )
                     n = memoryview(chunk).nbytes
                     throughput.add(n)
                     telemetry.counter_add("bytes_read", n)
@@ -1400,9 +1501,16 @@ class _ReadPipeline:
             # empty Range headers (S3 ignores them, GCS returns 416).
             read_io.buf = bytearray()
         else:
+            t0 = telemetry.monotonic() if telemetry.enabled() else None
             with telemetry.span("storage_read", path=self.read_req.path) as sp:
                 await storage.read(read_io)
                 sp.set(bytes=memoryview(read_io.buf).nbytes)
+            if t0 is not None:
+                telemetry.histogram_observe(
+                    "read.entry_s",
+                    telemetry.monotonic() - t0,
+                    key=type(storage).__name__,
+                )
         buf = read_io.buf
         throughput.add(len(buf))
         telemetry.counter_add("bytes_read", len(buf))
@@ -1487,6 +1595,18 @@ async def execute_read_reqs(
     inflight: Set[asyncio.Task] = set()
     inflight_recv = 0
     io_concurrency = governor.io_concurrency("read", plugin_key)
+    telemetry.record_election(
+        site="read",
+        plugin=plugin_key,
+        mode=mode,
+        streaming=sub_chunk is not None,
+        streamed_entries=n_streamed,
+        stream_all=stream_all,
+        sub_chunk_bytes=sub_chunk,
+        io_concurrency=io_concurrency,
+        coop=coop is not None,
+        read_bps=read_bps,
+    )
     if coop is not None:
         fallback_gate = asyncio.Semaphore(io_concurrency)
         for p in pending:

@@ -256,6 +256,9 @@ class Snapshot:
             "op.begin", op="take", rank=pg_wrapper.get_rank(), path=path
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
+        # Live /metrics endpoint (TORCHSNAPSHOT_TPU_METRICS_PORT): armed
+        # once per process at the first op; a no-op with the env unset.
+        telemetry.promexp.maybe_start(rank=pg_wrapper.get_rank())
         body_ok = False
         try:
             # Synchronous take blocks the caller until I/O drains, so staged
@@ -400,6 +403,7 @@ class Snapshot:
             "op.begin", op="take", rank=pg_wrapper.get_rank(), path=path
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
+        telemetry.promexp.maybe_start(rank=pg_wrapper.get_rank())
         try:
             pending_io_work, metadata = cls._take_impl(
                 path=path,
@@ -820,6 +824,7 @@ class Snapshot:
             "op.begin", op="restore", rank=rank, path=self.path
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "restore", self.path)
+        telemetry.promexp.maybe_start(rank=rank)
         coop_session = None
         try:
             metadata = self._read_metadata(storage, event_loop)
@@ -1217,6 +1222,13 @@ class Snapshot:
         # measured against some other plugin earlier in the process must
         # not decide for this one.
         decision = governor.should_preverify(type(storage).__name__)
+        telemetry.record_election(
+            site="preverify",
+            plugin=type(storage).__name__,
+            decision=decision,
+            hash_bps=governor.hash_bps(),
+            read_bps=governor.read_bps(type(storage).__name__),
+        )
         if not decision:
             logger.info(
                 "distributed digest verification skipped: measured read "
@@ -1735,6 +1747,21 @@ class Snapshot:
         except Exception:
             logger.exception("telemetry summary failed; continuing without it")
             summary = None
+        if summary is not None:
+            try:
+                # Per-rank critical-path attribution (telemetry/critpath):
+                # built from this op's span events (served from the
+                # recorder's post-finish cache), gathered with the summary
+                # so rank 0 can stitch the cross-rank critical path.
+                summary["attribution"] = telemetry.critpath.build_attribution(
+                    recorder.events(),
+                    wall_s=summary.get("wall_s"),
+                    rank=summary.get("rank", 0),
+                )
+            except Exception:
+                logger.exception(
+                    "critical-path attribution failed; continuing without it"
+                )
         world_size = pg_wrapper.get_world_size()
         try:
             # The gather can only fail for store-level reasons (connection
@@ -1748,12 +1775,31 @@ class Snapshot:
                 gathered = [summary]
             fleet = telemetry.merge_summaries(gathered)
             telemetry.set_last_fleet(fleet)
+            attribution = None
+            try:
+                attribution = telemetry.critpath.merge_attributions(
+                    [
+                        (s or {}).get("attribution")
+                        if isinstance(s, dict)
+                        else None
+                        for s in gathered
+                    ],
+                    aggregate=(fleet or {}).get("aggregate"),
+                )
+                telemetry.set_last_attribution(attribution)
+            except Exception:
+                logger.exception(
+                    "critical-path merge failed; continuing without it"
+                )
             if persist and path is not None and pg_wrapper.get_rank() == 0:
                 # History works with the bus OFF too (fleet None): wall
                 # time and identity always record; counters/rates ride
                 # along when telemetry contributed a fleet view. rank 0
                 # only; crash-safe append (telemetry/history.py).
-                cls._append_history(op, path, timer, pg_wrapper, fleet, summary)
+                cls._append_history(
+                    op, path, timer, pg_wrapper, fleet, summary,
+                    attribution=attribution,
+                )
             if fleet is None:
                 return  # telemetry off everywhere: zero residue
             agg = fleet.get("aggregate") or {}
@@ -1804,6 +1850,26 @@ class Snapshot:
                         )
                     )
                 )
+                if attribution is not None:
+                    # The compact per-take attribution record next to the
+                    # telemetry summary — what `explain <path>` reads.
+                    cp_doc = telemetry.critpath.build_attribution_document(
+                        op,
+                        world_size,
+                        attribution,
+                        rates=(summary or {}).get("rates"),
+                        governor=(summary or {}).get("governor"),
+                    )
+                    event_loop.run_until_complete(
+                        storage.write(
+                            WriteIO(
+                                path=telemetry.critpath.ATTRIBUTION_FNAME,
+                                buf=json.dumps(cp_doc, indent=1).encode(
+                                    "utf-8"
+                                ),
+                            )
+                        )
+                    )
         except Exception:
             logger.exception(
                 "telemetry persistence failed; the snapshot is unaffected"
@@ -1817,6 +1883,7 @@ class Snapshot:
         pg_wrapper: PGWrapper,
         fleet: Optional[Dict[str, Any]],
         summary: Optional[Dict[str, Any]],
+        attribution: Optional[Dict[str, Any]] = None,
     ) -> None:
         """Append this committed take to ``<parent>/.telemetry_history
         .jsonl`` (local roots only; guarded — history must never fail a
@@ -1840,6 +1907,7 @@ class Snapshot:
                 fleet=fleet,
                 rank_summary=summary,
                 step=step,
+                attribution=attribution,
             )
             telemetry.history.append_record(root, record)
         except Exception:  # noqa: BLE001

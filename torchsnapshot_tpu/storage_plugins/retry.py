@@ -57,6 +57,21 @@ def named(fn: Callable[[], Any], op: str) -> Callable[[], Any]:
         return call
 
 
+def observe_storage_op(plugin: str, op: Optional[str], seconds: float) -> None:
+    """Record one storage operation's latency into the shared
+    ``storage.op_s`` histogram, labeled ``<Plugin>.<op>`` — called by
+    the plugins' ``_retrying`` wrappers on every SUCCESSFUL attempt, so
+    the distribution covers puts, per-part uploads, and ranged gets
+    individually (the scalar rate meters only see whole-pipeline
+    averages; a long tail here with a healthy mean is the throttling
+    signature). One flag check when telemetry is disabled."""
+    if not telemetry.enabled():
+        return
+    telemetry.histogram_observe(
+        "storage.op_s", seconds, key=f"{plugin}.{op}" if op else plugin
+    )
+
+
 def is_transient_error(exc: BaseException) -> bool:
     """Classify transport errors worth retrying: 429/5xx-style service
     hiccups, connection and timeout failures. Everything else (permission

@@ -33,6 +33,7 @@ from .retry import (
     cloud_io_executor,
     is_transient_error,
     named,
+    observe_storage_op,
     ordered_window_chunks,
 )
 
@@ -97,6 +98,9 @@ class S3StoragePlugin(StoragePlugin):
             try:
                 result = await loop.run_in_executor(cloud_io_executor(), fn)
                 self.retry_strategy.report_progress()
+                observe_storage_op(
+                    type(self).__name__, op, telemetry.monotonic() - started
+                )
                 return result
             except BaseException as e:  # noqa: B036
                 if not is_transient_error(e):

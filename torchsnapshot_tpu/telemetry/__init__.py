@@ -15,6 +15,7 @@ Typical programmatic use::
 """
 
 from .core import (  # noqa: F401
+    HISTOGRAM_BOUNDS,
     TELEMETRY_ENV_VAR,
     OpRecorder,
     Span,
@@ -28,6 +29,10 @@ from .core import (  # noqa: F401
     events,
     gauge_set,
     gauges,
+    histogram_observe,
+    histogram_quantile,
+    histograms,
+    last_attribution,
     last_fleet,
     last_summary,
     monotonic,
@@ -36,6 +41,7 @@ from .core import (  # noqa: F401
     register_rate_listener,
     reset,
     set_enabled,
+    set_last_attribution,
     set_last_fleet,
     span,
 )
@@ -51,7 +57,7 @@ from .export import (  # noqa: F401
     trace_path_for_rank,
     write_chrome_trace,
 )
-from .aggregate import merge_summaries  # noqa: F401
+from .aggregate import merge_histograms, merge_summaries  # noqa: F401
 # The always-on observability planes (ISSUE 7): the flight recorder
 # (bounded ring + abort dumps + blackbox merge, event registry in
 # taxonomy.py), the live health plane (heartbeats over the coordination
@@ -62,3 +68,20 @@ from .aggregate import merge_summaries  # noqa: F401
 # ``events``) so it can never shadow the ``events()`` scrape function
 # exported from core above.
 from . import flightrec, health, history, taxonomy  # noqa: F401, E402
+# The performance-attribution plane (ISSUE 8): critpath reconstructs the
+# cross-rank critical path of a take/restore and names the binding
+# resource (the `explain` CLI's engine); promexp serves the live
+# OpenMetrics endpoint (TORCHSNAPSHOT_TPU_METRICS_PORT). Namespaced like
+# the other planes (critpath.build_attribution, promexp.maybe_start).
+from . import critpath, promexp  # noqa: F401, E402
+
+
+def record_election(**fields) -> None:
+    """Record one IOGovernor election on BOTH planes: the always-on
+    flight recorder (so ``blackbox`` shows what the governor chose
+    before an abort) and, bus permitting, a ``cat="governor"`` instant
+    the OpRecorder folds into ``summary["governor"]`` (what ``explain
+    -v``/``stats -v`` render and ``.snapshot_critpath`` persists).
+    One helper so an election site can never wire half the pair."""
+    flightrec.record("governor.elect", **fields)
+    event("governor_elect", cat="governor", **fields)

@@ -42,6 +42,35 @@ _SUMMED_COUNTERS = (
 )
 
 
+def merge_histograms(
+    rank_summaries: List[Optional[Dict[str, Any]]]
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Bucket-wise sum of every rank's latency histograms.
+
+    Histograms share the fixed log2 ladder (core.HISTOGRAM_BOUNDS), so
+    the merge is element-wise addition per ``(name, key)`` family —
+    short/long counts lists (version skew) are padded, never dropped."""
+    merged: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for summary in rank_summaries:
+        if not isinstance(summary, dict):
+            continue
+        for name, by_key in (summary.get("histograms") or {}).items():
+            for key, hist in by_key.items():
+                counts = list(hist.get("counts") or [])
+                tgt = merged.setdefault(name, {}).setdefault(
+                    key, {"counts": [], "count": 0, "sum": 0.0}
+                )
+                if len(tgt["counts"]) < len(counts):
+                    tgt["counts"].extend(
+                        [0] * (len(counts) - len(tgt["counts"]))
+                    )
+                for i, n in enumerate(counts):
+                    tgt["counts"][i] += n
+                tgt["count"] += hist.get("count") or 0
+                tgt["sum"] = round(tgt["sum"] + (hist.get("sum") or 0.0), 6)
+    return merged
+
+
 def merge_summaries(
     rank_summaries: List[Optional[Dict[str, Any]]]
 ) -> Optional[Dict[str, Any]]:
@@ -70,6 +99,7 @@ def merge_summaries(
         aggregate["write_gbps"] = aggregate["bytes_written"] / wall_max / 1e9
     if aggregate.get("bytes_read") and wall_max > 0:
         aggregate["read_gbps"] = aggregate["bytes_read"] / wall_max / 1e9
+    histograms = merge_histograms([s for _, s in present])
     return {
         "world_size": len(rank_summaries),
         "reporting": len(present),
@@ -80,4 +110,5 @@ def merge_summaries(
         "slowest_rank": slowest,
         "fastest_rank": fastest,
         "aggregate": aggregate,
+        **({"histograms": histograms} if histograms else {}),
     }

@@ -35,6 +35,7 @@ Design constraints, in priority order:
 from __future__ import annotations
 
 import contextvars
+import math
 import os
 import threading
 import time
@@ -273,6 +274,139 @@ def gauge_set(name: str, value: float) -> None:
         _sample_locked(name, "gauge", value)
 
 
+# -------------------------------------------------------------- histograms
+
+# Fixed log2 bucket ladder for every latency histogram: upper bounds
+# 2^-20 s (~1 µs) .. 2^7 s (128 s), one bucket per power of two, plus the
+# implicit +Inf overflow. Fixed (not per-instrument) so fleet merges,
+# the OpenMetrics exposition, and cross-take comparisons are always
+# bucket-compatible — adaptive buckets cannot be summed across ranks.
+_HIST_LOW_EXP = -20
+_HIST_HIGH_EXP = 7
+HISTOGRAM_BOUNDS: Tuple[float, ...] = tuple(
+    2.0 ** k for k in range(_HIST_LOW_EXP, _HIST_HIGH_EXP + 1)
+)
+_N_BUCKETS = len(HISTOGRAM_BOUNDS) + 1  # + the +Inf overflow bucket
+
+
+def _bucket_index(seconds: float) -> int:
+    """Index of the smallest bound >= ``seconds`` (log2 ladder), or the
+    overflow slot. ``math.frexp`` gives seconds = m * 2^e with m in
+    [0.5, 1): seconds <= 2^e always, and seconds <= 2^(e-1) exactly when
+    m == 0.5 — two float ops, no log() call on the hot path."""
+    if seconds <= HISTOGRAM_BOUNDS[0]:
+        return 0
+    m, e = math.frexp(seconds)
+    idx = e - _HIST_LOW_EXP - (1 if m == 0.5 else 0)
+    return idx if idx < _N_BUCKETS else _N_BUCKETS - 1
+
+
+class _Histogram:
+    __slots__ = ("counts", "count", "sum")
+
+    def __init__(self) -> None:
+        self.counts = [0] * _N_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum": round(self.sum, 6),
+        }
+
+
+# {name: {key or "": _Histogram}} — ``name`` must be registered in
+# taxonomy.HISTOGRAM_NAMES (lint-pinned, like the flight-event registry);
+# ``key`` is the free-form label (storage plugin class, collective verb).
+_histograms: Dict[str, Dict[str, _Histogram]] = {}
+
+
+def histogram_observe(name: str, seconds: float, key: Optional[str] = None) -> None:
+    """Record one latency observation into the fixed log2-bucket
+    histogram ``name`` (labeled by ``key``). One flag check when
+    telemetry is disabled; enabled cost is the bucket math plus one
+    uncontended lock round — cheap enough for per-sub-chunk call sites,
+    and unlike counters it records NO per-observation trace event.
+
+    ``name`` must be a literal registered in
+    ``taxonomy.HISTOGRAM_NAMES`` (scripts/check_event_taxonomy.py
+    enforces it)."""
+    if not _enabled:
+        return
+    idx = _bucket_index(seconds)
+    with _lock:
+        by_key = _histograms.get(name)
+        if by_key is None:
+            by_key = _histograms[name] = {}
+        hist = by_key.get(key or "")
+        if hist is None:
+            hist = by_key[key or ""] = _Histogram()
+        hist.counts[idx] += 1
+        hist.count += 1
+        hist.sum += seconds
+
+
+def histograms() -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """A JSON-able snapshot of every histogram:
+    ``{name: {key: {"counts": [...], "count": n, "sum": s}}}`` with
+    counts parallel to :data:`HISTOGRAM_BOUNDS` plus a final +Inf slot."""
+    with _lock:
+        return {
+            name: {key: h.as_dict() for key, h in by_key.items()}
+            for name, by_key in _histograms.items()
+        }
+
+
+def histogram_quantile(hist: Dict[str, Any], q: float) -> Optional[float]:
+    """Approximate quantile from a histogram dict (bucket upper bound at
+    rank ceil(q*count)); None when empty. Good to a factor of 2 by
+    construction — the resolution the log2 ladder buys."""
+    count = hist.get("count") or 0
+    if count <= 0:
+        return None
+    target = max(1, math.ceil(q * count))
+    running = 0
+    for i, n in enumerate(hist.get("counts") or []):
+        running += n
+        if running >= target:
+            return (
+                HISTOGRAM_BOUNDS[i]
+                if i < len(HISTOGRAM_BOUNDS)
+                else HISTOGRAM_BOUNDS[-1] * 2
+            )
+    return HISTOGRAM_BOUNDS[-1] * 2
+
+
+def _histograms_delta(
+    since: Dict[str, Dict[str, Dict[str, Any]]]
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Histograms accumulated since a prior :func:`histograms` snapshot
+    (bucket-wise subtraction; empty deltas elided) — what an OpRecorder
+    reports so one op's summary never inherits the previous op's tail."""
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for name, by_key in histograms().items():
+        for key, hist in by_key.items():
+            base = (since.get(name) or {}).get(key)
+            if base is not None:
+                delta_count = hist["count"] - base["count"]
+                if delta_count <= 0:
+                    continue
+                counts = [
+                    n - b for n, b in zip(hist["counts"], base["counts"])
+                ]
+                hist = {
+                    "counts": counts,
+                    "count": delta_count,
+                    "sum": round(hist["sum"] - base["sum"], 6),
+                }
+            elif hist["count"] <= 0:
+                continue
+            out.setdefault(name, {})[key] = hist
+    return out
+
+
 # ------------------------------------------------------------------- rates
 
 # Rate observations (achieved storage/hash bandwidth) flow THROUGH the bus
@@ -349,6 +483,7 @@ def reset() -> None:
         _events.clear()
         _counters.clear()
         _gauges.clear()
+        _histograms.clear()
         _dropped = 0
 
 
@@ -387,6 +522,10 @@ class OpRecorder:
                 _events[:] = [e for e in _events if e["id"] > cutoff]
             self._event_mark = _next_id
             self._counters0 = dict(_counters)
+            self._hist0 = {
+                name: {key: h.as_dict() for key, h in by_key.items()}
+                for name, by_key in _histograms.items()
+            }
             self._dropped0 = _dropped
             self._annotations = dict(_pending_annotations)
             _pending_annotations.clear()
@@ -408,11 +547,18 @@ class OpRecorder:
         wall = monotonic() - self._t0
         spans: Dict[str, Dict[str, float]] = {}
         op_gauges: Dict[str, float] = {}
+        elections: List[Dict[str, Any]] = []
         for ev in evs:
             if ev["ph"] == "counter" and ev.get("cat") == "gauge":
                 # Only gauges SET during this op: a restore must not
                 # inherit the previous take's final queue depths.
                 op_gauges[ev["name"]] = ev.get("value", 0)
+            if ev["ph"] == "instant" and ev.get("cat") == "governor":
+                # IOGovernor elections recorded during this op ride the
+                # persisted summary, so `explain` can show what the
+                # governor chose and why (the flight recorder carries the
+                # always-on copy for abort dumps).
+                elections.append(dict(ev.get("args") or {}))
             if ev["ph"] != "span":
                 continue
             agg = spans.setdefault(
@@ -439,6 +585,11 @@ class OpRecorder:
             "gauges": op_gauges,
             "dropped_events": _dropped - self._dropped0,
         }
+        hist = _histograms_delta(self._hist0)
+        if hist:
+            summary["histograms"] = hist
+        if elections:
+            summary["governor"] = elections
         if self._annotations:
             summary["annotations"] = self._annotations
         if extra:
@@ -494,10 +645,12 @@ def annotate_next_op(**args: Any) -> None:
         _pending_annotations.update(args)
 
 
-# Last finished per-op summary / fleet view, for programmatic scraping
-# (bench.py embeds these; user code can poll after a take).
+# Last finished per-op summary / fleet view / critical-path attribution,
+# for programmatic scraping (bench.py embeds these; user code can poll
+# after a take).
 _last_summary: Optional[Dict[str, Any]] = None
 _last_fleet: Optional[Dict[str, Any]] = None
+_last_attribution: Optional[Dict[str, Any]] = None
 
 
 def _set_last_summary(summary: Dict[str, Any]) -> None:
@@ -510,6 +663,11 @@ def set_last_fleet(view: Optional[Dict[str, Any]]) -> None:
     _last_fleet = view
 
 
+def set_last_attribution(view: Optional[Dict[str, Any]]) -> None:
+    global _last_attribution
+    _last_attribution = view
+
+
 def last_summary() -> Optional[Dict[str, Any]]:
     """The most recent per-op summary finished in this process."""
     return _last_summary
@@ -518,3 +676,8 @@ def last_summary() -> Optional[Dict[str, Any]]:
 def last_fleet() -> Optional[Dict[str, Any]]:
     """The most recent cross-rank merged view (distributed ops only)."""
     return _last_fleet
+
+
+def last_attribution() -> Optional[Dict[str, Any]]:
+    """The most recent merged critical-path attribution (critpath.py)."""
+    return _last_attribution

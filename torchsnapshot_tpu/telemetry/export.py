@@ -246,9 +246,68 @@ def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
             )
         if verbose and summary.get("rates"):
             lines.append(f"  rates: {summary['rates']}")
+        if verbose:
+            for row in summary.get("governor") or []:
+                args = ", ".join(
+                    f"{k}={v}" for k, v in row.items() if k != "site"
+                )
+                lines.append(f"  governor[{row.get('site', '?')}]: {args}")
         if summary.get("dropped_events"):
             lines.append(f"  dropped_events: {summary['dropped_events']}")
+    hist = (fleet or {}).get("histograms") or {}
+    if hist:
+        lines.append("")
+        lines.append("latency histograms (fleet, bucket-wise sums):")
+        lines.extend(render_histogram_lines(hist))
     return "\n".join(lines)
+
+
+def render_histogram_lines(
+    histograms: Dict[str, Dict[str, Dict[str, Any]]]
+) -> List[str]:
+    """Human-readable one-liners for a histogram table (shared by the
+    ``stats`` fleet rendering and the ``explain`` CLI): approximate
+    p50/p95/max from the log2 buckets, labeled by family and key."""
+    from .core import HISTOGRAM_BOUNDS, histogram_quantile
+
+    lines: List[str] = []
+    for name in sorted(histograms):
+        for key in sorted(histograms[name]):
+            hist = histograms[name][key]
+            count = hist.get("count") or 0
+            if not count:
+                continue
+            p50 = histogram_quantile(hist, 0.5)
+            p95 = histogram_quantile(hist, 0.95)
+            counts = hist.get("counts") or []
+            top = None
+            for i in range(len(counts) - 1, -1, -1):
+                if counts[i]:
+                    top = (
+                        HISTOGRAM_BOUNDS[i]
+                        if i < len(HISTOGRAM_BOUNDS)
+                        else float("inf")
+                    )
+                    break
+            label = f"{name}[{key}]" if key else name
+            lines.append(
+                f"  {label}: n={count} p50<={_fmt_s(p50)} "
+                f"p95<={_fmt_s(p95)} max<={_fmt_s(top)} "
+                f"sum={_fmt_s(hist.get('sum'))}"
+            )
+    return lines
+
+
+def _fmt_s(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "?"
+    if seconds == float("inf"):
+        return "inf"
+    if seconds >= 1.0:
+        return f"{seconds:.3g}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.3g}ms"
+    return f"{seconds * 1e6:.3g}us"
 
 
 # -------------------------------------------------------------- openmetrics
@@ -263,6 +322,67 @@ def _om_escape(value: str) -> str:
         .replace('"', '\\"')
         .replace("\n", "\\n")
     )
+
+
+def om_family_name(name: str) -> str:
+    """Prefixed, spec-legal metric family name: every character outside
+    ``[a-zA-Z0-9_:]`` becomes ``_`` (histogram names like
+    ``write.sub_chunk_s`` carry dots)."""
+    safe = "".join(
+        c if (c.isascii() and (c.isalnum() or c in "_:")) else "_"
+        for c in name
+    )
+    return f"{_METRIC_PREFIX}_{safe}"
+
+
+def _om_label_str(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_om_escape(v)}"' for k, v in labels.items() if v is not None
+    )
+    return "{" + inner + "}" if inner else ""
+
+
+def om_histogram_lines(
+    name: str,
+    by_key: Dict[str, Dict[str, Any]],
+    extra_labels: Optional[Dict[str, Any]] = None,
+    help_text: Optional[str] = None,
+) -> List[str]:
+    """One OpenMetrics histogram family from a bus histogram snapshot
+    (``{key: {"counts": [...], "count": n, "sum": s}}``): cumulative
+    ``_bucket`` samples over the fixed log2 ladder, ``+Inf`` equal to
+    ``_count``, plus ``_count``/``_sum`` — the shape strict parsers
+    (prometheus_client) demand. Shared by ``stats --openmetrics`` and
+    the live /metrics exporter so the two can never drift."""
+    from .core import HISTOGRAM_BOUNDS
+
+    family = om_family_name(name)
+    lines = [f"# TYPE {family} histogram"]
+    if help_text:
+        lines.append(f"# HELP {family} {_om_escape(help_text)}")
+    for key in sorted(by_key):
+        hist = by_key[key]
+        labels = dict(extra_labels or {})
+        if key:
+            labels["key"] = key
+        cumulative = 0
+        counts = hist.get("counts") or []
+        for i, bound in enumerate(HISTOGRAM_BOUNDS):
+            cumulative += counts[i] if i < len(counts) else 0
+            bl = dict(labels)
+            bl["le"] = repr(bound)
+            lines.append(f"{family}_bucket{_om_label_str(bl)} {cumulative}")
+        bl = dict(labels)
+        bl["le"] = "+Inf"
+        total = hist.get("count") or 0
+        lines.append(f"{family}_bucket{_om_label_str(bl)} {total}")
+        lines.append(f"{family}_count{_om_label_str(labels)} {total}")
+        lines.append(
+            f"{family}_sum{_om_label_str(labels)} {hist.get('sum') or 0:g}"
+        )
+    return lines
 
 
 def render_openmetrics(doc: Dict[str, Any]) -> str:
@@ -314,5 +434,12 @@ def render_openmetrics(doc: Dict[str, Any]) -> str:
                 f'rank="{summary.get("rank", 0)}"}} '
                 f"{summary.get('wall_s', 0):g}"
             )
+    # Fleet latency histograms (bucket-wise sums across ranks) as real
+    # OpenMetrics histogram families — the distribution view the scalar
+    # counters above cannot carry.
+    for hname, by_key in sorted((fleet.get("histograms") or {}).items()):
+        lines.extend(
+            om_histogram_lines(hname, by_key, extra_labels={"op": op})
+        )
     lines.append("# EOF")
     return "\n".join(lines) + "\n"

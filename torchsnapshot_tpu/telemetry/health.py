@@ -291,7 +291,8 @@ def render_fleet(
     lines = []
     lines.append(
         f"{'rank':>4}  {'op':<8} {'phase':<14} {'staged':>10} {'written':>10} "
-        f"{'read':>10} {'total':>10} {'io':>3} {'eta':>7} {'wall':>8}  status"
+        f"{'read':>10} {'total':>10} {'io':>3} {'eta':>7} {'wall':>8}  "
+        f"{'bound on':<15} status"
     )
     walls = []
     for rank in sorted(fleet):
@@ -301,6 +302,10 @@ def render_fleet(
         status = f"STALLED {age:.0f}s" if stalled else "ok"
         eta = rec.get("eta_s")
         walls.append((rec.get("wall_s") or 0.0, rank))
+        # The binding-resource hint (scheduler reporter -> critpath
+        # live estimate): a STALLED row that also says "storage_write"
+        # tells the on-call WHAT the straggler is stuck on.
+        binding = rec.get("binding") or "-"
         lines.append(
             f"{rank:>4}  {str(rec.get('op', '?')):<8} "
             f"{str(rec.get('phase', '?')):<14} "
@@ -310,7 +315,7 @@ def render_fleet(
             f"{fmt_bytes(rec.get('total_bytes')):>10} "
             f"{rec.get('inflight_io', 0):>3} "
             f"{(str(eta) + 's') if eta is not None else '?':>7} "
-            f"{rec.get('wall_s', 0):>7.1f}s  {status}"
+            f"{rec.get('wall_s', 0):>7.1f}s  {str(binding):<15} {status}"
         )
     if len(walls) > 1:
         wall_max, slowest = max(walls)

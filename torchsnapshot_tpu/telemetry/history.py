@@ -71,6 +71,7 @@ def build_record(
     fleet: Optional[Dict[str, Any]],
     rank_summary: Optional[Dict[str, Any]] = None,
     step: Optional[int] = None,
+    attribution: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One compact history line from whatever the take measured.
 
@@ -96,6 +97,14 @@ def build_record(
     if fleet:
         rec["skew_s"] = fleet.get("skew_s")
         rec["slowest_rank"] = fleet.get("slowest_rank")
+    # Critical-path verdict (critpath.merge_attributions): the binding
+    # category per take, so the trend view can answer "when did saves
+    # become storage-bound?" without re-opening every snapshot.
+    binding = (attribution or {}).get("binding") or {}
+    if binding.get("category"):
+        rec["binding"] = binding["category"]
+        if binding.get("gbps") is not None:
+            rec["binding_gbps"] = binding["gbps"]
     # Overlap ratio: time the pipeline spent inside storage I/O spans
     # over the op wall — >1 means I/O genuinely overlapped with staging/
     # verify (the PR 1/3 streaming design working), <<1 means the op was
@@ -274,6 +283,8 @@ def render_trend(
             extras.append(f"{rec['fanout_fallbacks']:.0f} fanout fallback(s)")
         if rec.get("mirror_failovers"):
             extras.append(f"{rec['mirror_failovers']:.0f} mirror failover(s)")
+        if rec.get("binding"):
+            extras.append(f"bound: {rec['binding']}")
         lines.append(
             f"  {when}  {rec.get('snapshot', '?'):<16} "
             f"{rec.get('op', '?'):<5} {rec.get('wall_s', 0):>9.3f}s"

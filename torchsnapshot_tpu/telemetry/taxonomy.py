@@ -47,6 +47,11 @@ EVENTS: Dict[str, str] = {
     # commit protocol (snapshot.py)
     "fence.plant": "rank 0 planted the commit fence (gen)",
     "commit.decision": "fenced commit decision (gen, found, ok) — StaleCommitError when not ok",
+    # adaptive tuning (scheduler.IOGovernor consumers)
+    "governor.elect": "an IOGovernor election was made (site, decision fields, "
+    "measured rates at decision time) — recorded wherever the governor "
+    "picks streaming on/off, sub-chunk size, I/O concurrency, the "
+    "preverify gate, or cooperative restore",
     # cross-cutting
     "fault.trip": "a fault-injection rule fired (site, hit, action)",
     "preempt.signal": "a termination signal was observed (signum)",
@@ -54,3 +59,32 @@ EVENTS: Dict[str, str] = {
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
+
+# ------------------------------------------------------------- histograms
+#
+# The latency-histogram instrument (core.histogram_observe) is the same
+# kind of operator interface the flight-recorder events are: fleet merges
+# sum bucket-wise by NAME, the stats/explain renderings and the live
+# /metrics exporter expose families by NAME, and dashboards alert on
+# them. So the names are pinned here, and check_event_taxonomy.py
+# enforces that every ``histogram_observe(...)`` call in the package uses
+# a registered literal and that every registered name is wired somewhere.
+# The optional ``key`` argument (storage-plugin class, collective verb)
+# becomes a label and is free-form; the FAMILY name is not.
+
+HISTOGRAM_NAMES: Dict[str, str] = {
+    "write.sub_chunk_s": "per-sub-chunk stage+write handoff latency on a "
+    "streamed write (scheduler; key = storage plugin)",
+    "read.sub_chunk_s": "per-sub-chunk delivery latency on a streamed or "
+    "peer-fed read (scheduler; key = storage plugin or 'peer')",
+    "write.entry_s": "buffered per-entry storage write latency "
+    "(scheduler; key = storage plugin)",
+    "read.entry_s": "buffered per-entry storage read latency "
+    "(scheduler; key = storage plugin)",
+    "storage.op_s": "per-storage-operation latency in the cloud retry "
+    "tier (retry/_retrying; key = '<Plugin>.<op>')",
+    "collective.wait_s": "wall time inside one KV-store collective "
+    "(pg_wrapper; key = collective verb)",
+}
+
+HISTOGRAMS = frozenset(HISTOGRAM_NAMES)
