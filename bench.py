@@ -405,6 +405,112 @@ def _coop_restore_leg(timeout_s: float = 420.0):
     return summary["worlds"]
 
 
+def _native_io_leg(tmp: str, app_state, state, nbytes: int):
+    """Side-by-side native-engine vs Python-path legs (ISSUE 9),
+    persisted to BENCH_r10.json and embedded in the main record.
+
+    Both save legs pin a 32 MB sub-chunk so the streamed write path (the
+    surface the engine replaces) engages for every entry under BOTH
+    modes — the comparison measures the engine, not the streaming
+    election; both restore legs force streamed reads for the same
+    reason. Trials are back-to-back best-of-N (this host's bimodal
+    reclaim stalls only ever inflate walls). Returns the record dict, or
+    None when the engine probe fails (the legs would measure nothing)."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import Snapshot, StateDict, native_io
+
+    if native_io.engine_kind() is None:
+        _log("native I/O leg skipped: engine probe failed")
+        return None
+
+    pinned = {
+        "TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES": str(32 << 20),
+        "TORCHSNAPSHOT_TPU_STREAM_READS": "always",
+    }
+    saved_env = {
+        k: os.environ.get(k)
+        for k in list(pinned) + ["TORCHSNAPSHOT_TPU_NATIVE_IO"]
+    }
+    legs: "dict[str, dict]" = {}
+    try:
+        os.environ.update(pinned)
+        for mode in ("never", "always"):
+            os.environ["TORCHSNAPSHOT_TPU_NATIVE_IO"] = mode
+            root = f"{tmp}/native_{mode}"
+            saves, restores = [], []
+            Snapshot.take(f"{root}/warm", app_state)  # discarded warmup
+            shutil.rmtree(f"{root}/warm", ignore_errors=True)
+            for trial in range(4):
+                t0 = time.perf_counter()
+                Snapshot.take(f"{root}/s", app_state)
+                saves.append(time.perf_counter() - t0)
+                dst = {
+                    "model": StateDict(
+                        {k: jnp.zeros_like(v) for k, v in state.items()}
+                    )
+                }
+                t0 = time.perf_counter()
+                Snapshot(f"{root}/s").restore(dst)
+                restores.append(time.perf_counter() - t0)
+                if trial < 3:
+                    shutil.rmtree(f"{root}/s", ignore_errors=True)
+            shutil.rmtree(root, ignore_errors=True)
+            legs[mode] = {
+                "save_trials_s": [round(t, 3) for t in saves],
+                "restore_trials_s": [round(t, 3) for t in restores],
+                "save_gbps": round(nbytes / 1e9 / min(saves), 3),
+                "save_p50_gbps": round(
+                    nbytes / 1e9 / statistics.median(saves), 3
+                ),
+                "restore_gbps": round(nbytes / 1e9 / min(restores), 3),
+                "restore_p50_gbps": round(
+                    nbytes / 1e9 / statistics.median(restores), 3
+                ),
+            }
+            _log(
+                f"native leg [{mode}]: save best "
+                f"{legs[mode]['save_gbps']:.2f} GB/s p50 "
+                f"{legs[mode]['save_p50_gbps']:.2f} | restore best "
+                f"{legs[mode]['restore_gbps']:.2f} p50 "
+                f"{legs[mode]['restore_p50_gbps']:.2f}"
+            )
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    from torchsnapshot_tpu import _native
+
+    record = {
+        "engine": native_io.engine_kind(),
+        "queue_depth": native_io.queue_depth(),
+        "slab_caps_seen": _native.slab_caps_seen(),
+        "sub_chunk_bytes_pinned": 32 << 20,
+        "python": legs["never"],
+        "native": legs["always"],
+        "native_vs_python_save": round(
+            legs["always"]["save_p50_gbps"]
+            / max(legs["never"]["save_p50_gbps"], 1e-9),
+            3,
+        ),
+        "native_vs_python_restore": round(
+            legs["always"]["restore_p50_gbps"]
+            / max(legs["never"]["restore_p50_gbps"], 1e-9),
+            3,
+        ),
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r10.json"
+    )
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    _log(f"native I/O side-by-side written to {out}")
+    return record
+
+
 def build_state(total_bytes: int, n_arrays: int = 18):
     """n_arrays bf16 arrays totalling ~total_bytes, on device."""
     import jax
@@ -666,6 +772,10 @@ def main() -> None:
         b = np.asarray(jax.device_get(dst["model"]["param_0"]))
         assert a.tobytes() == b.tobytes(), "restore not bit-exact"
         _log("restore round-trip verified bit-exact")
+
+        # Native-engine side-by-side (BENCH_r10.json): never vs always
+        # at a pinned sub-chunk so both modes stream every entry.
+        native_leg = _native_io_leg(tmp, app_state, state, nbytes)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -692,6 +802,8 @@ def main() -> None:
         record["discarded_contended_trials_s"] = discarded_trials
     if tpu_hw is not None:
         record["tpu_hw"] = tpu_hw
+    if native_leg is not None:
+        record["native_io"] = native_leg
     # Cooperative restore fan-out side-leg (multi-process, own group +
     # timeout): failures degrade to an absent key, never a dead bench.
     coop = _coop_restore_leg()

@@ -180,11 +180,11 @@ def _check_take_invariant(
     return "aborted"
 
 
-def _check_restore_invariant(backend, tmp_path, plan: str) -> str:
+def _check_restore_invariant(backend, tmp_path, plan: str, big: bool = False) -> str:
     """Run one restore-phase schedule: a faulted restore must either
     deliver bit-exact data or raise — never return silently-wrong bytes
     — and a clean retry afterwards must succeed bit-exact."""
-    state1 = _state(1)
+    state1 = _state(1, big)
     _prev, cur, opts, fsck_opts, _local = _backend(backend, tmp_path)
     Snapshot.take(cur, state1, storage_options=opts)
 
@@ -401,6 +401,127 @@ def test_chaos_sigkill(tmp_path, plan):
     assert _equal(dst, state0)
     assert run_fsck(str(tmp_path / "prev"))[0] == 0
     # The rubble reads as a partial commit (or nothing at all).
+    if os.path.isdir(cur):
+        assert run_fsck(cur)[0] in (1, 2)
+
+
+# ------------------------------------------- native-engine schedules
+#
+# The same binary invariant, drilled THROUGH the io_uring fast path
+# (ISSUE 9): env forces the native election and pins a small sub-chunk
+# so the big entry streams through the fs.native_* sites.
+
+_NATIVE_ENV = {
+    "TORCHSNAPSHOT_TPU_NATIVE_IO": "always",
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES": str(256 << 10),
+    "TORCHSNAPSHOT_TPU_STREAM_READS": "always",
+}
+
+
+def _native_engine_ready() -> bool:
+    from torchsnapshot_tpu import native_io
+
+    return native_io.engine_kind() == "uring"
+
+
+NATIVE_TAKE_PLANS = [
+    "fs.native_pwrite@2=transient",
+    "fs.native_pwrite@1=permanent",
+    "fs.native_pwrite@3=truncate:0.5",
+    "fs.native_pwrite@2=corrupt;seed=21",
+    "fs.native_pwrite@p0.4=transient;seed=22",
+]
+
+
+@pytest.mark.parametrize("plan", NATIVE_TAKE_PLANS)
+def test_chaos_native_take(tmp_path, plan, monkeypatch):
+    if not _native_engine_ready():
+        pytest.skip("io_uring unavailable")
+    for key, val in _NATIVE_ENV.items():
+        monkeypatch.setenv(key, val)
+    outcome = _check_take_invariant("fs", tmp_path, plan, big=True)
+    if plan == "fs.native_pwrite@1=permanent":
+        assert outcome == "aborted"
+    assert outcome in ("aborted", "committed", "committed-detectable")
+
+
+NATIVE_RESTORE_PLANS = [
+    "fs.native_pread@1=corrupt;seed=23",
+    "fs.native_pread@2=transient",
+    "fs.native_pread@1=truncate:0.5",
+    "fs.native_pread@2=delay:0.02",
+]
+
+
+@pytest.mark.parametrize("plan", NATIVE_RESTORE_PLANS)
+def test_chaos_native_restore(tmp_path, plan, monkeypatch):
+    if not _native_engine_ready():
+        pytest.skip("io_uring unavailable")
+    for key, val in _NATIVE_ENV.items():
+        monkeypatch.setenv(key, val)
+    outcome = _check_restore_invariant("fs", tmp_path, plan, big=True)
+    if plan.startswith("fs.native_pread@1=corrupt"):
+        # The receiver-side chained CRC catches the flipped byte before
+        # anything commits to the destination.
+        assert outcome == "raised"
+    if plan == "fs.native_pread@2=delay:0.02":
+        assert outcome == "restored"
+
+
+_NATIVE_KILL_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["TORCHSNAPSHOT_TPU_NATIVE_IO"] = "always"
+os.environ["TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES"] = str(256 << 10)
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject
+
+root, plan = sys.argv[1], sys.argv[2]
+
+def state(seed):
+    rng = np.random.default_rng(seed)
+    return {"model": StateDict(
+        w=rng.standard_normal(20_000).astype(np.float32),
+        big=rng.standard_normal(3_000_000).astype(np.float32),
+        step=np.array([seed], dtype=np.int64),
+    )}
+
+Snapshot.take(os.path.join(root, "prev"), state(0))
+faultinject.configure(plan)
+Snapshot.take(os.path.join(root, "cur"), state(1))
+print("SURVIVED")  # only reachable if the plan never fired
+"""
+
+
+def test_chaos_native_sigkill_mid_queue(tmp_path):
+    """SIGKILL while SQEs are queued in the native engine: the kernel
+    dies with the process's ring — the temp file never reaches the final
+    path, the previous snapshot stays restorable + fsck-clean."""
+    if not _native_engine_ready():
+        pytest.skip("io_uring unavailable")
+    plan = "fs.native_pwrite@2=kill"
+    r = subprocess.run(
+        [sys.executable, "-c", _NATIVE_KILL_CHILD, str(tmp_path), plan],
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+    assert "SURVIVED" not in r.stdout
+    cur = str(tmp_path / "cur")
+    assert not os.path.exists(os.path.join(cur, ".snapshot_metadata"))
+    rng = np.random.default_rng(0)
+    expected = {
+        "model": StateDict(
+            w=rng.standard_normal(20_000).astype(np.float32),
+            big=rng.standard_normal(3_000_000).astype(np.float32),
+            step=np.array([0], dtype=np.int64),
+        )
+    }
+    dst = _zeros_like(expected)
+    Snapshot(str(tmp_path / "prev")).restore(dst)
+    assert _equal(dst, expected)
+    assert run_fsck(str(tmp_path / "prev"))[0] == 0
     if os.path.isdir(cur):
         assert run_fsck(cur)[0] in (1, 2)
 

@@ -1,0 +1,535 @@
+"""Native I/O fast path: pinned slab allocator, io_uring engine, the fs
+plugin's native stream paths, and the IOGovernor election (ISSUE 9).
+
+Four layers, mirroring the subsystem's seams:
+
+- **Slab allocator / staging pool**: page-aligned, pre-faulted-at-
+  construction slabs; GC-driven recycling with derived-view pinning on
+  every interpreter (the ctypes holder, not PEP 688); telemetry gauges.
+- **Engine**: submit/wait/drain semantics, EOF taxonomy, the
+  buffer-pin contract (a pooled slab is never recycled while its SQE
+  may be in flight).
+- **fs plugin**: native streamed writes/reads are byte- and
+  checksum-identical to the Python path, atomic on mid-stream failure,
+  and drilled through the ``fs.native_*`` fault sites.
+- **Election**: env modes, the governor's measured-rate gates, silent
+  degradation when the probe fails, and the recorded election event.
+"""
+
+import asyncio
+import gc
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import faultinject, native_io
+from torchsnapshot_tpu import _native
+from torchsnapshot_tpu.io_types import ReadIO, WriteStream
+from torchsnapshot_tpu.io_preparers.array import (
+    _NATIVE_SLAB_MIN_BYTES,
+    _StagingPool,
+    pooled_buffer,
+)
+from torchsnapshot_tpu.scheduler import IOGovernor
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+native_present = pytest.mark.skipif(
+    not _native.native_available(), reason="native extension unavailable"
+)
+uring_present = pytest.mark.skipif(
+    native_io.engine_kind() != "uring", reason="io_uring unavailable"
+)
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+async def _chunks_of(payload: bytes, n: int):
+    for lo in range(0, len(payload), n):
+        yield payload[lo : lo + n]
+
+
+async def _collect(stream) -> bytes:
+    out = bytearray()
+    async for chunk in stream.chunks:
+        out += bytes(memoryview(chunk).cast("B"))
+    return bytes(out)
+
+
+# ------------------------------------------------------- slab allocator
+
+
+@native_present
+def test_slab_alloc_page_aligned_and_writable():
+    out = _native.slab_alloc(1 << 20)
+    assert out is not None
+    addr, caps = out
+    try:
+        assert addr % 4096 == 0
+        assert caps & _native.SLAB_PREFAULT  # pre-faulted at construction
+        view = np.frombuffer(
+            (np.ctypeslib.ctypes.c_ubyte * (1 << 20)).from_address(addr),
+            np.uint8,
+        )
+        view[:] = 7
+        assert int(view[-1]) == 7
+    finally:
+        _native.slab_free(addr, 1 << 20)
+
+
+@native_present
+def test_pool_native_recycles_and_aligns():
+    pool = _StagingPool(limit_bytes=1 << 22)
+    buf = pool.get(1 << 20)
+    assert buf.ctypes.data % 4096 == 0  # aligned for O_DIRECT/io_uring
+    ptr = buf.ctypes.data
+    del buf
+    gc.collect()
+    again = pool.get(1 << 20)
+    assert again.ctypes.data == ptr  # same pinned slab came back
+    # Eviction past the limit frees the mapping instead of pooling it.
+    big = pool.get(1 << 22)
+    del big, again
+    gc.collect()
+    assert pool._free_bytes <= 1 << 22
+
+
+@native_present
+def test_pool_native_derived_view_pins_slab():
+    pool = _StagingPool(limit_bytes=1 << 22)
+    buf = pool.get(1 << 20)
+    buf[:] = 7
+    view = buf[10:20]
+    ptr = buf.ctypes.data
+    del buf
+    gc.collect()
+    other = pool.get(1 << 20)
+    assert other.ctypes.data != ptr  # slab NOT recycled while aliased
+    other[:] = 99
+    assert np.all(view == 7)
+    del view, other
+    gc.collect()
+    free_ptrs = {s.ctypes.data for slabs in pool._free.values() for s in slabs}
+    assert ptr in free_ptrs  # recycled once every reference died
+
+
+@native_present
+def test_pool_degrade_frees_native_slabs(monkeypatch):
+    """A mid-run allocation failure degrades the pool to the Python
+    path; pooled native slabs must be munmap'd at that transition (and
+    late returners freed), never inherited by _get_py — whose eviction
+    would drop the pinned mapping with no munmap."""
+    pool = _StagingPool(limit_bytes=1 << 24)
+    a = pool.get(1 << 20)
+    held = pool.get(1 << 20)  # still checked out across the degrade
+    del a
+    gc.collect()
+    assert pool._free_bytes == 1 << 20
+    monkeypatch.setattr("torchsnapshot_tpu._native.slab_view", lambda n: None)
+    b = pool.get(2 << 20)  # allocation fails -> degrade for good
+    assert pool._native is False
+    assert all(n < _NATIVE_SLAB_MIN_BYTES for n in pool._free)  # drained
+    b[:] = 1  # the fallback buffer is an ordinary working buffer
+    del held
+    gc.collect()  # the late returner is freed, not pooled
+    assert all(n < _NATIVE_SLAB_MIN_BYTES for n in pool._free)
+
+
+def test_pool_tiny_buffers_skip_native_path():
+    pool = _StagingPool(limit_bytes=1 << 22)
+    small = pool.get(_NATIVE_SLAB_MIN_BYTES - 1)
+    small[:] = 3  # writable, correct size — the whole contract for tiny bufs
+    assert small.nbytes == _NATIVE_SLAB_MIN_BYTES - 1
+
+
+def test_pool_python_fallback_same_surface():
+    """With native slabs unavailable the pool must keep the identical
+    call surface and buffer semantics (writable exact-size uint8),
+    recycling when the interpreter allows it and degrading to fresh
+    allocations when not — never erroring."""
+    pool = _StagingPool(limit_bytes=1 << 22)
+    pool._native = False  # simulate a build-absent host
+    buf = pool.get(1 << 16)
+    assert buf.dtype == np.uint8 and buf.nbytes == 1 << 16
+    buf[:] = 42
+    assert int(buf[-1]) == 42
+    assert pool.prewarm([1 << 16]) >= 0  # never raises
+
+
+@native_present
+def test_pool_prewarm_allocates_prefaulted_slabs():
+    pool = _StagingPool(limit_bytes=1 << 24)
+    warmed = pool.prewarm([1 << 20, 1 << 20, 1 << 16])
+    assert warmed == (1 << 20) * 2 + (1 << 16)
+    assert pool.prewarm([1 << 20, 1 << 20]) == 0  # already pooled
+    # The warmed slabs are exactly what get() hands out.
+    ptrs = {s.ctypes.data for slabs in pool._free.values() for s in slabs}
+    got = pool.get(1 << 20)
+    assert got.ctypes.data in ptrs
+
+
+@native_present
+def test_pool_telemetry_gauges(monkeypatch):
+    from torchsnapshot_tpu import telemetry
+
+    telemetry.set_enabled(True)
+    try:
+        telemetry.reset()
+        pool = _StagingPool(limit_bytes=1 << 22)
+        a = pool.get(1 << 20)  # miss
+        del a
+        gc.collect()
+        b = pool.get(1 << 20)  # hit
+        counters = telemetry.counters()
+        assert counters.get("staging_pool_misses", 0) >= 1
+        assert counters.get("staging_pool_hits", 0) >= 1
+        gauges = telemetry.gauges()
+        assert gauges.get("staging_pool_outstanding_bytes") == 1 << 20
+        del b
+    finally:
+        telemetry.set_enabled(False)
+        telemetry.reset()
+
+
+# --------------------------------------------------------------- engine
+
+
+@uring_present
+def test_engine_write_read_roundtrip(tmp_path):
+    eng = native_io.open_engine()
+    assert isinstance(eng, native_io.UringEngine)
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+    try:
+        payload = np.frombuffer(os.urandom(1 << 18), np.uint8).copy()
+        slots = [
+            eng.submit_pwrite(fd, payload[lo : lo + (1 << 16)], lo)
+            for lo in range(0, 1 << 18, 1 << 16)
+        ]
+        eng.drain()
+        back = np.zeros(1 << 18, np.uint8)
+        slot = eng.submit_pread(fd, back, 0)
+        eng.wait(slot)
+        assert np.array_equal(back, payload)
+        assert len(slots) == 4
+    finally:
+        eng.close()
+        os.close(fd)
+
+
+@uring_present
+def test_engine_short_read_is_eoferror(tmp_path):
+    path = str(tmp_path / "short")
+    with open(path, "wb") as f:
+        f.write(b"x" * 100)
+    eng = native_io.open_engine()
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        buf = np.zeros(4096, np.uint8)
+        slot = eng.submit_pread(fd, buf, 0)
+        with pytest.raises(EOFError):
+            eng.wait(slot, path)
+    finally:
+        eng.close()
+        os.close(fd)
+
+
+@uring_present
+def test_engine_error_propagates_from_drain(tmp_path):
+    path = str(tmp_path / "ro")
+    with open(path, "wb") as f:
+        f.write(b"y" * 10)
+    eng = native_io.open_engine()
+    fd = os.open(path, os.O_RDONLY)  # write to an O_RDONLY fd must fail
+    try:
+        eng.submit_pwrite(fd, np.zeros(64, np.uint8), 0)
+        with pytest.raises(OSError):
+            eng.drain()
+    finally:
+        eng.close()
+        os.close(fd)
+
+
+@uring_present
+def test_engine_pins_pooled_buffer_until_reaped(tmp_path):
+    """The satellite-3 lifetime contract: a pooled slab handed to the
+    engine is NEVER recycled while its SQE may be in flight — even if
+    the Python side drops every reference before waiting."""
+    from torchsnapshot_tpu.io_preparers.array import _staging_pool
+
+    path = str(tmp_path / "pin")
+    with open(path, "wb") as f:
+        f.write(os.urandom(1 << 20))
+    eng = native_io.open_engine()
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        # A deliberately odd size: the process-global pool is exact-size
+        # keyed, so this test can never donate a slab that other tests'
+        # (or the write path's) round sizes would silently inherit.
+        size = (1 << 20) - 8192
+        buf = pooled_buffer(size)
+        ptr = buf.ctypes.data
+        slot = eng.submit_pread(fd, buf, 0)
+        del buf  # the engine's pin must now be the only thing holding it
+        gc.collect()
+        fresh = _staging_pool.get(size)
+        assert fresh.ctypes.data != ptr  # in-flight slab NOT handed out
+        eng.wait(slot)
+        gc.collect()
+        recycled = _staging_pool.get(size)
+        assert recycled.ctypes.data == ptr  # reaped slab recycles
+        del fresh, recycled
+    finally:
+        eng.close()
+        os.close(fd)
+
+
+# ------------------------------------------------------------ fs plugin
+
+
+@uring_present
+def test_fs_native_stream_equals_python_stream(tmp_path, loop, monkeypatch):
+    payload = os.urandom((1 << 20) + 12345)  # unaligned tail
+    plugin = FSStoragePlugin(root=str(tmp_path))
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "never")
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(
+                path="python", nbytes=len(payload),
+                chunks=_chunks_of(payload, 100_000),
+            )
+        )
+    )
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(
+                path="native", nbytes=len(payload),
+                chunks=_chunks_of(payload, 100_000),
+            )
+        )
+    )
+    assert (tmp_path / "native").read_bytes() == (tmp_path / "python").read_bytes()
+
+    # Native streamed reads produce the identical byte stream too.
+    stream = loop.run_until_complete(
+        plugin.read_stream(ReadIO(path="native"), 100_000)
+    )
+    assert loop.run_until_complete(_collect(stream)) == payload
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "never")
+    stream = loop.run_until_complete(
+        plugin.read_stream(ReadIO(path="native"), 100_000)
+    )
+    assert loop.run_until_complete(_collect(stream)) == payload
+
+
+@uring_present
+def test_fs_native_ranged_read_stream(tmp_path, loop, monkeypatch):
+    payload = os.urandom(1 << 20)
+    (tmp_path / "r").write_bytes(payload)
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    stream = loop.run_until_complete(
+        plugin.read_stream(
+            ReadIO(path="r", byte_range=(1000, 700_000)), 65_536
+        )
+    )
+    assert loop.run_until_complete(_collect(stream)) == payload[1000:700_000]
+
+
+@uring_present
+def test_fs_native_midstream_failure_atomic(tmp_path, loop, monkeypatch):
+    """An injected failure at the native pwrite site aborts the stream
+    with NO final object and NO temp litter — the same atomicity the
+    Python path pins."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(1 << 20)
+    faultinject.configure("fs.native_pwrite@2=permanent")
+    try:
+        with pytest.raises(OSError):
+            loop.run_until_complete(
+                plugin.write_stream(
+                    WriteStream(
+                        path="obj", nbytes=len(payload),
+                        chunks=_chunks_of(payload, 100_000),
+                    )
+                )
+            )
+    finally:
+        faultinject.disable()
+    assert not (tmp_path / "obj").exists()
+    assert not list(tmp_path.glob("*.tmp.*"))
+    assert faultinject.hits() == {}  # disabled resets
+
+
+@uring_present
+def test_fs_native_truncate_fault_detected_as_short_write(
+    tmp_path, loop, monkeypatch
+):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(1 << 20)
+    faultinject.configure("fs.native_pwrite@3=truncate:0.5")
+    try:
+        with pytest.raises(IOError):
+            loop.run_until_complete(
+                plugin.write_stream(
+                    WriteStream(
+                        path="obj", nbytes=len(payload),
+                        chunks=_chunks_of(payload, 100_000),
+                    )
+                )
+            )
+    finally:
+        faultinject.disable()
+    assert not (tmp_path / "obj").exists()
+
+
+@uring_present
+def test_fs_native_pread_corrupt_drills_verification(
+    tmp_path, loop, monkeypatch
+):
+    """A corrupt fault at the native pread site must surface through the
+    normal read-side taxonomy: the stream yields mutated bytes, and the
+    consumer's chained CRC (exercised end-to-end elsewhere) is what
+    catches it — here we pin that the site actually fires and mutates."""
+    payload = os.urandom(1 << 20)
+    (tmp_path / "r").write_bytes(payload)
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    faultinject.configure("fs.native_pread@1=corrupt;seed=3")
+    try:
+        stream = loop.run_until_complete(
+            plugin.read_stream(ReadIO(path="r"), 65_536)
+        )
+        got = loop.run_until_complete(_collect(stream))
+    finally:
+        faultinject.disable()
+    assert len(got) == len(payload)
+    assert got != payload  # exactly one flipped byte
+    assert sum(a != b for a, b in zip(got, payload)) == 1
+
+
+# -------------------------------------------------------------- election
+
+
+def test_native_io_mode_parser(monkeypatch):
+    for raw, want in [
+        ("never", "never"), ("0", "never"), ("off", "never"),
+        ("always", "always"), ("1", "always"), ("force", "always"),
+        ("auto", "auto"), ("", "auto"), ("garbage", "auto"),
+    ]:
+        monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", raw)
+        assert native_io.native_io_mode() == want, raw
+
+
+def test_elect_never_short_circuits(monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "never")
+    assert native_io.maybe_engine("write", "FSStoragePlugin") is None
+
+
+def test_elect_degrades_silently_without_engine(monkeypatch):
+    """Build-absent / ENOSYS / EPERM all collapse to engine_kind() None;
+    election then returns False even under `always` — the Python path
+    takes over with no error surfaced."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    monkeypatch.setattr(native_io, "_probe_done", True)
+    monkeypatch.setattr(native_io, "_probe_kind", None)
+    assert native_io.elect("write", "FSStoragePlugin") is False
+    assert native_io.maybe_engine("write", "FSStoragePlugin") is None
+
+
+def test_governor_native_write_gate():
+    governor = IOGovernor()
+    # Unmeasured: optimistic (the streaming-writes precedent).
+    assert governor.should_native_io("FSStoragePlugin", op="write")
+    governor.record_write("FSStoragePlugin", 1 << 30, 1.0)
+    assert governor.should_native_io("FSStoragePlugin", op="write")
+    # Native measured clearly slower than the pipeline without it: depose.
+    governor.record_write("FSStoragePlugin.native", 1 << 30, 2.0)
+    assert not governor.should_native_io("FSStoragePlugin", op="write")
+    # Native at parity: stays elected (hysteresis margin).
+    governor.record_write("FSStoragePlugin.native", 1 << 30, 0.25)
+    assert governor.should_native_io("FSStoragePlugin", op="write")
+
+
+def test_governor_native_read_gate_uses_latency_knee():
+    governor = IOGovernor()
+    # No measurement: status-quo Python path (unlike the write side).
+    assert not governor.should_native_io("FSStoragePlugin", op="read")
+    # memcpy-speed local reads: queue depth buys nothing — stay Python.
+    governor.record_read("FSStoragePlugin", 4 << 30, 1.0)
+    assert not governor.should_native_io("FSStoragePlugin", op="read")
+    # Latency-bound storage: elect.
+    governor_slow = IOGovernor()
+    governor_slow.record_read("FSStoragePlugin", 50 << 20, 1.0)
+    assert governor_slow.should_native_io("FSStoragePlugin", op="read")
+    # ...unless the native engine itself measured clearly worse there.
+    governor_slow.record_read("FSStoragePlugin.native", 10 << 20, 1.0)
+    assert not governor_slow.should_native_io("FSStoragePlugin", op="read")
+
+
+@uring_present
+def test_election_recorded_on_flight_ring(tmp_path, loop, monkeypatch):
+    from torchsnapshot_tpu.telemetry import flightrec
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    native_io._election_seen.clear()
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    payload = os.urandom(1 << 18)
+    loop.run_until_complete(
+        plugin.write_stream(
+            WriteStream(
+                path="e", nbytes=len(payload),
+                chunks=_chunks_of(payload, 1 << 16),
+            )
+        )
+    )
+    events = [
+        args
+        for (_seq, _t, ev, args) in flightrec.snapshot_ring()
+        if ev == "governor.elect" and (args or {}).get("site") == "native_io"
+    ]
+    assert events, "native_io election must land on the flight ring"
+    last = events[-1]
+    assert last["elected"] is True and last["engine"] == "uring"
+
+
+@uring_present
+def test_native_end_to_end_snapshot_roundtrip(tmp_path, monkeypatch):
+    """A forced-native streamed take records the same checksums the
+    Python path would and restores bit-exactly (streamed==buffered
+    equivalence at the Snapshot level)."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_NATIVE_IO", "always")
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(1 << 18))
+    rng = np.random.default_rng(7)
+    state = {"m": StateDict(w=rng.standard_normal(500_000).astype(np.float32))}
+    Snapshot.take(str(tmp_path / "s"), state)
+    dst = {"m": StateDict(w=np.zeros(500_000, np.float32))}
+    Snapshot(str(tmp_path / "s")).restore(dst)
+    assert np.array_equal(dst["m"]["w"], state["m"]["w"])
+    # The recorded checksum algorithm matches the Python streamed path.
+    meta = Snapshot(str(tmp_path / "s")).metadata
+
+    def _array_entries(entry):
+        for shard in getattr(entry, "chunks", []) + getattr(entry, "shards", []):
+            yield shard.array
+        if getattr(entry, "checksum", None) is not None:
+            yield entry
+
+    checksums = [
+        arr.checksum
+        for e in meta.manifest.values()
+        for arr in _array_entries(e)
+        if getattr(arr, "checksum", None) is not None
+    ]
+    assert checksums and all(c.startswith("crc32c:") for c in checksums)

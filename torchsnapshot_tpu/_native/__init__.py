@@ -8,10 +8,16 @@ Python when no toolchain is available:
                               table fallback; identical values either way.
 - ``scatter_copy(dst, src, regions)`` - batched memcpy, falling back to
                               per-region memoryview slicing.
+- ``slab_alloc/slab_free/slab_view`` - pinned, page-aligned, pre-faulted
+                              staging slabs (the staging pool's backing
+                              store; manual lifetime, pool-owned).
+- ``uring_*``               - io_uring engine bindings (int-level; the
+                              engine object lives in native_io.py).
 - ``native_available()``    - True when the compiled extension is loaded.
 
 Kill switch: ``TORCHSNAPSHOT_TPU_DISABLE_NATIVE=1`` forces the fallbacks
-(used by tests to cover both paths).
+and disables the slab allocator + io_uring surface with them (used by
+tests and the CI native-absent leg to cover both paths).
 """
 
 from __future__ import annotations
@@ -114,6 +120,27 @@ def _try_load() -> Optional[ctypes.CDLL]:
     lib.ts_copy_crc32c.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
     ]
+    lib.ts_slab_alloc.restype = ctypes.c_void_p
+    lib.ts_slab_alloc.argtypes = [
+        ctypes.c_size_t, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.ts_slab_free.restype = None
+    lib.ts_slab_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.ts_uring_init.restype = ctypes.c_void_p
+    lib.ts_uring_init.argtypes = [ctypes.c_uint]
+    lib.ts_uring_close.restype = None
+    lib.ts_uring_close.argtypes = [ctypes.c_void_p]
+    lib.ts_uring_probe.restype = ctypes.c_int
+    lib.ts_uring_probe.argtypes = []
+    lib.ts_uring_submit.restype = ctypes.c_int
+    lib.ts_uring_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint,
+    ]
+    lib.ts_uring_wait_slot.restype = ctypes.c_int
+    lib.ts_uring_wait_slot.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ts_uring_drain.restype = ctypes.c_int
+    lib.ts_uring_drain.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -281,3 +308,135 @@ def copy_crc32c(dst, src, crc: int = 0) -> Optional[int]:
         src_arr.nbytes,
         ctypes.c_uint32(crc),
     )
+
+
+# ------------------------------------------------------- pinned slabs
+#
+# Page-aligned, pre-faulted, best-effort-pinned staging memory for the
+# process staging pool (io_preparers/array.py). The allocation is
+# manual-lifetime: the pool owns each slab and frees it on eviction —
+# the capability degradation (no hugetlb pool, RLIMIT_MEMLOCK) happens
+# inside the C allocator and is reported via the caps bitmask.
+
+SLAB_HUGETLB = 1
+SLAB_MLOCK = 2
+SLAB_PREFAULT = 4
+SLAB_THP = 8
+_SLAB_WANT = SLAB_HUGETLB | SLAB_MLOCK | SLAB_PREFAULT | SLAB_THP
+
+# Union of capability bits achieved by any allocation this process made
+# (telemetry/stats surface it; individual slabs may differ).
+_slab_caps_seen = 0
+
+
+def slab_allocator_available() -> bool:
+    """True when pinned native slabs can back the staging pool."""
+    return _load() is not None
+
+
+def slab_caps_seen() -> int:
+    return _slab_caps_seen
+
+
+def slab_alloc(nbytes: int) -> Optional[Tuple[int, int]]:
+    """Allocate a pre-faulted, page-aligned slab; ``(addr, caps)`` or
+    None. The caller owns the mapping and must ``slab_free`` it."""
+    global _slab_caps_seen
+    lib = _load()
+    if lib is None or nbytes <= 0:
+        return None
+    got = ctypes.c_int(0)
+    ptr = lib.ts_slab_alloc(nbytes, _SLAB_WANT, ctypes.byref(got))
+    if not ptr:
+        return None
+    _slab_caps_seen |= got.value
+    return int(ptr), got.value
+
+
+def slab_free(addr: int, nbytes: int) -> None:
+    lib = _load()
+    if lib is not None and addr:
+        lib.ts_slab_free(ctypes.c_void_p(addr), nbytes)
+
+
+def slab_view(nbytes: int):
+    """A writable uint8 ndarray over a fresh pinned slab, or None.
+
+    The array does NOT own the mapping (its base is a ``from_address``
+    ctypes array): whoever holds the view must eventually call
+    ``slab_free(view.ctypes.data, view.nbytes)`` — the staging pool's
+    eviction path does."""
+    import numpy as np
+
+    out = slab_alloc(nbytes)
+    if out is None:
+        return None
+    addr, _caps = out
+    return np.frombuffer((ctypes.c_ubyte * nbytes).from_address(addr), np.uint8)
+
+
+# ----------------------------------------------------------- io_uring
+#
+# Thin int-level passthroughs; the engine object (buffer pinning, slot
+# bookkeeping, errno -> exception mapping) lives in native_io.py so this
+# loader stays a pure binding surface.
+
+IOSQE_ASYNC = 0x10  # force kernel-worker execution (submit returns fast)
+
+
+def uring_probe() -> int:
+    """0 when an io_uring ring can be set up, else -errno."""
+    lib = _load()
+    if lib is None:
+        return -1
+    return int(lib.ts_uring_probe())
+
+
+def uring_init(depth: int) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.ts_uring_init(ctypes.c_uint(depth))
+    return int(handle) if handle else None
+
+
+def uring_close(handle: int) -> None:
+    lib = _load()
+    if lib is not None and handle:
+        lib.ts_uring_close(ctypes.c_void_p(handle))
+
+
+def uring_submit(
+    handle: int,
+    is_write: bool,
+    fd: int,
+    addr: int,
+    nbytes: int,
+    offset: int,
+    sqe_flags: int = IOSQE_ASYNC,
+) -> int:
+    lib = _load()
+    assert lib is not None
+    return int(
+        lib.ts_uring_submit(
+            ctypes.c_void_p(handle),
+            1 if is_write else 0,
+            fd,
+            ctypes.c_void_p(addr),
+            ctypes.c_uint64(nbytes),
+            ctypes.c_uint64(offset),
+            ctypes.c_uint(sqe_flags),
+        )
+    )
+
+
+def uring_wait_slot(handle: int, slot: int) -> int:
+    lib = _load()
+    assert lib is not None
+    return int(lib.ts_uring_wait_slot(ctypes.c_void_p(handle), slot))
+
+
+def uring_drain(handle: int) -> int:
+    lib = _load()
+    assert lib is not None
+    return int(lib.ts_uring_drain(ctypes.c_void_p(handle)))

@@ -82,6 +82,8 @@ SITES: Dict[str, str] = {
     "fs.pwrite": "data",          # streamed sub-chunk positional write
     "fs.read": "data",            # buffered / mmap read
     "fs.pread": "data",           # streamed sub-chunk positional read
+    "fs.native_pwrite": "data",   # native-engine (io_uring) sub-chunk write
+    "fs.native_pread": "data",    # native-engine (io_uring) sub-chunk read
     # s3 plugin
     "s3.put": "data",             # single-request PUT
     "s3.put_part": "data",        # streaming multipart part upload

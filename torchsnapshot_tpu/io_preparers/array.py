@@ -17,11 +17,13 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import contextvars
+import ctypes
 import logging
 import os
 import sys
 import threading
 import weakref
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -115,45 +117,226 @@ class _SlabHolder:
         return memoryview(self._slab)
 
 
+# Below this size a fresh np.empty beats an mmap-backed native slab
+# (two syscalls + page bookkeeping for memory that fits in one page's
+# worth of faults anyway) — tiny buffers skip the native pool path.
+_NATIVE_SLAB_MIN_BYTES = 4096
+
+
 class _StagingPool:
     """Bounded free-list of staging buffers, recycled by the GC.
 
     A training loop calls async_take every N minutes; without a pool each
     call allocates the full state size in fresh buffers, and on
     lazily-backed VMs first-touch page faults cost several x the copy
-    itself. ``get`` returns an array over a pooled slab whose base is a
-    ``_SlabHolder`` carrying a finalizer: when every reference dies
-    (scheduler, storage plugin, a mirror's background replica, any numpy
-    view a consumer derived — whoever holds it longest), the slab returns
-    to the free list. GC-driven recycling means no component needs an
-    explicit release call, and a buffer still referenced anywhere can
-    never be handed out again."""
+    itself. ``get`` returns an array over a pooled slab whose base
+    carries a finalizer: when every reference dies (scheduler, storage
+    plugin, a mirror's background replica, any numpy view a consumer
+    derived — whoever holds it longest), the slab returns to the free
+    list. GC-driven recycling means no component needs an explicit
+    release call, and a buffer still referenced anywhere can never be
+    handed out again.
+
+    Slabs are NATIVE when the extension is present (``_native``'s
+    pinned allocator: page-aligned for O_DIRECT/io_uring, pre-faulted
+    deterministically at allocation — never lazily inside a timed
+    staging copy — THP-hinted, mlock'd best-effort), recycled through a
+    ``from_address`` ctypes holder, which works on every supported
+    interpreter. The PEP 688 ``_SlabHolder`` path remains for
+    native-absent 3.12+ hosts; pre-3.12 without the extension degrades
+    to unpooled ``np.empty``. Pool traffic is published to the
+    telemetry bus (``staging_pool_hits``/``_misses`` counters,
+    ``staging_pool_free_bytes``/``_outstanding_bytes`` gauges) for
+    ``stats`` and the ``/metrics`` exporter."""
 
     def __init__(self, limit_bytes: int) -> None:
         self._limit = limit_bytes
         self._lock = threading.Lock()
         self._free: dict = {}
         self._free_bytes = 0
+        self._outstanding = 0
+        # Slabs whose GC finalizer fired while the lock was unavailable.
+        # A finalizer can run at ANY allocation point — including inside
+        # this pool's own critical sections — so it must never block on
+        # the lock (self-deadlock) nor mutate the counters reentrantly
+        # (a += interrupted mid-op would lose one side's update).
+        # Deferred returns park here (deque append is GIL-atomic, the
+        # flightrec precedent) and are integrated by the next get/prewarm.
+        self._deferred_native: "deque" = deque()
+        self._deferred_py: "deque" = deque()
+        # None = unprobed; False = unavailable (or an alloc failed —
+        # never retried); True = native slabs back the pool.
+        self._native: Optional[bool] = None
+
+    def _native_ok(self) -> bool:
+        if self._native is None:
+            try:
+                from .._native import slab_allocator_available
+
+                self._native = bool(slab_allocator_available())
+            except Exception:  # noqa: BLE001 - probe must never raise
+                self._native = False
+        return self._native
 
     def get(self, nbytes: int) -> np.ndarray:
-        if not _BUFFER_PROTOCOL_OK:  # pragma: no cover (3.12 CI)
+        self._integrate_deferred()
+        if nbytes < _NATIVE_SLAB_MIN_BYTES or not self._native_ok():
+            return self._get_py(nbytes)
+        out = self._get_native(nbytes)
+        if out is None:  # allocation failure: degrade for good
+            self._native = False
+            self._drain_native_free()
+            return self._get_py(nbytes)
+        return out
+
+    def _integrate_deferred(self) -> None:
+        """Fold in returns whose finalizer could not take the lock."""
+        while True:
+            try:
+                view = self._deferred_native.popleft()
+            except IndexError:
+                break
+            with self._lock:
+                self._outstanding -= view.nbytes
+            self._store_native(view)
+        while True:
+            try:
+                base = self._deferred_py.popleft()
+            except IndexError:
+                break
+            with self._lock:
+                self._outstanding -= base.nbytes
+                if self._free_bytes + base.nbytes <= self._limit:
+                    self._free.setdefault(base.nbytes, []).append(base)
+                    self._free_bytes += base.nbytes
+
+    # ------------------------------------------------ native slab path
+
+    def _get_native(self, nbytes: int) -> Optional[np.ndarray]:
+        with self._lock:
+            slabs = self._free.get(nbytes)
+            view = slabs.pop() if slabs else None
+            if view is not None:
+                self._free_bytes -= nbytes
+        hit = view is not None
+        if view is None:
+            from .. import _native
+
+            view = _native.slab_view(nbytes)
+            if view is None:
+                return None
+        with self._lock:
+            self._outstanding += nbytes
+        # The holder aliases the slab without owning it; numpy's base-
+        # chain collapsing stops at the first non-ndarray base, so every
+        # derived view keeps the holder (and through its finalizer the
+        # slab's pool entry) alive — same property _SlabHolder documents.
+        holder = (ctypes.c_ubyte * nbytes).from_address(view.ctypes.data)
+        weakref.finalize(holder, self._put_native, view)
+        self._publish(hit)
+        return np.frombuffer(holder, np.uint8)
+
+    def _put_native(self, view: np.ndarray) -> None:
+        # Finalizer context: may fire at any allocation point, possibly
+        # while THIS thread already holds the pool lock (GC inside a
+        # critical section). Never block — integrate now if the lock is
+        # free, else defer to the next get/prewarm.
+        if not self._lock.acquire(blocking=False):
+            self._deferred_native.append(view)
+            return
+        try:
+            self._outstanding -= view.nbytes
+        finally:
+            self._lock.release()
+        self._store_native(view)
+
+    def _store_native(self, view: np.ndarray) -> None:
+        evict = False
+        with self._lock:
+            # After a mid-run degrade the free lists feed _get_py, which
+            # must never pop an unowned native view (its eviction path
+            # would drop the mmap with no munmap): free late returners.
+            if self._native is False or (
+                self._free_bytes + view.nbytes > self._limit
+            ):
+                evict = True
+            else:
+                self._free.setdefault(view.nbytes, []).append(view)
+                self._free_bytes += view.nbytes
+        if evict:
+            from .. import _native
+
+            _native.slab_free(view.ctypes.data, view.nbytes)
+
+    def _drain_native_free(self) -> None:
+        """Free every pooled native slab (the True→False degrade
+        transition): sizes >= the native floor were allocated natively
+        while the pool ran native, and _get_py must never inherit them.
+        Sub-floor sizes (PEP 688 slabs) stay pooled."""
+        from .. import _native
+
+        drained: List[np.ndarray] = []
+        with self._lock:
+            for nbytes in [
+                n for n in self._free if n >= _NATIVE_SLAB_MIN_BYTES
+            ]:
+                views = self._free.pop(nbytes)
+                drained.extend(views)
+                self._free_bytes -= nbytes * len(views)
+        for view in drained:
+            _native.slab_free(view.ctypes.data, view.nbytes)
+
+    # --------------------------------------------------- PEP 688 path
+
+    def _get_py(self, nbytes: int) -> np.ndarray:
+        if not _BUFFER_PROTOCOL_OK:
             return np.empty(nbytes, np.uint8)
         with self._lock:
             slabs = self._free.get(nbytes)
             base = slabs.pop() if slabs else None
             if base is not None:
                 self._free_bytes -= nbytes
+            self._outstanding += nbytes
+        hit = base is not None
         if base is None:
             base = np.empty(nbytes, np.uint8)
         holder = _SlabHolder(base)
         weakref.finalize(holder, self._put, base)
+        self._publish(hit)
         return np.frombuffer(holder, np.uint8)
 
     def _put(self, base: np.ndarray) -> None:
-        with self._lock:
+        # Finalizer context — same never-block rule as _put_native.
+        if not self._lock.acquire(blocking=False):
+            self._deferred_py.append(base)
+            return
+        try:
+            self._outstanding -= base.nbytes
             if self._free_bytes + base.nbytes <= self._limit:
                 self._free.setdefault(base.nbytes, []).append(base)
                 self._free_bytes += base.nbytes
+        finally:
+            self._lock.release()
+
+    # ------------------------------------------------------- telemetry
+
+    def _publish(self, hit: bool) -> None:
+        if not telemetry.enabled():
+            return
+        telemetry.counter_add(
+            "staging_pool_hits" if hit else "staging_pool_misses", 1
+        )
+        with self._lock:
+            free_b, out_b = self._free_bytes, self._outstanding
+        telemetry.gauge_set("staging_pool_free_bytes", free_b)
+        telemetry.gauge_set("staging_pool_outstanding_bytes", out_b)
+
+    # ---------------------------------------------------------- warmup
+
+    def can_recycle(self) -> bool:
+        """True when ``get`` actually draws from (and returns to) the
+        free lists — native slabs anywhere, PEP 688 holders on 3.12+."""
+        return self._native_ok() or _BUFFER_PROTOCOL_OK
 
     def prewarm(self, sizes: Sequence[int]) -> int:
         """Pre-fault slabs so the FIRST staging pass doesn't pay them.
@@ -163,21 +346,40 @@ class _StagingPool:
         async_take blocks far longer than a warm one. ``sizes`` is a
         multiset of exact staged-buffer sizes (the pool's free lists are
         exact-size); slabs already pooled count toward it. Returns the
-        bytes newly faulted. Bounded by the pool limit."""
+        bytes newly faulted. Bounded by the pool limit. Native slabs are
+        pre-faulted by the allocator itself (deterministically, at slab
+        construction), so warming them is pure allocation."""
         from collections import Counter
 
-        if not _BUFFER_PROTOCOL_OK:  # pool is never drawn from pre-3.12
-            return 0
-        want = Counter(int(s) for s in sizes if s > 0)
+        self._integrate_deferred()
+        native = self._native_ok()
+        if not native and not _BUFFER_PROTOCOL_OK:
+            return 0  # pool is never drawn from: warming would pin waste
+        want = Counter(
+            int(s)
+            for s in sizes
+            if s >= (_NATIVE_SLAB_MIN_BYTES if native else 1)
+        )
         warmed = 0
         for nbytes, cnt in want.items():
             with self._lock:
                 missing = cnt - len(self._free.get(nbytes, []))
                 room = (self._limit - self._free_bytes) // nbytes if nbytes else 0
             for _ in range(min(missing, room)):
-                slab = np.empty(nbytes, np.uint8)
-                slab.fill(0)  # touch every page
-                self._put(slab)
+                if native:
+                    from .. import _native
+
+                    view = _native.slab_view(nbytes)
+                    if view is None:
+                        return warmed
+                    self._store_native(view)
+                else:
+                    slab = np.empty(nbytes, np.uint8)
+                    slab.fill(0)  # touch every page
+                    with self._lock:
+                        if self._free_bytes + nbytes <= self._limit:
+                            self._free.setdefault(nbytes, []).append(slab)
+                            self._free_bytes += nbytes
                 warmed += nbytes
         return warmed
 
@@ -200,9 +402,14 @@ def pooled_buffer(nbytes: int) -> np.ndarray:
     recycled by the GC when every reference dies (see _StagingPool).
 
     The public face of the pool for the other byte movers on the restore
-    hot path — the fs plugin's pread windows and the cooperative-restore
-    peer receiver (fanout.py) — so repeated sub-chunk buffers don't pay
-    first-touch page faults on every window/frame."""
+    hot path — the fs plugin's pread windows (Python and native engine
+    alike) and the cooperative-restore peer receiver (fanout.py) — so
+    repeated sub-chunk buffers don't pay first-touch page faults on
+    every window/frame. With the native extension present, buffers of at
+    least ``_NATIVE_SLAB_MIN_BYTES`` are page-aligned pinned slabs —
+    valid O_DIRECT/io_uring targets — and the alignment/lifetime
+    contract (aligned reuse, derived views pin the slab, never recycled
+    while an SQE holds it) is pinned by tests/test_native_io.py."""
     return _staging_pool.get(nbytes)
 
 
@@ -361,11 +568,13 @@ def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
 
     No-op (returns 0) whenever staging cannot draw from the pool: the
     pool feeds only the fused copy+CRC path (``_stage_fused``), which
-    needs the PEP 688 holder (Python >= 3.12), the native extension, and
-    checksums enabled — warming slabs no save will ever draw would pin
-    pool-limit bytes for nothing. Dedup (incremental) and compression
-    also bypass the pool; CheckpointManager.warmup checks those, since
-    they are its configuration rather than process state.
+    needs the native extension (whose pinned slab allocator also makes
+    the pool recycle on every interpreter — the PEP 688 holder covers
+    native-absent 3.12+ hosts) and checksums enabled — warming slabs no
+    save will ever draw would pin pool-limit bytes for nothing. Dedup
+    (incremental) and compression also bypass the pool;
+    CheckpointManager.warmup checks those, since they are its
+    configuration rather than process state.
 
     Sizes mirror the write partition: for GSPMD-sharded jax arrays the
     exact owned-piece sizes this process stages; large dense arrays
@@ -385,7 +594,11 @@ def warmup_staging(app_state, pg=None, replicated=None, save_dtype=None) -> int:
     from .._native import native_available
     from ..integrity import checksums_enabled
 
-    if not _BUFFER_PROTOCOL_OK or not native_available() or not checksums_enabled():
+    if (
+        not _staging_pool.can_recycle()
+        or not native_available()
+        or not checksums_enabled()
+    ):
         return 0
 
     sizes: List[int] = [

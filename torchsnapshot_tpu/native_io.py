@@ -1,0 +1,426 @@
+"""Native I/O fast path: engine objects + election over ``_native``.
+
+The pipeline's measured p50s are Python-pipeline-bound (ROADMAP item 4):
+per-sub-chunk executor hops and strictly-sequential pwrites/preads leave
+the kernel idle between chunks. This module is the Python face of the
+native engine that closes that gap:
+
+- **io_uring engine** (:class:`UringEngine`): sub-chunk positional
+  transfers become queued SQEs submitted with ``IOSQE_ASYNC`` — kernel
+  workers execute them while the Python side stages/CRCs the next chunk,
+  so a streamed entry runs ``queue_depth`` transfers deep instead of one.
+- **pwritev/preadv fallback** (:class:`PosixEngine`): when io_uring is
+  unavailable (old kernel, seccomp) but O_DIRECT is explicitly enabled,
+  plain positional syscalls against an O_DIRECT fd still bypass the page
+  cache for aligned slabs. Without O_DIRECT this tier adds nothing over
+  the existing ``_aio`` thread-pool path, so it is NOT elected.
+- **election** (:func:`elect` / ``IOGovernor.should_native_io``): the
+  governor measures the native engine like any plugin rate (the fs
+  plugin records per-stream rates under ``<Plugin>.native``) and elects
+  it the way it elects streaming — ``TORCHSNAPSHOT_TPU_NATIVE_IO``
+  ``auto`` (default) defers to the governor, ``always``/``never``
+  force. Build-absent, ``ENOSYS``, and permission failures all degrade
+  silently to the Python path; every election is recorded as a
+  ``governor.elect`` flight event + ``cat="governor"`` bus instant.
+
+Knobs: ``TORCHSNAPSHOT_TPU_NATIVE_QUEUE_DEPTH`` (SQEs in flight per
+stream, default 8), ``TORCHSNAPSHOT_TPU_NATIVE_ALIGN`` (O_DIRECT
+alignment, default 4096), ``TORCHSNAPSHOT_TPU_NATIVE_ODIRECT`` (``1``
+opts the write path into O_DIRECT where alignment permits; default off —
+tmpfs rejects it and NVMe deployments opt in deliberately).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+NATIVE_IO_ENV_VAR = "TORCHSNAPSHOT_TPU_NATIVE_IO"
+NATIVE_QD_ENV_VAR = "TORCHSNAPSHOT_TPU_NATIVE_QUEUE_DEPTH"
+NATIVE_ALIGN_ENV_VAR = "TORCHSNAPSHOT_TPU_NATIVE_ALIGN"
+NATIVE_ODIRECT_ENV_VAR = "TORCHSNAPSHOT_TPU_NATIVE_ODIRECT"
+
+_DEFAULT_QUEUE_DEPTH = 8
+_DEFAULT_ALIGN = 4096
+
+
+def native_io_mode() -> str:
+    """THE parser for ``TORCHSNAPSHOT_TPU_NATIVE_IO`` (mirrors
+    ``stream_reads_mode``): ``never`` disables the native engine,
+    ``always`` elects it whenever the probe succeeds, default ``auto``
+    defers to the governor's measured-rate election."""
+    raw = os.environ.get(NATIVE_IO_ENV_VAR, "auto").strip().lower()
+    if raw in ("0", "false", "off", "no", "never"):
+        return "never"
+    if raw in ("1", "always", "force", "on"):
+        return "always"
+    return "auto"
+
+
+def queue_depth() -> int:
+    raw = os.environ.get(NATIVE_QD_ENV_VAR, "").strip()
+    if raw:
+        try:
+            return max(1, min(256, int(raw)))
+        except ValueError:
+            logger.warning("ignoring non-integer %s=%r", NATIVE_QD_ENV_VAR, raw)
+    return _DEFAULT_QUEUE_DEPTH
+
+
+def alignment() -> int:
+    raw = os.environ.get(NATIVE_ALIGN_ENV_VAR, "").strip()
+    if raw:
+        try:
+            val = int(raw)
+            if val > 0 and (val & (val - 1)) == 0:
+                return val
+            logger.warning("%s=%r is not a power of two; using default",
+                           NATIVE_ALIGN_ENV_VAR, raw)
+        except ValueError:
+            logger.warning("ignoring non-integer %s=%r", NATIVE_ALIGN_ENV_VAR, raw)
+    return _DEFAULT_ALIGN
+
+
+def odirect_enabled() -> bool:
+    raw = os.environ.get(NATIVE_ODIRECT_ENV_VAR, "0").strip().lower()
+    return raw not in ("", "0", "false", "no", "off")
+
+
+# ------------------------------------------------------------- engines
+
+
+def _os_error(code: int, what: str) -> OSError:
+    err = -code
+    return OSError(err, f"{what}: {os.strerror(err)}")
+
+
+class UringEngine:
+    """One io_uring ring driving one stream's sub-chunk transfers.
+
+    Buffer lifetime contract (pinned by tests/test_native_io.py): every
+    submitted buffer is referenced by the engine until its slot is
+    waited (or the engine drains/closes) — a pooled staging slab can
+    never be recycled while the kernel may still touch it. Not
+    thread-safe: callers serialize submit/wait/drain (the fs plugin's
+    awaited executor hops already do)."""
+
+    kind = "uring"
+
+    def __init__(self, handle: int, depth: int) -> None:
+        self._h: Optional[int] = handle
+        self.depth = depth
+        self._bufs: Dict[int, object] = {}
+
+    @property
+    def inflight(self) -> int:
+        return len(self._bufs)
+
+    def _submit(self, is_write: bool, fd: int, buf, offset: int) -> int:
+        from . import _native
+
+        arr, addr = _native._as_flat_u8(buf, writable_target=not is_write)
+        slot = _native.uring_submit(
+            self._h, is_write, fd, addr, arr.nbytes, offset
+        )
+        if slot < 0:
+            raise _os_error(slot, "io_uring submit")
+        # `arr` views (and therefore pins) the caller's buffer; holding
+        # it holds the slab until the kernel is done with it.
+        self._bufs[slot] = arr
+        return slot
+
+    def submit_pwrite(self, fd: int, buf, offset: int) -> int:
+        return self._submit(True, fd, buf, offset)
+
+    def submit_pread(self, fd: int, buf, offset: int) -> int:
+        return self._submit(False, fd, buf, offset)
+
+    # The C engine offsets transport-layer errors (io_uring_enter itself
+    # failing while ops may still be live in the kernel) by this, so the
+    # Python side can tell "the op finished (badly)" from "the op may
+    # still be running": for the latter the buffer pin is KEPT — it is
+    # released by close(), whose C side drains the ring first.
+    _TRANSPORT_ERR_OFFSET = 4096
+
+    def wait(self, slot: int, what: str = "io_uring op") -> None:
+        """Block until ``slot`` completes; releases the engine's buffer
+        pin. EOF inside a requested read range surfaces as ``EOFError``
+        (the taxonomy the buffered fs path and mirror failover speak)."""
+        from . import _native
+
+        code = _native.uring_wait_slot(self._h, slot)
+        if code <= -self._TRANSPORT_ERR_OFFSET:
+            # The op may still be executing: the slab must stay pinned
+            # or the pool could recycle it under a live kernel write.
+            raise _os_error(
+                code + self._TRANSPORT_ERR_OFFSET, f"io_uring wait ({what})"
+            )
+        self._bufs.pop(slot, None)
+        if code == 0:
+            return
+        if code == -61:  # ENODATA: the C engine's EOF marker
+            raise EOFError(f"short read: {what} ended before the requested range")
+        raise _os_error(code, what)
+
+    def drain(self) -> None:
+        from . import _native
+
+        code = _native.uring_drain(self._h)
+        if code <= -self._TRANSPORT_ERR_OFFSET:
+            # Slots were not released; pins stay until close() drains.
+            raise _os_error(
+                code + self._TRANSPORT_ERR_OFFSET, "io_uring drain"
+            )
+        self._bufs.clear()
+        if code != 0:
+            raise _os_error(code, "io_uring drain")
+
+    def close(self) -> None:
+        from . import _native
+
+        if self._h is not None:
+            # ts_uring_close drains outstanding kernel ops before the
+            # ring dies, so dropping the buffer pins afterwards is safe.
+            _native.uring_close(self._h)
+            self._h = None
+        self._bufs.clear()
+
+    def __del__(self) -> None:
+        # Backstop for engines abandoned before their stream ran (a
+        # ReadStream never iterated, setup failing before the stream's
+        # finally): the ring fd + its three mmaps must not leak for the
+        # life of the process. Idempotent with close().
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - finalizer must never raise
+            pass
+
+
+class PosixEngine:
+    """Fallback tier: synchronous pwrite/preadv against (optionally
+    O_DIRECT) fds, with the same call surface as :class:`UringEngine`.
+    Ops complete at submit time; wait/drain only surface errors."""
+
+    kind = "posix"
+    depth = 1
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    @property
+    def inflight(self) -> int:
+        return 0
+
+    def _full_pwrite(self, fd: int, mv: memoryview, offset: int) -> None:
+        written = 0
+        while written < mv.nbytes:
+            written += os.pwrite(fd, mv[written:], offset + written)
+
+    def _full_pread(self, fd: int, buf, offset: int) -> None:
+        view = memoryview(buf).cast("B")
+        got = 0
+        while got < view.nbytes:
+            n = os.preadv(fd, [view[got:]], offset + got)
+            if n == 0:
+                raise EOFError(
+                    f"short read: fd {fd} yielded {got} of {view.nbytes} "
+                    f"bytes (offset {offset})"
+                )
+            got += n
+
+    def submit_pwrite(self, fd: int, buf, offset: int) -> int:
+        self._full_pwrite(fd, memoryview(buf).cast("B"), offset)
+        self._next += 1
+        return self._next - 1
+
+    def submit_pread(self, fd: int, buf, offset: int) -> int:
+        self._full_pread(fd, buf, offset)
+        self._next += 1
+        return self._next - 1
+
+    def wait(self, slot: int, what: str = "") -> None:
+        return None
+
+    def drain(self) -> None:
+        return None
+
+    def close(self) -> None:
+        return None
+
+
+# ------------------------------------------------------------ probing
+
+# Cached capability probe: "uring" | "posix" | None. One probe per
+# process — ENOSYS/EPERM/build-absent all land on None (or "posix" when
+# O_DIRECT is explicitly enabled) and the Python path takes over
+# silently, exactly once logged.
+_probe_lock = threading.Lock()
+_probe_done = False
+_probe_kind: Optional[str] = None
+
+
+def engine_kind() -> Optional[str]:
+    global _probe_done, _probe_kind
+    if _probe_done:
+        return _probe_kind
+    with _probe_lock:
+        if _probe_done:
+            return _probe_kind
+        kind: Optional[str] = None
+        try:
+            from . import _native
+
+            if _native.native_available():
+                rc = _native.uring_probe()
+                if rc == 0:
+                    kind = "uring"
+                else:
+                    logger.info(
+                        "io_uring unavailable (%s); native I/O %s",
+                        os.strerror(-rc) if rc < 0 else rc,
+                        "degrades to pwritev/O_DIRECT" if odirect_enabled()
+                        else "disabled (Python path)",
+                    )
+                    # The posix tier only beats the existing thread-pool
+                    # path when O_DIRECT is in play; otherwise it is the
+                    # same syscalls with extra indirection.
+                    kind = "posix" if odirect_enabled() else None
+        except Exception as e:  # noqa: BLE001 - probe must never raise
+            logger.info("native I/O probe failed (%s); using Python path", e)
+            kind = None
+        _probe_kind = kind
+        _probe_done = True
+    return _probe_kind
+
+
+def _reset_probe_for_tests() -> None:
+    global _probe_done, _probe_kind
+    _probe_done = False
+    _probe_kind = None
+
+
+def open_engine() -> Optional[object]:
+    """A fresh engine for one stream, or None (degrade silently)."""
+    kind = engine_kind()
+    if kind == "uring":
+        from . import _native
+
+        depth = queue_depth()
+        handle = _native.uring_init(depth)
+        if handle is None:
+            return None
+        return UringEngine(handle, depth)
+    if kind == "posix":
+        return PosixEngine()
+    return None
+
+
+# ----------------------------------------------------------- O_DIRECT
+
+
+def open_for_write(path: str) -> Tuple[int, bool]:
+    """Open ``path`` for the native write stream: ``(fd, direct)``.
+    O_DIRECT is attempted only when explicitly enabled (NVMe knob) and
+    falls back transparently where the filesystem rejects it (tmpfs)."""
+    flags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+    if odirect_enabled() and hasattr(os, "O_DIRECT"):
+        try:
+            return os.open(path, flags | os.O_DIRECT, 0o644), True
+        except OSError:
+            pass
+    return os.open(path, flags, 0o644), False
+
+
+def clear_direct(fd: int) -> None:
+    """Drop O_DIRECT from an open fd (the unaligned-tail escape)."""
+    import fcntl
+
+    fcntl.fcntl(fd, fcntl.F_SETFL, fcntl.fcntl(fd, fcntl.F_GETFL) & ~os.O_DIRECT)
+
+
+def io_aligned(mv: memoryview, offset: int) -> bool:
+    """True when (address, length, file offset) all satisfy the
+    configured O_DIRECT alignment."""
+    import numpy as np
+
+    align = alignment()
+    if offset % align or mv.nbytes % align:
+        return False
+    addr = np.frombuffer(mv, np.uint8).ctypes.data if mv.nbytes else 0
+    return addr % align == 0
+
+
+# ----------------------------------------------------------- election
+
+# Last recorded election per (op, plugin): elections fire per stream
+# (per entry), so identical repeats are deduped to keep the flight ring
+# signal-dense while every CHANGE is recorded.
+_election_seen: Dict[Tuple[str, str], Tuple] = {}
+_election_lock = threading.Lock()
+
+
+def elect(op: str, plugin_key: str) -> bool:
+    """Should this stream use the native engine? ``op`` is "write" or
+    "read"; ``plugin_key`` the storage plugin class name."""
+    mode = native_io_mode()
+    if mode == "never":
+        return False
+    kind = engine_kind()
+    if kind is None:
+        return False
+    if kind == "posix" and op == "read":
+        # The posix tier's only advantage is O_DIRECT, which applies to
+        # the write fd alone — for reads it would serialize each pread
+        # with consumption (depth 1, synchronous submit) and LOSE the
+        # Python path's dispatched read-ahead. Never elect it there.
+        return False
+    if mode == "always":
+        decision = True
+    else:
+        from .scheduler import io_governor
+
+        decision = io_governor().should_native_io(plugin_key, op=op)
+    _record(op, plugin_key, mode, kind, decision)
+    return decision
+
+
+def _record(op: str, plugin_key: str, mode: str, kind: str, decision: bool) -> None:
+    from .scheduler import io_governor
+
+    governor = io_governor()
+    rate = governor.read_bps if op == "read" else governor.write_bps
+    fields = (
+        mode,
+        kind,
+        decision,
+        queue_depth(),
+    )
+    with _election_lock:
+        if _election_seen.get((op, plugin_key)) == fields:
+            return
+        _election_seen[(op, plugin_key)] = fields
+    from . import telemetry
+
+    telemetry.record_election(
+        site="native_io",
+        op=op,
+        plugin=plugin_key,
+        mode=mode,
+        engine=kind,
+        elected=decision,
+        queue_depth=queue_depth(),
+        native_bps=rate(f"{plugin_key}.native"),
+        python_bps=rate(plugin_key),
+    )
+
+
+def maybe_engine(op: str, plugin_key: str) -> Optional[object]:
+    """The fs plugin's one-call entry: elected AND openable, else None
+    (callers fall back to the Python path with no behavioral change)."""
+    if not elect(op, plugin_key):
+        return None
+    return open_engine()

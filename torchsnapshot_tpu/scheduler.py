@@ -169,6 +169,10 @@ _SUB_CHUNK_TARGET_SECONDS = 0.05
 # the margin absorbs rate-measurement noise and the HtoD cost a read
 # still pays after the storage fetch.
 _PREVERIFY_READ_MARGIN = 1.25
+# Depose an elected native engine only when its measured rate falls
+# clearly below the plugin's non-native rate — hysteresis against the
+# two meters' different windows (whole pipeline vs one stream).
+_NATIVE_FALLBACK_MARGIN = 0.75
 
 
 class IOGovernor:
@@ -345,6 +349,40 @@ class IOGovernor:
         if hash_bps is None or read_bps is None:
             return True  # no evidence: keep the zero-byte verify path
         return read_bps <= hash_bps * _PREVERIFY_READ_MARGIN
+
+    def should_native_io(self, plugin: Optional[str] = None, op: str = "write") -> bool:
+        """Economic gate for the native I/O engine (native_io.py, under
+        ``TORCHSNAPSHOT_TPU_NATIVE_IO=auto``). The fs plugin records
+        per-stream native-engine rates under ``<Plugin>.native`` — the
+        same EWMA tables every plugin rate lands in — so the engine is
+        measured like any backend and elected like streaming:
+
+        - **writes**: optimistic while unmeasured (the way streaming
+          writes default on — queued SQEs are never worse than the
+          sequential pwrite loop), deposed only when the engine's own
+          measured rate falls clearly below what the pipeline achieves
+          without it. The margin absorbs the mismatch between the two
+          meters (the plugin-keyed rate spans the whole pipeline; the
+          ``.native`` rate one stream).
+        - **reads**: the streamed-read latency knee. Queue depth pays
+          where per-request transport latency can hide behind it; on
+          memcpy-speed local reads (page cache) the engine measurably
+          loses to the mmap/pread paths, so native reads engage only on
+          measured latency-bound storage (no measurement = no evidence
+          = Python path, the read-side status quo bias)."""
+        table = self._read_bps if op == "read" else self._write_bps
+        with self._lock:
+            native = table.get(f"{plugin}.native") if plugin else None
+            base = table.get(plugin) if plugin else None
+        if op == "read":
+            if base is None or base >= _STREAM_READ_LATENCY_BPS:
+                return False
+            if native is None:
+                return True
+            return native >= _NATIVE_FALLBACK_MARGIN * base
+        if native is None or base is None:
+            return True  # no evidence against it: gather measurements
+        return native >= _NATIVE_FALLBACK_MARGIN * base
 
     def should_coop_restore(self, plugin: Optional[str] = None) -> bool:
         """Economic gate for cooperative restore fan-out (fanout.py,

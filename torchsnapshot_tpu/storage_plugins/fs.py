@@ -127,7 +127,20 @@ class FSStoragePlugin(StoragePlugin):
         pwrites into the SAME temp file, published atomically with
         ``os.replace`` only after the final chunk — a crash or mid-stream
         failure can never leave a partial payload at the final path, and
-        the fsync contract matches the buffered path exactly."""
+        the fsync contract matches the buffered path exactly.
+
+        When the IOGovernor elects the native engine (native_io.py),
+        sub-chunk pwrites become queued io_uring SQEs executed by kernel
+        workers instead of sequential executor-thread syscalls — same
+        bytes, same checksum chaining (the stager owns the CRC), same
+        temp-file atomicity; election failure of any kind degrades
+        silently to the path below."""
+        from .. import native_io
+
+        engine = native_io.maybe_engine("write", type(self).__name__)
+        if engine is not None:
+            await self._write_stream_native(stream, engine)
+            return
         path = os.path.join(self.root, stream.path)
         await self._ensure_parent(path)
         tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
@@ -156,6 +169,96 @@ class FSStoragePlugin(StoragePlugin):
                 await loop.run_in_executor(
                     None, _fsync_path, os.path.dirname(path) or "."
                 )
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    async def _write_stream_native(self, stream: WriteStream, engine) -> None:
+        """io_uring-backed ``write_stream``: each sub-chunk is submitted
+        as one SQE (``IOSQE_ASYNC`` — kernel workers move the bytes) and
+        the producer immediately stages the next chunk, so the stream
+        runs ``queue_depth`` transfers deep instead of one. Completions
+        are reaped oldest-first once the window fills (releasing the
+        engine's pin on that chunk's staging slab), the final drain
+        surfaces any queued error BEFORE the short-write check, and the
+        temp-file + ``os.replace`` + fsync contract is byte-identical to
+        the Python path."""
+        from .. import native_io, telemetry
+
+        path = os.path.join(self.root, stream.path)
+        loop = asyncio.get_running_loop()
+        t0 = telemetry.monotonic()
+        # Everything up to the fd open can raise (EACCES/EROFS/ENOSPC);
+        # the engine must be closed on THAT window too or its ring fd +
+        # mmaps leak per attempt. close() is idempotent, so the inner
+        # finally's close (ordered before os.close(fd), which the drain
+        # needs) composes with this outer guard.
+        try:
+            await self._ensure_parent(path)
+            tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
+            fd, direct = await loop.run_in_executor(
+                None, native_io.open_for_write, tmp
+            )
+        except BaseException:
+            engine.close()
+            raise
+        offset = 0
+        pending: list = []
+        try:
+            try:
+                async for chunk in stream.chunks:
+                    buf = faultinject.mutate("fs.native_pwrite", chunk)
+                    mv = memoryview(buf).cast("B")
+                    if mv.nbytes:
+                        if direct and not native_io.io_aligned(mv, offset):
+                            # Unaligned tail: drop O_DIRECT for the rest
+                            # of the stream (already-queued aligned ops
+                            # are valid under either flag state).
+                            await loop.run_in_executor(
+                                None, native_io.clear_direct, fd
+                            )
+                            direct = False
+                        while len(pending) >= engine.depth:
+                            with telemetry.span("native_write", cat="storage"):
+                                await loop.run_in_executor(
+                                    None, engine.wait, pending.pop(0), tmp
+                                )
+                        pending.append(
+                            await loop.run_in_executor(
+                                None, engine.submit_pwrite, fd, mv, offset
+                            )
+                        )
+                    offset += mv.nbytes
+                with telemetry.span("native_write", cat="storage", bytes=offset):
+                    await loop.run_in_executor(None, engine.drain)
+                pending.clear()
+                if offset != stream.nbytes:
+                    raise IOError(
+                        f"short write stream for {stream.path!r}: produced "
+                        f"{offset} of {stream.nbytes} bytes"
+                    )
+                if self._fsync:
+                    await loop.run_in_executor(None, os.fsync, fd)
+            finally:
+                await loop.run_in_executor(None, engine.close)
+                os.close(fd)
+            await aiofiles.os.replace(tmp, path)
+            if self._fsync:
+                await loop.run_in_executor(
+                    None, _fsync_path, os.path.dirname(path) or "."
+                )
+            # The engine is measured like any plugin: its achieved rate
+            # lands in the governor's EWMA tables under the `.native`
+            # key, which is what the auto election compares.
+            telemetry.record_rate(
+                "write",
+                f"{type(self).__name__}.native",
+                offset,
+                telemetry.monotonic() - t0,
+            )
         except BaseException:
             try:
                 os.remove(tmp)
@@ -282,6 +385,19 @@ class FSStoragePlugin(StoragePlugin):
             lo, hi = read_io.byte_range
             size = max(0, hi - lo)
 
+        if size > 0:
+            from .. import native_io
+
+            engine = native_io.maybe_engine("read", type(self).__name__)
+            if engine is not None:
+                return ReadStream(
+                    path=read_io.path,
+                    nbytes=size,
+                    chunks=self._native_read_chunks(
+                        engine, path, lo, size, sub_chunk_bytes
+                    ),
+                )
+
         async def chunks():
             if size <= 0:
                 return
@@ -318,6 +434,58 @@ class FSStoragePlugin(StoragePlugin):
                 os.close(fd)
 
         return ReadStream(path=read_io.path, nbytes=size, chunks=chunks())
+
+    async def _native_read_chunks(
+        self, engine, path: str, lo: int, size: int, sub_chunk_bytes: int
+    ):
+        """io_uring-backed sub-chunk reads: up to ``queue_depth`` pread
+        windows are queued at once (vs the Python path's one-window
+        read-ahead), each landing in a pinned pooled slab, and yielded
+        strictly in submission order — the same ordered-stream contract
+        ``read_stream`` documents. The engine pins every slab until its
+        completion is reaped, so pool recycling can never alias an
+        in-flight window."""
+        from .. import native_io, telemetry  # noqa: F401 (native_io: doc anchor)
+        from ..io_preparers.array import pooled_buffer
+
+        loop = asyncio.get_running_loop()
+        spans = [
+            (o, min(o + sub_chunk_bytes, lo + size))
+            for o in range(lo, lo + size, sub_chunk_bytes)
+        ]
+        t0 = telemetry.monotonic()
+        fd = os.open(path, os.O_RDONLY)
+        pending: list = []
+
+        def _submit(span):
+            wlo, whi = span
+            buf = pooled_buffer(whi - wlo)
+            return engine.submit_pread(fd, buf, wlo), buf
+
+        try:
+            nxt = 0
+            for _ in range(min(engine.depth, len(spans))):
+                pending.append(await loop.run_in_executor(None, _submit, spans[nxt]))
+                nxt += 1
+            while pending:
+                slot, buf = pending.pop(0)
+                with telemetry.span("native_read", cat="storage", bytes=buf.nbytes):
+                    await loop.run_in_executor(None, engine.wait, slot, path)
+                if nxt < len(spans):
+                    pending.append(
+                        await loop.run_in_executor(None, _submit, spans[nxt])
+                    )
+                    nxt += 1
+                yield memoryview(faultinject.mutate("fs.native_pread", buf))
+            telemetry.record_rate(
+                "read",
+                f"{type(self).__name__}.native",
+                size,
+                telemetry.monotonic() - t0,
+            )
+        finally:
+            await loop.run_in_executor(None, engine.close)
+            os.close(fd)
 
     async def delete(self, path: str) -> None:
         await aiofiles.os.remove(os.path.join(self.root, path))

@@ -56,6 +56,7 @@ CATEGORIES: Tuple[str, ...] = (
     "storage_read",     # bytes moving from the storage tier
     "decode",           # verify/decompress/HtoD on the restore side
     "peer_transfer",    # cooperative fan-out byte redistribution
+    "native_io",        # blocked on the native engine (io_uring reap/drain)
     "collective_wait",  # blocked inside a KV-store collective
     "sched_idle",       # wall no instrumented work covered (budget
                         # defers, event-loop gaps, un-spanned work)
@@ -77,6 +78,11 @@ SPAN_CATEGORIES: Dict[str, str] = {
     "coop_read": "peer_transfer",
     "peer_send": "peer_transfer",
     "peer_recv": "peer_transfer",
+    # Native-engine waits (fs plugin, io_uring reap/drain): time the
+    # pipeline spent blocked on queued kernel I/O — submissions are
+    # non-blocking, so these spans ARE the engine's storage wait.
+    "native_write": "native_io",
+    "native_read": "native_io",
     "collective_wait": "collective_wait",
 }
 
@@ -89,14 +95,15 @@ SPAN_CATEGORIES: Dict[str, str] = {
 #: span with no instrumented pipeline work running, i.e. waiting on the
 #: residual resource — attributes to the residual category.
 FUSED_SPANS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
-    "stream_write": ("storage_write", ("stage_copy", "hash")),
-    "stream_read": ("storage_read", ("decode", "peer_transfer")),
+    "stream_write": ("storage_write", ("stage_copy", "hash", "native_io")),
+    "stream_read": ("storage_read", ("decode", "peer_transfer", "native_io")),
     "stage": ("stage_copy", ("hash", "stage_copy")),
 }
 
 _CATEGORY_CLASS: Dict[str, str] = {
     "storage_write": "storage",
     "storage_read": "storage",
+    "native_io": "storage",
     "collective_wait": "coordination",
 }
 
@@ -134,6 +141,12 @@ _HINTS: Dict[str, str] = {
         "peer-transfer-bound on rank(s) {ranks} — the host network is "
         "the bottleneck; shrink the cooperative fan-out "
         "(TORCHSNAPSHOT_TPU_COOP_RESTORE=never) or widen the NIC"
+    ),
+    "native_io": (
+        "native-engine-bound at {rate} on rank(s) {ranks} — the "
+        "io_uring queue is the bottleneck: raise "
+        "TORCHSNAPSHOT_TPU_NATIVE_QUEUE_DEPTH, or move the tier to "
+        "faster storage (the Python pipeline is already off the path)"
     ),
     "collective_wait": (
         "coordination-bound — rank(s) {ranks} spent the critical path "
@@ -492,6 +505,10 @@ def _binding_bytes(
         "storage_read": aggregate.get("bytes_read"),
         "stage_copy": aggregate.get("bytes_staged"),
         "peer_transfer": aggregate.get("bytes_to_peers"),
+        # The native engine moves whichever direction the op ran; saves
+        # dominate in practice and a restore-bound native path reports
+        # bytes_read through storage_read's row anyway.
+        "native_io": aggregate.get("bytes_written") or aggregate.get("bytes_read"),
     }.get(category)
 
 
