@@ -1,225 +1,33 @@
 #!/usr/bin/env python
-"""Lint: flight-recorder event names AND histogram instrument names are
-registered literals, and both registries are fully wired.
+"""Lint: flight-recorder events and histograms use the taxonomy (thin wrapper).
 
-The flight recorder (torchsnapshot_tpu/telemetry/flightrec.py) is always
-on: its event stream is an operator interface — the ``blackbox`` CLI
-merges rank dumps by matching event names, runbooks grep for them, tests
-assert on them. Three properties keep that interface trustworthy, in the
-same lint culture as ``check_fault_sites.py``:
-
-1. **Registered names only.** Every ``flightrec.record(...)`` call in
-   the package must pass a STRING LITERAL present in
-   ``telemetry.events.FLIGHT_EVENTS`` — a typo'd name would record
-   events nothing can find.
-2. **No dead registry rows.** Every registered name must be recorded at
-   one or more call sites (unlike fault sites, multiplicity is fine:
-   ``collective.enter`` fires from every collective verb); a registered-
-   but-unwired name means a documented event that can never occur.
-3. **Literal-first calls.** The event name must be the literal first
-   argument — computed names are unlintable and ungreppable.
-
-The latency-histogram instrument (``telemetry.histogram_observe``, ISSUE
-8) gets the same treatment against ``taxonomy.HISTOGRAM_NAMES``: fleet
-merges sum bucket-wise BY NAME and the /metrics exposition names
-families by it, so a typo'd instrument would silently fork a family no
-dashboard watches. Every ``histogram_observe(...)`` call in the package
-must pass a registered literal first argument, and every registered name
-must be observed somewhere.
-
-Run: ``python scripts/check_event_taxonomy.py`` — exits 0 when clean, 1
-with a per-violation report. Enforced in tier-1 via
-tests/test_flightrec.py.
+The implementation moved into the ``tsalint`` static-analysis framework
+(``torchsnapshot_tpu/analysis/plugins/legacy_event_taxonomy.py``, rule
+id ``event-taxonomy``) — run it standalone here, as ``python -m
+torchsnapshot_tpu lint --rule event-taxonomy``, or as part of the full
+``tsalint`` run. This wrapper keeps the historical entry point and
+re-exports the names tier-1 tests exercise; output and exit codes are
+bit-identical.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-sys.path.insert(0, REPO)
-
-from torchsnapshot_tpu.telemetry.taxonomy import (  # noqa: E402
+from torchsnapshot_tpu.analysis.plugins.legacy_event_taxonomy import (  # noqa: E402,F401
     FLIGHT_EVENTS,
     HISTOGRAMS,
+    MIN_EVENTS,
+    MIN_HISTOGRAMS,
+    PACKAGE,
+    REPO,
+    check_source,
+    main,
+    run,
 )
 
-# Names a module may bind the flightrec module to. Calls are recognized
-# as ``<alias>.record(...)`` or ``telemetry.flightrec.record(...)``.
-_MODULE_NAME = "flightrec"
-
-# Regression floor: the taxonomy shipped with this many events (ISSUE 7).
-# Shrinking it means an operator-facing event class was silently dropped.
-MIN_EVENTS = 15
-# Same floor for histogram instruments (ISSUE 8).
-MIN_HISTOGRAMS = 5
-
-
-def _is_flightrec_record(fn: ast.AST, aliases: set) -> bool:
-    """True for ``<alias>.record`` and ``<mod>.flightrec.record``."""
-    if not (isinstance(fn, ast.Attribute) and fn.attr == "record"):
-        return False
-    val = fn.value
-    if isinstance(val, ast.Name) and val.id in aliases:
-        return True
-    return isinstance(val, ast.Attribute) and val.attr == _MODULE_NAME
-
-
-def _is_histogram_observe(fn: ast.AST) -> bool:
-    """True for ``<anything>.histogram_observe`` and a bare
-    ``histogram_observe`` name (``from ... import histogram_observe``)."""
-    if isinstance(fn, ast.Attribute) and fn.attr == "histogram_observe":
-        return True
-    return isinstance(fn, ast.Name) and fn.id == "histogram_observe"
-
-
-def check_source(
-    source: str, filename: str
-) -> Tuple[List[Tuple[int, str]], Dict[str, List[int]], Dict[str, List[int]]]:
-    """Return (violations, {event_name: [lines]}, {hist_name: [lines]})
-    for one file."""
-    tree = ast.parse(source, filename=filename)
-    violations: List[Tuple[int, str]] = []
-    uses: Dict[str, List[int]] = {}
-    hist_uses: Dict[str, List[int]] = {}
-    aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name.split(".")[-1] == _MODULE_NAME:
-                    aliases.add(alias.asname or alias.name.split(".")[0])
-        elif isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name == _MODULE_NAME:
-                    aliases.add(alias.asname or alias.name)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _is_histogram_observe(node.func):
-            if not node.args or not (
-                isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-            ):
-                violations.append(
-                    (
-                        node.lineno,
-                        "histogram_observe(...) — the instrument name must "
-                        "be a string literal",
-                    )
-                )
-                continue
-            name = node.args[0].value
-            if name not in HISTOGRAMS:
-                violations.append(
-                    (
-                        node.lineno,
-                        f"histogram_observe({name!r}) — instrument not "
-                        "registered in telemetry/taxonomy.py",
-                    )
-                )
-                continue
-            hist_uses.setdefault(name, []).append(node.lineno)
-            continue
-        if not _is_flightrec_record(node.func, aliases):
-            continue
-        if not node.args or not (
-            isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            violations.append(
-                (
-                    node.lineno,
-                    "flightrec.record(...) — the event name must be a "
-                    "string literal",
-                )
-            )
-            continue
-        name = node.args[0].value
-        if name not in FLIGHT_EVENTS:
-            violations.append(
-                (
-                    node.lineno,
-                    f"flightrec.record({name!r}) — event not registered in "
-                    "telemetry/taxonomy.py",
-                )
-            )
-            continue
-        uses.setdefault(name, []).append(node.lineno)
-    return violations, uses, hist_uses
-
-
-def run(package_dir: str = PACKAGE) -> List[str]:
-    failures: List[str] = []
-    wired: Dict[str, List[str]] = {}
-    hist_wired: Dict[str, List[str]] = {}
-    for dirpath, _dirnames, filenames in os.walk(package_dir):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            rel = os.path.relpath(os.path.join(dirpath, fname), package_dir)
-            if rel in (
-                os.path.join("telemetry", "flightrec.py"),
-                os.path.join("telemetry", "core.py"),
-            ):
-                continue  # the shims themselves
-            path = os.path.join(dirpath, fname)
-            with open(path, "r") as f:
-                source = f.read()
-            violations, uses, hist_uses = check_source(source, path)
-            for lineno, what in violations:
-                failures.append(f"{rel}:{lineno}: {what}")
-            for name, lines in uses.items():
-                for lineno in lines:
-                    wired.setdefault(name, []).append(f"{rel}:{lineno}")
-            for name, lines in hist_uses.items():
-                for lineno in lines:
-                    hist_wired.setdefault(name, []).append(f"{rel}:{lineno}")
-    # flight.dump is emitted by the dump machinery itself (the header
-    # record), not via record() — it is wired by construction.
-    wired.setdefault("flight.dump", ["telemetry/flightrec.py:dump"])
-    for name in sorted(FLIGHT_EVENTS - set(wired)):
-        failures.append(
-            f"event {name!r} is registered in telemetry/taxonomy.py but "
-            "recorded nowhere — remove the registration or wire the event"
-        )
-    for name in sorted(HISTOGRAMS - set(hist_wired)):
-        failures.append(
-            f"histogram {name!r} is registered in telemetry/taxonomy.py but "
-            "observed nowhere — remove the registration or wire the "
-            "instrument"
-        )
-    if len(FLIGHT_EVENTS) < MIN_EVENTS:
-        failures.append(
-            f"event taxonomy shrank to {len(FLIGHT_EVENTS)} (< {MIN_EVENTS}): "
-            "an operator-facing event class was dropped"
-        )
-    if len(HISTOGRAMS) < MIN_HISTOGRAMS:
-        failures.append(
-            f"histogram registry shrank to {len(HISTOGRAMS)} "
-            f"(< {MIN_HISTOGRAMS}): an operator-facing latency family was "
-            "dropped"
-        )
-    return failures
-
-
-def main() -> int:
-    failures = run()
-    if failures:
-        print("flight-recorder event taxonomy lint failures:", file=sys.stderr)
-        for failure in sorted(failures):
-            print(f"  {failure}", file=sys.stderr)
-        return 1
-    print(
-        f"event-taxonomy lint: clean ({len(FLIGHT_EVENTS)} events, "
-        f"{len(HISTOGRAMS)} histograms registered)"
-    )
-    return 0
-
-
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

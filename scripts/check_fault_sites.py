@@ -1,220 +1,33 @@
 #!/usr/bin/env python
-"""Lint: fault-injection sites are unique, registered, and shim-only.
+"""Lint: fault-injection sites are unique, registered, shim-only (thin wrapper).
 
-``torchsnapshot_tpu/faultinject.py`` threads named injection points
-through every I/O and coordination boundary. Three properties keep the
-subsystem trustworthy, and all three rot silently without enforcement:
-
-1. **Registered names only.** A ``faultinject.site("typo")`` would count
-   hits nobody can target from a plan; every call's name must be a
-   string literal present in ``faultinject.SITES``.
-2. **One call site per name.** A fault plan targets "the Nth hit of
-   fs.pwrite"; if two code paths shared the name, the Nth hit would
-   depend on interleaving and schedules would stop replaying. Each
-   registered name must appear at exactly ONE call in the package — and
-   every registered name must actually be wired (no dead registry rows).
-3. **Shim only.** Production modules may call ``faultinject.site`` and
-   ``faultinject.mutate`` and nothing else — reaching into the plan
-   object (or importing names out of the module) would bypass the
-   disabled-is-one-flag-check contract and make call sites unlintable.
-   ``configure``/``disable``/``refresh_from_env`` belong to tests,
-   benchmarks, and process bootstrap, not the pipeline.
-
-Run: ``python scripts/check_fault_sites.py`` — exits 0 when clean, 1
-with a per-violation report otherwise. Enforced in tier-1 via
-tests/test_faultinject.py.
+The implementation moved into the ``tsalint`` static-analysis framework
+(``torchsnapshot_tpu/analysis/plugins/legacy_fault_sites.py``, rule id
+``fault-sites``) — run it standalone here, as ``python -m
+torchsnapshot_tpu lint --rule fault-sites``, or as part of the full
+``tsalint`` run. This wrapper keeps the historical entry point and
+re-exports the names tier-1 tests exercise; output and exit codes are
+bit-identical.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-sys.path.insert(0, REPO)
-
-from torchsnapshot_tpu.faultinject import KNOWN_SITES  # noqa: E402
-
-# The shim: the only attributes production code may use on the module.
-ALLOWED_ATTRS = {"site", "mutate"}
-
-# Coordination-plane sites are additionally pinned to their module: the
-# replication/lease protocol's injection points (ISSUE 6) only mean what
-# the chaos schedules assume while they live on the dist_store
-# boundaries — a site name drifting into another file would silently
-# change what "kill the store host at the Nth serve" drills.
-PINNED_SITE_FILES = {
-    "dist_store.rpc": "dist_store.py",
-    "dist_store.serve_op": "dist_store.py",
-    "dist_store.replica_rpc": "dist_store.py",
-    "dist_store.lease_renew": "dist_store.py",
-    "peer.send_frame": "dist_store.py",
-    "peer.recv_frame": "dist_store.py",
-    # The native-engine sites (ISSUE 9) are pinned to the fs plugin: the
-    # chaos matrix's kill/transient/truncate drills through the io_uring
-    # path only mean what they assume while the sites sit on the fs
-    # plugin's native submit/yield boundaries.
-    "fs.native_pwrite": os.path.join("storage_plugins", "fs.py"),
-    "fs.native_pread": os.path.join("storage_plugins", "fs.py"),
-}
-
-# Regression floor: the registry started at 15 sites (ISSUE 5), grew
-# the replication/lease sites (ISSUE 6) and the native-engine sites
-# (ISSUE 9). Shrinking it means a drill surface was silently unthreaded.
-MIN_SITES = 20
-
-
-def check_source(
-    source: str, filename: str
-) -> Tuple[List[Tuple[int, str]], Dict[str, List[int]]]:
-    """Return (violations, {site_name: [lines]}) for one file."""
-    tree = ast.parse(source, filename=filename)
-    violations: List[Tuple[int, str]] = []
-    uses: Dict[str, List[int]] = {}
-    # Names the module binds to the faultinject module object.
-    fi_aliases = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name.split(".")[-1] == "faultinject":
-                    fi_aliases.add(alias.asname or alias.name.split(".")[0])
-        elif isinstance(node, ast.ImportFrom):
-            mod = (node.module or "").split(".")[-1]
-            if mod == "faultinject":
-                violations.append(
-                    (
-                        node.lineno,
-                        "from ...faultinject import ... — import the module "
-                        "and call faultinject.site()/mutate() (the shim)",
-                    )
-                )
-            elif node.module is None or not node.module:
-                # `from . import faultinject [as x]`
-                for alias in node.names:
-                    if alias.name == "faultinject":
-                        fi_aliases.add(alias.asname or alias.name)
-
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Attribute):
-            continue
-        if not (
-            isinstance(node.value, ast.Name) and node.value.id in fi_aliases
-        ):
-            continue
-        if node.attr not in ALLOWED_ATTRS:
-            violations.append(
-                (
-                    node.lineno,
-                    f"faultinject.{node.attr} — production code may only "
-                    "use the site()/mutate() shim",
-                )
-            )
-
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (
-            isinstance(fn, ast.Attribute)
-            and fn.attr in ALLOWED_ATTRS
-            and isinstance(fn.value, ast.Name)
-            and fn.value.id in fi_aliases
-        ):
-            continue
-        if not node.args or not isinstance(node.args[0], ast.Constant) or not (
-            isinstance(node.args[0].value, str)
-        ):
-            violations.append(
-                (
-                    node.lineno,
-                    f"faultinject.{fn.attr}(...) — the site name must be a "
-                    "string literal",
-                )
-            )
-            continue
-        name = node.args[0].value
-        if name not in KNOWN_SITES:
-            violations.append(
-                (
-                    node.lineno,
-                    f"faultinject.{fn.attr}({name!r}) — site not registered "
-                    "in faultinject.SITES",
-                )
-            )
-            continue
-        uses.setdefault(name, []).append(node.lineno)
-
-    return violations, uses
-
-
-def run(package_dir: str = PACKAGE) -> List[str]:
-    failures: List[str] = []
-    all_uses: Dict[str, List[str]] = {}
-    for dirpath, _dirnames, filenames in os.walk(package_dir):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            rel = os.path.relpath(os.path.join(dirpath, fname), package_dir)
-            if rel == "faultinject.py":
-                continue  # the shim itself
-            if rel == "test_utils.py":
-                # The test harness, not the pipeline: its subprocess
-                # launchers arm fault plans via configure() — exactly the
-                # "tests, benchmarks, and process bootstrap" audience the
-                # shim contract carves out.
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path, "r") as f:
-                source = f.read()
-            violations, uses = check_source(source, path)
-            for lineno, what in violations:
-                failures.append(f"{rel}:{lineno}: {what}")
-            for name, lines in uses.items():
-                for lineno in lines:
-                    all_uses.setdefault(name, []).append(f"{rel}:{lineno}")
-    for name, locations in sorted(all_uses.items()):
-        if len(locations) > 1:
-            failures.append(
-                f"site {name!r} used at {len(locations)} call sites "
-                f"({', '.join(locations)}) — one call per name, or plans "
-                "stop replaying deterministically"
-            )
-    for name in sorted(KNOWN_SITES - set(all_uses)):
-        failures.append(
-            f"site {name!r} is registered in faultinject.SITES but wired "
-            "nowhere — remove the registration or thread the site"
-        )
-    for name, pinned_file in sorted(PINNED_SITE_FILES.items()):
-        for location in all_uses.get(name, []):
-            if not location.startswith(pinned_file + ":"):
-                failures.append(
-                    f"site {name!r} used at {location} but pinned to "
-                    f"{pinned_file} — coordination sites must not drift "
-                    "out of the store/peer plane"
-                )
-    if len(KNOWN_SITES) < MIN_SITES:
-        failures.append(
-            f"site registry shrank to {len(KNOWN_SITES)} (< {MIN_SITES}): "
-            "a drill surface was unthreaded"
-        )
-    return failures
-
-
-def main() -> int:
-    failures = run()
-    if failures:
-        print("fault-injection site lint failures:", file=sys.stderr)
-        for failure in sorted(failures):
-            print(f"  {failure}", file=sys.stderr)
-        return 1
-    print(f"fault-site lint: clean ({len(KNOWN_SITES)} sites wired)")
-    return 0
-
+from torchsnapshot_tpu.analysis.plugins.legacy_fault_sites import (  # noqa: E402,F401
+    ALLOWED_ATTRS,
+    KNOWN_SITES,
+    MIN_SITES,
+    PACKAGE,
+    PINNED_SITE_FILES,
+    REPO,
+    check_source,
+    main,
+    run,
+)
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

@@ -1,102 +1,29 @@
 #!/usr/bin/env python
-"""Lint: the cooperative-restore peer plane must be device-free BY
-CONSTRUCTION — no ``jax`` import, attribute chain, or device/collective
-call anywhere in ``fanout.py`` or the ``dist_store.py`` transport.
+"""Lint: the peer plane stays jax-free (thin wrapper).
 
-Why a lint, not review: the peer channel runs on background restore
-threads (async_restore's worker, receiver/handler threads, the commit
-thread's restores), where a device collective deadlocks against the main
-thread's XLA programs — the exact hazard the repo's snapshot.py:33
-invariant exists to prevent. The streaming consumers that DO touch
-devices (io_preparers) sit above the channel and run on the scheduler's
-event loop; the channel itself moves bytes only. A well-meaning
-"optimization" that slips a ``jax.device_put`` or a collective into the
-forwarding path would pass every single-process test and hang a pod —
-so opting the peer plane into jax must fail CI, not slip through review.
-
-Checked per file (AST walk, so comments/strings never false-positive):
-  - ``import jax`` / ``import jax.anything`` / ``from jax... import ...``
-  - any attribute/call chain rooted at a name bound from jax
-
-Run: ``python scripts/check_peer_channel.py`` — exits 0 when clean, 1
-with a per-violation report otherwise. Enforced in tier-1 via
-tests/test_fanout.py (test_peer_channel_lint).
+The implementation moved into the ``tsalint`` static-analysis framework
+(``torchsnapshot_tpu/analysis/plugins/legacy_peer_channel.py``, rule id
+``peer-channel``) — run it standalone here, as ``python -m
+torchsnapshot_tpu lint --rule peer-channel``, or as part of the full
+``tsalint`` run. This wrapper keeps the historical entry point and
+re-exports the names tier-1 tests exercise; output and exit codes are
+bit-identical.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "torchsnapshot_tpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The peer plane: the fan-out protocol/session module and the transport
-# sidecar it rides (dist_store also hosts the KV store — equally
-# device-free by the same invariant).
-PEER_PLANE_FILES = ("fanout.py", "dist_store.py")
-
-
-def check_source(source: str, filename: str) -> list:
-    """Return (line, message) violations for one file's source."""
-    tree = ast.parse(source, filename=filename)
-    violations = []
-    jax_names = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                root = alias.name.split(".")[0]
-                if root == "jax":
-                    violations.append(
-                        (node.lineno, f"import {alias.name!r}")
-                    )
-                    jax_names.add(alias.asname or root)
-        elif isinstance(node, ast.ImportFrom):
-            root = (node.module or "").split(".")[0]
-            if root == "jax":
-                names = ", ".join(a.name for a in node.names)
-                violations.append(
-                    (node.lineno, f"from {node.module} import {names}")
-                )
-                for alias in node.names:
-                    jax_names.add(alias.asname or alias.name)
-
-    if jax_names:
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Name) and node.id in jax_names:
-                # Attribute chains and calls both root at a Name load.
-                if isinstance(node.ctx, ast.Load):
-                    violations.append(
-                        (node.lineno, f"use of jax-bound name {node.id!r}")
-                    )
-    return sorted(set(violations))
-
-
-def main() -> int:
-    bad = 0
-    for name in PEER_PLANE_FILES:
-        path = os.path.join(PKG, name)
-        with open(path, "r") as f:
-            source = f.read()
-        for lineno, msg in check_source(source, path):
-            print(
-                f"{os.path.relpath(path, REPO)}:{lineno}: jax on the peer "
-                f"plane ({msg}) — the cooperative-restore byte channel must "
-                "stay background-thread-safe by construction; move device "
-                "work into a consumer above the channel",
-                file=sys.stderr,
-            )
-            bad += 1
-    if bad:
-        return 1
-    print(
-        f"peer channel lint: clean ({len(PEER_PLANE_FILES)} file(s), "
-        "no jax imports or calls)"
-    )
-    return 0
-
+from torchsnapshot_tpu.analysis.plugins.legacy_peer_channel import (  # noqa: E402,F401
+    PEER_PLANE_FILES,
+    PKG,
+    REPO,
+    check_source,
+    main,
+)
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

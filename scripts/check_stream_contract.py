@@ -1,85 +1,30 @@
 #!/usr/bin/env python
-"""Lint: every storage plugin advertising ``supports_streaming_reads``
-must be covered by the shared read-stream contract parametrization
-(``CONTRACT_PLUGINS`` in tests/test_streaming_read.py).
+"""Lint: streaming-read advertisers carry contract tests (thin wrapper).
 
-The streaming contract is behavioral, not structural: a plugin whose
-``read_stream`` drops, reorders, or duplicates a byte corrupts restored
-state silently, and nothing in the type system catches it. The contract
-tests (streamed bytes == buffered bytes, full + ranged, zero-length
-short-circuit) are the enforcement — so opting a plugin in WITHOUT
-registering it there must fail CI, not slip through review.
-
-Run: ``python scripts/check_stream_contract.py`` — exits 0 when every
-advertising plugin is registered, 1 with a per-plugin report otherwise.
-Enforced in tier-1 via tests/test_streaming_read.py
-(test_contract_coverage_lint).
+The implementation moved into the ``tsalint`` static-analysis framework
+(``torchsnapshot_tpu/analysis/plugins/legacy_stream_contract.py``, rule
+id ``stream-contract``) — run it standalone here, as ``python -m
+torchsnapshot_tpu lint --rule stream-contract``, or as part of the full
+``tsalint`` run. This wrapper keeps the historical entry point and
+re-exports the names tier-1 tests exercise; output and exit codes are
+bit-identical.
 """
 
 from __future__ import annotations
 
-import importlib
-import inspect
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-TEST_FILE = os.path.join(REPO, "tests", "test_streaming_read.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Every module under torchsnapshot_tpu/storage_plugins that can define a
-# plugin class (the walk is explicit so a new module is added here — and
-# thereby linted — rather than silently skipped).
-PLUGIN_MODULES = ("fs", "s3", "gcs", "mirror", "retry")
-
-
-def advertising_plugins() -> set:
-    sys.path.insert(0, REPO)
-    from torchsnapshot_tpu.io_types import StoragePlugin
-
-    out = set()
-    for name in PLUGIN_MODULES:
-        mod = importlib.import_module(f"torchsnapshot_tpu.storage_plugins.{name}")
-        for _, cls in inspect.getmembers(mod, inspect.isclass):
-            if not issubclass(cls, StoragePlugin) or cls.__module__ != mod.__name__:
-                continue
-            # getattr_static sees a property (mirror's delegation) as
-            # advertising too — composition still needs contract tests.
-            flag = inspect.getattr_static(cls, "supports_streaming_reads", False)
-            if flag is not False:
-                out.add(cls.__name__)
-    return out
-
-
-def covered_plugins() -> set:
-    with open(TEST_FILE, "r") as f:
-        source = f.read()
-    match = re.search(r"CONTRACT_PLUGINS\s*=\s*\{(.*?)\n\}", source, re.S)
-    if match is None:
-        return set()
-    return set(re.findall(r'"(\w+)"\s*:', match.group(1)))
-
-
-def main() -> int:
-    advertised = advertising_plugins()
-    covered = covered_plugins()
-    missing = sorted(advertised - covered)
-    if missing:
-        print(
-            "storage plugin(s) advertise supports_streaming_reads without "
-            "read-stream contract coverage (register them in "
-            "CONTRACT_PLUGINS, tests/test_streaming_read.py):",
-            file=sys.stderr,
-        )
-        for name in missing:
-            print(f"  {name}", file=sys.stderr)
-        return 1
-    print(
-        f"stream contract lint: clean ({len(advertised)} advertising "
-        f"plugin(s), all covered)"
-    )
-    return 0
-
+from torchsnapshot_tpu.analysis.plugins.legacy_stream_contract import (  # noqa: E402,F401
+    PLUGIN_MODULES,
+    REPO,
+    TEST_FILE,
+    advertising_plugins,
+    covered_plugins,
+    main,
+)
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

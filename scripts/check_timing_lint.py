@@ -1,158 +1,33 @@
 #!/usr/bin/env python
-"""Lint: no ad-hoc ``time.monotonic()`` / ``time.perf_counter()`` timing
-in ``torchsnapshot_tpu/`` outside the telemetry package.
+"""Lint: the telemetry package owns pipeline timing (thin wrapper).
 
-The telemetry subsystem (torchsnapshot_tpu/telemetry/) is the ONE
-measurement mechanism for the pipeline — spans, counters, rates, and the
-blessed ``telemetry.monotonic`` clock. Before it existed, measurements
-forked into private meters (scheduler throughput tables, governor EWMA
-feeds, phase timers) that could silently disagree; this check keeps new
-code from regrowing them. Wall-clock DEADLINE logic (store RPC timeouts,
-the test launcher's subprocess deadline) is not measurement and stays on
-raw ``time.monotonic`` via the explicit allowlist below.
-
-``benchmarks/`` is walked too: wall-clock measurement IS a benchmark's
-job, but only deliberately — files registered in ``BENCHMARK_ALLOWLIST``
-may call the raw clocks; anything else under benchmarks/ should go
-through the telemetry bus (or be registered here when it genuinely
-measures wall time), so a new benchmark can't accidentally grow a
-private timing idiom.
-
-Run: ``python scripts/check_timing_lint.py`` — exits 0 when clean,
-1 with a per-violation report otherwise. Enforced in tier-1 via
-tests/test_timing_lint.py.
+The implementation moved into the ``tsalint`` static-analysis framework
+(``torchsnapshot_tpu/analysis/plugins/legacy_timing.py``, rule id
+``timing``) — run it standalone here, as ``python -m torchsnapshot_tpu
+lint --rule timing``, or as part of the full ``tsalint`` run. This
+wrapper keeps the historical entry point and re-exports the names
+tier-1 tests exercise; output and exit codes are bit-identical.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "torchsnapshot_tpu")
-BENCH_DIR = os.path.join(REPO, "benchmarks")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Paths (relative to the package) allowed to call time.monotonic/
-# perf_counter directly. Deadline/timeout bookkeeping only — add a file
-# here ONLY for wall-deadline logic, never for measurement (measurement
-# belongs on the telemetry bus).
-ALLOWLIST = {
-    "dist_store.py",  # store RPC / barrier deadline arithmetic
-    "test_utils.py",  # multi-process launcher subprocess deadline
-}
-
-# Benchmark files (relative to benchmarks/) that measure wall clock
-# deliberately — the registration is the point: a benchmark timing the
-# pipeline from outside NEEDS raw perf_counter, and listing it here
-# records that the choice was deliberate rather than drift.
-BENCHMARK_ALLOWLIST = {
-    "async_stall.py",
-    "attention_bench.py",
-    "bench_utils.py",
-    "chaos_soak.py",  # soak wall + the disabled-injector overhead gate
-    "coop_restore.py",  # fan-out vs direct restore walls time wall clock
-    "device_dedup.py",
-    "dist_verify.py",
-    "dma_overlap.py",
-    "embedding_save.py",
-    "manifest_scale.py",
-    "restore_overlap.py",  # read/consume overlap legs time wall clock
-    "sharded_save.py",
-    "store_scale.py",
-    "stream_overlap.py",
-    "vs_orbax.py",
-}
-
-_BANNED_ATTRS = {"monotonic", "perf_counter", "monotonic_ns", "perf_counter_ns"}
-
-
-def _violations_in(path: str) -> list:
-    with open(path, "r") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:  # pragma: no cover - package must parse
-        return [(e.lineno or 0, f"syntax error: {e}")]
-    out = []
-    # Names bound by `from time import monotonic/perf_counter [as alias]`.
-    from_time_aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "time":
-            for alias in node.names:
-                if alias.name in _BANNED_ATTRS:
-                    from_time_aliases.add(alias.asname or alias.name)
-                    out.append(
-                        (node.lineno, f"from time import {alias.name}")
-                    )
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if (
-            isinstance(fn, ast.Attribute)
-            and fn.attr in _BANNED_ATTRS
-            and isinstance(fn.value, ast.Name)
-            and fn.value.id in ("time", "_time")
-        ):
-            out.append((node.lineno, f"{fn.value.id}.{fn.attr}()"))
-        elif isinstance(fn, ast.Name) and fn.id in from_time_aliases:
-            out.append((node.lineno, f"{fn.id}()"))
-    return out
-
-
-# Files INSIDE telemetry/ that are clock CONSUMERS, not the clock's
-# owner: they must go through core.monotonic like the rest of the
-# package, so the lint covers them despite living in the exempt dir.
-# (core.py/export.py own the clock; history.py records calendar time.)
-# critpath.py consumes recorded span timestamps and promexp.py serves
-# scrapes — neither may ever grow a private clock.
-TELEMETRY_COVERED = {"flightrec.py", "health.py", "critpath.py", "promexp.py"}
-
-
-def main() -> int:
-    failures = []
-    for dirpath, dirnames, filenames in os.walk(PACKAGE):
-        rel_dir = os.path.relpath(dirpath, PACKAGE)
-        if rel_dir.split(os.sep)[0] == "telemetry":
-            # The telemetry package owns the raw clock — EXCEPT its
-            # consumer modules (the flight recorder, the health plane),
-            # which are linted like everything else.
-            for name in sorted(filenames):
-                if name not in TELEMETRY_COVERED:
-                    continue
-                rel = os.path.normpath(os.path.join(rel_dir, name))
-                for lineno, what in _violations_in(os.path.join(dirpath, name)):
-                    failures.append((rel, lineno, what))
-            continue
-        for name in filenames:
-            if not name.endswith(".py"):
-                continue
-            rel = os.path.normpath(os.path.join(rel_dir, name))
-            if rel in ALLOWLIST:
-                continue
-            for lineno, what in _violations_in(os.path.join(dirpath, name)):
-                failures.append((rel, lineno, what))
-    if os.path.isdir(BENCH_DIR):
-        for name in sorted(os.listdir(BENCH_DIR)):
-            if not name.endswith(".py") or name in BENCHMARK_ALLOWLIST:
-                continue
-            for lineno, what in _violations_in(os.path.join(BENCH_DIR, name)):
-                failures.append((os.path.join("..", "benchmarks", name), lineno, what))
-    if failures:
-        print(
-            "ad-hoc timing outside torchsnapshot_tpu/telemetry/ "
-            "(use telemetry.span()/record_rate()/telemetry.monotonic, or "
-            "add a DEADLINE-logic file to the allowlist in "
-            "scripts/check_timing_lint.py):",
-            file=sys.stderr,
-        )
-        for rel, lineno, what in sorted(failures):
-            print(f"  torchsnapshot_tpu/{rel}:{lineno}: {what}", file=sys.stderr)
-        return 1
-    print("timing lint: clean")
-    return 0
-
+from torchsnapshot_tpu.analysis.plugins.legacy_timing import (  # noqa: E402,F401
+    ALLOWLIST,
+    BENCH_DIR,
+    BENCHMARK_ALLOWLIST,
+    PACKAGE,
+    REPO,
+    TELEMETRY_COVERED,
+    _BANNED_ATTRS,
+    _violations_in,
+    collect_failures,
+    main,
+)
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

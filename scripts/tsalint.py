@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""tsalint — the torchsnapshot_tpu static analyzer (standalone entry).
+
+Equivalent to ``python -m torchsnapshot_tpu lint``; this script exists
+so CI and pre-commit hooks can run the analyzer without importing the
+package's heavy top level. See docs/source/static_analysis.rst for the
+rule catalog and suppression syntax.
+
+Exit codes: 0 clean, 1 findings (or suppression-hygiene failures),
+2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchsnapshot_tpu.analysis.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

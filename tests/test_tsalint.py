@@ -1,0 +1,477 @@
+"""Tier-1 enforcement + unit tests for the ``tsalint`` static-analysis
+framework (torchsnapshot_tpu/analysis/).
+
+Four layers, mirroring ISSUE 11's acceptance bars:
+
+1. **The package is clean** — the full analyzer exits 0 on the shipped
+   tree (this is the CI gate; the dedicated workflow job runs the same
+   entry point).
+2. **Seeded negatives** — each new pass catches a synthetic fixture of
+   the bug class it exists for: a lock-order inversion, a blocking call
+   under a lock, a blocking finalizer, a leaked fd on an early return,
+   an unregistered / unauditable env read. Exactly one finding each,
+   with the right rule id.
+3. **Suppression hygiene** — in-file allows (incl. multi-line comment
+   blocks) suppress and are verified; stale allows, missing reasons,
+   and stale/malformed baseline entries all fail the run.
+4. **Legacy bit-identity** — the five ``scripts/check_*.py`` wrappers
+   re-export the SAME function objects the plugins run, and a wrapper's
+   stdout/exit code matches the plugin invoked directly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TSALINT = os.path.join(REPO, "scripts", "tsalint.py")
+
+from torchsnapshot_tpu.analysis import (  # noqa: E402
+    EXIT_CLEAN,
+    EXIT_ERROR,
+    EXIT_FINDINGS,
+    Project,
+    run_lint,
+)
+from torchsnapshot_tpu.analysis import runner, suppress  # noqa: E402
+from torchsnapshot_tpu.analysis.plugins import (  # noqa: E402
+    PLUGINS,
+    legacy_event_taxonomy,
+    legacy_fault_sites,
+    legacy_peer_channel,
+    legacy_stream_contract,
+    legacy_timing,
+)
+
+
+def _project(tmp_path, files):
+    """Build a Project over a synthetic package tree."""
+    for sub, source in files.items():
+        path = tmp_path / sub
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return Project(package_dir=str(tmp_path), rel_prefix="pkg")
+
+
+def _lint(tmp_path, files, rules):
+    """run_lint over a synthetic tree with no baseline in play."""
+    return run_lint(
+        rules=rules,
+        project=_project(tmp_path, files),
+        baseline_file=str(tmp_path / "_no_baseline.json"),
+    )
+
+
+# ------------------------------------------------------- the shipped tree
+
+
+def test_package_scan_clean():
+    """The full analyzer is clean on the shipped tree: every true
+    positive is fixed or carries an in-file justification, and the
+    baseline holds zero entries."""
+    r = subprocess.run(
+        [sys.executable, TSALINT],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_module_entrypoint_json():
+    r = subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu", "lint", "--json",
+         "--rule", "timing", "--rule", "peer-channel"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["exit_code"] == 0
+    assert doc["findings"] == []
+    assert sorted(doc["rules"]) == ["peer-channel", "timing"]
+
+
+def test_unknown_rule_is_usage_error(capsys):
+    assert run_lint(rules=["no-such-rule"]).exit_code == EXIT_ERROR
+    assert runner.main(["--rule", "no-such-rule"]) == EXIT_ERROR
+    capsys.readouterr()
+
+
+def test_list_rules_covers_every_plugin(capsys):
+    assert runner.main(["--list-rules"]) == EXIT_CLEAN
+    out = capsys.readouterr().out
+    for mod in PLUGINS.values():
+        for rule in mod.RULES:
+            assert rule in out
+
+
+# -------------------------------------------------------- seeded negatives
+
+
+def test_lock_order_inversion_seeded(tmp_path):
+    """A link-lock-then-cond acquisition in dist_store.py runs against
+    the documented _cond -> lock order: exactly one finding."""
+    report = _lint(tmp_path, {
+        "dist_store.py": """\
+            class S:
+                def bad(self, link):
+                    with link.lock:
+                        with self._cond:
+                            pass
+            """,
+    }, rules=["lock-order"])
+    assert [f.rule for f in report.unsuppressed] == ["lock-order"]
+    assert report.unsuppressed[0].file == "pkg/dist_store.py"
+    assert report.exit_code == EXIT_FINDINGS
+
+
+def test_lock_order_generic_inversion_seeded(tmp_path):
+    """Without a documented order, a two-way inversion is reported once
+    per direction."""
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            class S:
+                def ab(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            pass
+
+                def ba(self):
+                    with self.b_lock:
+                        with self.a_lock:
+                            pass
+            """,
+    }, rules=["lock-order"])
+    assert [f.rule for f in report.unsuppressed] == ["lock-order"] * 2
+
+
+def test_lock_blocking_seeded(tmp_path):
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            import time
+
+            def f(lk):
+                with lk:
+                    time.sleep(1.0)
+            """,
+    }, rules=["lock-blocking"])
+    assert [f.rule for f in report.unsuppressed] == ["lock-blocking"]
+    assert "time.sleep" in report.unsuppressed[0].message
+
+
+def test_lock_blocking_one_level_descent(tmp_path):
+    """The pass sees a blocking call one package-local call below the
+    lock (the wrapper-function idiom the repo actually uses)."""
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            import time
+
+            def _wait():
+                time.sleep(1.0)
+
+            def f(lk):
+                with lk:
+                    _wait()
+            """,
+    }, rules=["lock-blocking"])
+    assert [f.rule for f in report.unsuppressed] == ["lock-blocking"]
+    assert "_wait" in report.unsuppressed[0].message
+
+
+def test_restricted_context_blocking_finalizer_seeded(tmp_path):
+    report = _lint(tmp_path, {
+        "pool.py": """\
+            import threading
+            import weakref
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    weakref.finalize(self, self._cleanup)
+
+                def _cleanup(self):
+                    with self._lock:
+                        pass
+            """,
+    }, rules=["restricted-context"])
+    assert [f.rule for f in report.unsuppressed] == ["restricted-context"]
+    assert "finalizer" in report.unsuppressed[0].message
+
+
+def test_resource_lifecycle_early_return_leak_seeded(tmp_path):
+    report = _lint(tmp_path, {
+        "io.py": """\
+            import os
+
+            def read_header(path, probe):
+                fd = os.open(path, os.O_RDONLY)
+                if probe:
+                    return None
+                data = os.read(fd, 16)
+                os.close(fd)
+                return data
+            """,
+    }, rules=["resource-lifecycle"])
+    assert [f.rule for f in report.unsuppressed] == ["resource-lifecycle"]
+    assert "os.open" in report.unsuppressed[0].message
+
+
+def test_resource_lifecycle_try_finally_is_clean(tmp_path):
+    report = _lint(tmp_path, {
+        "io.py": """\
+            import os
+
+            def read_header(path, probe):
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    if probe:
+                        return None
+                    return os.read(fd, 16)
+                finally:
+                    os.close(fd)
+            """,
+    }, rules=["resource-lifecycle"])
+    assert report.unsuppressed == []
+    assert report.exit_code == EXIT_CLEAN
+
+
+def test_env_unregistered_seeded(tmp_path):
+    report = _lint(tmp_path, {
+        "knobs.py": """\
+            import os
+
+            def knob():
+                return os.environ.get("TORCHSNAPSHOT_TPU_NOT_A_KNOB", "0")
+            """,
+    }, rules=["env-unregistered"])
+    assert [f.rule for f in report.unsuppressed] == ["env-unregistered"]
+    assert "TORCHSNAPSHOT_TPU_NOT_A_KNOB" in report.unsuppressed[0].message
+
+
+def test_env_dynamic_seeded(tmp_path):
+    report = _lint(tmp_path, {
+        "knobs.py": """\
+            import os
+
+            class Cfg:
+                def get(self, name):
+                    return os.environ.get(name)
+            """,
+    }, rules=["env-dynamic"])
+    assert [f.rule for f in report.unsuppressed] == ["env-dynamic"]
+
+
+def test_env_registered_read_is_clean(tmp_path):
+    report = _lint(tmp_path, {
+        "knobs.py": """\
+            import os
+
+            def knob():
+                return os.environ.get("TORCHSNAPSHOT_TPU_TELEMETRY", "0")
+            """,
+    }, rules=["env-unregistered", "env-dynamic"])
+    assert report.unsuppressed == []
+
+
+# ---------------------------------------------------- suppression hygiene
+
+
+_BLOCKING_FIXTURE = """\
+    import time
+
+    def f(lk):
+        with lk:
+            time.sleep(1.0)
+    """
+
+
+def test_inline_allow_suppresses(tmp_path):
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            import time
+
+            def f(lk):
+                with lk:
+                    # tsalint: allow[lock-blocking] fixture: deliberate hold
+                    time.sleep(1.0)
+            """,
+    }, rules=["lock-blocking"])
+    assert report.unsuppressed == []
+    assert report.hygiene == []
+    assert len(report.suppressed) == 1
+    assert report.exit_code == EXIT_CLEAN
+
+
+def test_inline_allow_comment_block_slides(tmp_path):
+    """A justification spread over a comment block still covers the
+    first code line below it."""
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            import time
+
+            def f(lk):
+                with lk:
+                    # tsalint: allow[lock-blocking] a long justification
+                    # that continues onto a second comment line
+                    time.sleep(1.0)
+            """,
+    }, rules=["lock-blocking"])
+    assert report.unsuppressed == []
+    assert report.hygiene == []
+    assert len(report.suppressed) == 1
+
+
+def test_stale_allow_fails_the_run(tmp_path):
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            # tsalint: allow[lock-blocking] nothing blocks here anymore
+            X = 1
+            """,
+    }, rules=["lock-blocking"])
+    assert [f.rule for f in report.hygiene] == ["stale-suppression"]
+    assert report.exit_code == EXIT_FINDINGS
+
+
+def test_allow_without_reason_fails_the_run(tmp_path):
+    report = _lint(tmp_path, {
+        "mod.py": """\
+            import time
+
+            def f(lk):
+                with lk:
+                    # tsalint: allow[lock-blocking]
+                    time.sleep(1.0)
+            """,
+    }, rules=["lock-blocking"])
+    assert any(f.rule == "suppression-syntax" for f in report.hygiene)
+    assert report.exit_code == EXIT_FINDINGS
+
+
+def test_allow_in_docstring_is_not_a_suppression(tmp_path):
+    """Only real COMMENT tokens register — prose that mentions the
+    syntax (like suppress.py's own docstring) must not."""
+    report = _lint(tmp_path, {
+        "mod.py": '''\
+            """Docs: write '# tsalint: allow[lock-blocking] reason' above."""
+
+            import time
+
+            def f(lk):
+                with lk:
+                    time.sleep(1.0)
+            ''',
+    }, rules=["lock-blocking"])
+    assert [f.rule for f in report.unsuppressed] == ["lock-blocking"]
+    assert report.hygiene == []  # the docstring is neither stale nor bad
+
+
+def test_baseline_suppresses_and_goes_stale(tmp_path):
+    files = {"mod.py": _BLOCKING_FIXTURE}
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"suppressions": [
+        {"rule": "lock-blocking", "file": "pkg/mod.py",
+         "reason": "adopted with the analyzer"},
+    ]}))
+    report = run_lint(
+        rules=["lock-blocking"], project=_project(tmp_path, files),
+        baseline_file=str(base),
+    )
+    assert report.unsuppressed == []
+    assert len(report.suppressed) == 1
+    assert report.exit_code == EXIT_CLEAN
+
+    # an entry matching nothing fails the run: the baseline only shrinks
+    base.write_text(json.dumps({"suppressions": [
+        {"rule": "lock-blocking", "file": "pkg/mod.py",
+         "reason": "adopted with the analyzer"},
+        {"rule": "lock-blocking", "file": "pkg/gone.py",
+         "reason": "file was deleted"},
+    ]}))
+    report = run_lint(
+        rules=["lock-blocking"], project=_project(tmp_path, files),
+        baseline_file=str(base),
+    )
+    assert [f.rule for f in report.hygiene] == ["stale-suppression"]
+    assert report.exit_code == EXIT_FINDINGS
+
+
+def test_baseline_entry_requires_reason(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"suppressions": [
+        {"rule": "lock-blocking", "file": "pkg/mod.py"},
+    ]}))
+    report = run_lint(
+        rules=["lock-blocking"],
+        project=_project(tmp_path, {"mod.py": _BLOCKING_FIXTURE}),
+        baseline_file=str(base),
+    )
+    assert any(f.rule == "suppression-syntax" for f in report.hygiene)
+    # the finding itself is NOT covered by the malformed entry
+    assert [f.rule for f in report.unsuppressed] == ["lock-blocking"]
+
+
+def test_baseline_env_override(monkeypatch, tmp_path):
+    override = tmp_path / "elsewhere.json"
+    monkeypatch.setenv(suppress.BASELINE_ENV_VAR, str(override))
+    assert suppress.baseline_path() == str(override)
+    monkeypatch.delenv(suppress.BASELINE_ENV_VAR)
+    assert suppress.baseline_path() == suppress.DEFAULT_BASELINE
+
+
+def test_shipped_baseline_is_empty():
+    with open(os.path.join(REPO, ".tsalint_baseline.json")) as f:
+        doc = json.load(f)
+    assert doc["suppressions"] == []
+
+
+# ------------------------------------------------------ legacy bit-identity
+
+
+def test_legacy_wrappers_reexport_the_plugin_objects():
+    """The scripts/check_*.py wrappers and the tsalint plugins are the
+    SAME objects — identical results by construction."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_event_taxonomy
+        import check_fault_sites
+        import check_peer_channel
+        import check_stream_contract
+        import check_timing_lint
+    finally:
+        sys.path.pop(0)
+    assert check_timing_lint._violations_in is legacy_timing._violations_in
+    assert check_timing_lint.collect_failures is legacy_timing.collect_failures
+    assert check_timing_lint.ALLOWLIST is legacy_timing.ALLOWLIST
+    assert check_fault_sites.check_source is legacy_fault_sites.check_source
+    assert check_fault_sites.run is legacy_fault_sites.run
+    assert check_fault_sites.MIN_SITES == legacy_fault_sites.MIN_SITES
+    assert check_peer_channel.check_source is legacy_peer_channel.check_source
+    assert (check_stream_contract.advertising_plugins
+            is legacy_stream_contract.advertising_plugins)
+    assert (check_event_taxonomy.check_source
+            is legacy_event_taxonomy.check_source)
+    assert check_event_taxonomy.run is legacy_event_taxonomy.run
+
+
+@pytest.mark.parametrize("script,plugin_mod", [
+    ("check_timing_lint.py",
+     "torchsnapshot_tpu.analysis.plugins.legacy_timing"),
+    ("check_fault_sites.py",
+     "torchsnapshot_tpu.analysis.plugins.legacy_fault_sites"),
+])
+def test_legacy_wrapper_output_bit_identical(script, plugin_mod):
+    """A wrapper's stdout and exit code match the plugin's own main()."""
+    wrapper = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", script)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    direct = subprocess.run(
+        [sys.executable, "-c",
+         f"import sys; from {plugin_mod} import main; sys.exit(main())"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert wrapper.returncode == direct.returncode
+    assert wrapper.stdout == direct.stdout

@@ -1,0 +1,114 @@
+"""Ported legacy lint: the cooperative-restore peer plane is jax-free
+by construction (rule ``peer-channel``).
+
+This is ``scripts/check_peer_channel.py`` moved onto the tsalint
+framework bit-for-bit: same two files, same AST checks, same messages.
+The script remains a thin wrapper importing everything from here.
+
+The peer channel runs on background restore threads, where a device
+collective deadlocks against the main thread's XLA programs. The
+streaming consumers that DO touch devices (io_preparers) sit above the
+channel; the channel itself moves bytes only.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+from ..core import Finding, PACKAGE_DIR, REPO_DIR, Project
+
+RULES = ("peer-channel",)
+
+REPO = REPO_DIR
+PKG = PACKAGE_DIR
+
+# The peer plane: the fan-out protocol/session module and the transport
+# sidecar it rides (dist_store also hosts the KV store — equally
+# device-free by the same invariant).
+PEER_PLANE_FILES = ("fanout.py", "dist_store.py")
+
+
+def check_source(source: str, filename: str) -> list:
+    """Return (line, message) violations for one file's source."""
+    tree = ast.parse(source, filename=filename)
+    violations = []
+    jax_names = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root == "jax":
+                    violations.append(
+                        (node.lineno, f"import {alias.name!r}")
+                    )
+                    jax_names.add(alias.asname or root)
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").split(".")[0]
+            if root == "jax":
+                names = ", ".join(a.name for a in node.names)
+                violations.append(
+                    (node.lineno, f"from {node.module} import {names}")
+                )
+                for alias in node.names:
+                    jax_names.add(alias.asname or alias.name)
+
+    if jax_names:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and node.id in jax_names:
+                # Attribute chains and calls both root at a Name load.
+                if isinstance(node.ctx, ast.Load):
+                    violations.append(
+                        (node.lineno, f"use of jax-bound name {node.id!r}")
+                    )
+    return sorted(set(violations))
+
+
+def run_pass(project: Project) -> List[Finding]:
+    out = []
+    for name in PEER_PLANE_FILES:
+        path = os.path.join(PKG, name)
+        with open(path, "r") as f:
+            source = f.read()
+        for lineno, msg in check_source(source, path):
+            out.append(
+                Finding(
+                    rule="peer-channel",
+                    file=f"torchsnapshot_tpu/{name}",
+                    line=lineno,
+                    message=(
+                        f"jax on the peer plane ({msg}) — the "
+                        "cooperative-restore byte channel must stay "
+                        "background-thread-safe by construction; move device "
+                        "work into a consumer above the channel"
+                    ),
+                )
+            )
+    return out
+
+
+def main() -> int:
+    bad = 0
+    for name in PEER_PLANE_FILES:
+        path = os.path.join(PKG, name)
+        with open(path, "r") as f:
+            source = f.read()
+        for lineno, msg in check_source(source, path):
+            print(
+                f"{os.path.relpath(path, REPO)}:{lineno}: jax on the peer "
+                f"plane ({msg}) — the cooperative-restore byte channel must "
+                "stay background-thread-safe by construction; move device "
+                "work into a consumer above the channel",
+                file=sys.stderr,
+            )
+            bad += 1
+    if bad:
+        return 1
+    print(
+        f"peer channel lint: clean ({len(PEER_PLANE_FILES)} file(s), "
+        "no jax imports or calls)"
+    )
+    return 0

@@ -1,0 +1,100 @@
+"""Ported legacy lint: every plugin advertising streaming reads has
+read-stream contract coverage (rule ``stream-contract``).
+
+This is ``scripts/check_stream_contract.py`` moved onto the tsalint
+framework bit-for-bit: same module list, same ``getattr_static``
+advertising probe, same ``CONTRACT_PLUGINS`` regex. The script remains
+a thin wrapper importing everything from here.
+
+The streaming contract is behavioral, not structural: a plugin whose
+``read_stream`` drops, reorders, or duplicates a byte corrupts restored
+state silently — so opting a plugin in WITHOUT registering it in the
+contract parametrization must fail CI, not slip through review.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import re
+import sys
+from typing import List
+
+from ..core import Finding, REPO_DIR, Project
+
+RULES = ("stream-contract",)
+
+REPO = REPO_DIR
+TEST_FILE = os.path.join(REPO, "tests", "test_streaming_read.py")
+
+# Every module under torchsnapshot_tpu/storage_plugins that can define a
+# plugin class (the walk is explicit so a new module is added here — and
+# thereby linted — rather than silently skipped).
+PLUGIN_MODULES = ("fs", "s3", "gcs", "mirror", "retry")
+
+
+def advertising_plugins() -> set:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from torchsnapshot_tpu.io_types import StoragePlugin
+
+    out = set()
+    for name in PLUGIN_MODULES:
+        mod = importlib.import_module(f"torchsnapshot_tpu.storage_plugins.{name}")
+        for _, cls in inspect.getmembers(mod, inspect.isclass):
+            if not issubclass(cls, StoragePlugin) or cls.__module__ != mod.__name__:
+                continue
+            # getattr_static sees a property (mirror's delegation) as
+            # advertising too — composition still needs contract tests.
+            flag = inspect.getattr_static(cls, "supports_streaming_reads", False)
+            if flag is not False:
+                out.add(cls.__name__)
+    return out
+
+
+def covered_plugins() -> set:
+    with open(TEST_FILE, "r") as f:
+        source = f.read()
+    match = re.search(r"CONTRACT_PLUGINS\s*=\s*\{(.*?)\n\}", source, re.S)
+    if match is None:
+        return set()
+    return set(re.findall(r'"(\w+)"\s*:', match.group(1)))
+
+
+def run_pass(project: Project) -> List[Finding]:
+    missing = sorted(advertising_plugins() - covered_plugins())
+    return [
+        Finding(
+            rule="stream-contract",
+            file="tests/test_streaming_read.py",
+            line=1,
+            message=(
+                f"{name} advertises supports_streaming_reads without "
+                "read-stream contract coverage — register it in "
+                "CONTRACT_PLUGINS"
+            ),
+        )
+        for name in missing
+    ]
+
+
+def main() -> int:
+    advertised = advertising_plugins()
+    covered = covered_plugins()
+    missing = sorted(advertised - covered)
+    if missing:
+        print(
+            "storage plugin(s) advertise supports_streaming_reads without "
+            "read-stream contract coverage (register them in "
+            "CONTRACT_PLUGINS, tests/test_streaming_read.py):",
+            file=sys.stderr,
+        )
+        for name in missing:
+            print(f"  {name}", file=sys.stderr)
+        return 1
+    print(
+        f"stream contract lint: clean ({len(advertised)} advertising "
+        f"plugin(s), all covered)"
+    )
+    return 0

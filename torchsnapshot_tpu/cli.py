@@ -68,6 +68,7 @@ import asyncio
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+from .analysis import runner as analysis_runner
 from .integrity import IntegrityError, verify_checksum
 from .io_types import ReadIO
 from .manifest import (
@@ -1545,6 +1546,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=5.0)
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_store_status)
+
+    p = sub.add_parser(
+        "lint",
+        help="run the tsalint static analyzer over the package "
+             "(concurrency, finalizer-context, resource-lifecycle, "
+             "env-registry, and the five legacy invariant lints)",
+    )
+    analysis_runner.add_lint_arguments(p)
+    p.set_defaults(fn=analysis_runner.cli_main)
     return parser
 
 

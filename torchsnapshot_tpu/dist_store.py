@@ -333,6 +333,10 @@ class _ReplicaLink:
         faultinject.site("dist_store.replica_rpc")
         with self.lock:
             self.sock.settimeout(timeout)
+            # tsalint: allow[lock-blocking] deadline-bounded: settimeout on
+            # the line above caps both the send and the recv; the link lock
+            # only serializes this link's exchanges (the dispatcher never
+            # waits on it — see the SYNCING protocol in __init__)
             _send_msg(self.sock, msg)
             resp = _recv_msg(self.sock)
         if not isinstance(resp, dict):
@@ -788,9 +792,19 @@ class _StoreServer:
         link = _ReplicaLink(conn, addr)
         sync_err: Optional[BaseException] = None
         with link.lock:
+            # tsalint: allow[lock-order] safe against the documented
+            # _cond -> lock order: this link was constructed two lines up
+            # and is not yet registered in _replicas, so no other thread
+            # can hold (or wait on) link.lock — the inverted edge cannot
+            # close a cycle until after the lock is released
             with self._cond:
                 if self._role != "leader":
                     try:
+                        # tsalint: allow[lock-blocking] best-effort one-shot
+                        # rejection to a conn nobody else shares: the frame
+                        # fits the kernel send buffer, and OSError (incl.
+                        # timeout) is swallowed — a wedged joiner cannot
+                        # hold this
                         _send_msg(
                             conn,
                             {"ok": False, "not_leader": True, "epoch": self._epoch},
@@ -830,6 +844,10 @@ class _StoreServer:
             deposed = False
             try:
                 conn.settimeout(max(self._replica_timeout(), 30.0))
+                # tsalint: allow[lock-blocking] deadline-bounded by the
+                # settimeout above, and holding ONLY link.lock here is the
+                # design: the cond was dropped before the sync precisely so
+                # a slow joiner stalls nothing but its own link
                 _send_msg(conn, sync)
                 ack = _recv_msg(conn)
                 conn.settimeout(None)
@@ -848,6 +866,7 @@ class _StoreServer:
             # on a SYNCING link's lock (replicate/lease/rs_update all
             # skip syncing links), so no cycle can form.
             while sync_err is None and not deposed:
+                # tsalint: allow[lock-order] documented amendment (comment above): this path holds link.lock and takes the cond briefly to swap batches; no thread holds the cond while waiting on a SYNCING link's lock, so no cycle can form
                 with self._cond:
                     batch = link.pending
                     link.pending = []
@@ -984,9 +1003,9 @@ class _StoreServer:
         sock = socket.create_connection(
             (host, int(port)), timeout=CONNECT_TIMEOUT_S
         )
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        advert = f"{sock.getsockname()[0]}:{self.port}"
         try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            advert = f"{sock.getsockname()[0]}:{self.port}"
             sock.settimeout(CONNECT_TIMEOUT_S)
             _send_msg(sock, {"op": "replica_join", "addr": advert})
             sync = _recv_msg(sock)
@@ -1419,26 +1438,36 @@ class TCPStore:
                 f"{host}:{port} answered the store probe with garbage "
                 f"({type(e).__name__}: {e}) — not a store server"
             ) from e
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # Silent-death detection at the TCP layer (a killed process RSTs
-        # and needs none of this; these cover power loss / partitions):
-        # - keepalive (idle 5 s + 3 probes x 5 s = ~20 s) tears down
-        #   connections idle in a blocked recv;
-        # - TCP_USER_TIMEOUT (~20 s) covers the case keepalive cannot:
-        #   request bytes sent but never ACKed (keepalive probes are
-        #   suppressed while data is outstanding — without this, that
-        #   path would ride retransmission backoff for ~15 minutes).
-        # Both land long before the 1800 s barrier timeout.
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-        for opt, val in (
-            ("TCP_KEEPIDLE", 5),
-            ("TCP_KEEPINTVL", 5),
-            ("TCP_KEEPCNT", 3),
-            ("TCP_USER_TIMEOUT", 20_000),  # milliseconds
-        ):
-            if hasattr(socket, opt):  # Linux; harmless to skip elsewhere
-                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+        try:
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Silent-death detection at the TCP layer (a killed process
+            # RSTs and needs none of this; these cover power loss /
+            # partitions):
+            # - keepalive (idle 5 s + 3 probes x 5 s = ~20 s) tears down
+            #   connections idle in a blocked recv;
+            # - TCP_USER_TIMEOUT (~20 s) covers the case keepalive cannot:
+            #   request bytes sent but never ACKed (keepalive probes are
+            #   suppressed while data is outstanding — without this, that
+            #   path would ride retransmission backoff for ~15 minutes).
+            # Both land long before the 1800 s barrier timeout.
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            for opt, val in (
+                ("TCP_KEEPIDLE", 5),
+                ("TCP_KEEPINTVL", 5),
+                ("TCP_KEEPCNT", 3),
+                ("TCP_USER_TIMEOUT", 20_000),  # milliseconds
+            ):
+                if hasattr(socket, opt):  # Linux; harmless to skip elsewhere
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, getattr(socket, opt), val
+                    )
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         return sock
 
     @property
@@ -1529,6 +1558,10 @@ class TCPStore:
                     req["cseq"] = self._mut_seq
                 try:
                     self._sock.settimeout(response_deadline)
+                    # tsalint: allow[lock-blocking] deadline-bounded by the
+                    # settimeout above; self._lock IS the client's
+                    # per-connection request serialization — concurrent
+                    # callers must queue behind the in-flight RPC by design
                     _send_msg(self._sock, req)
                     resp = _recv_msg(self._sock)
                     self._sock.settimeout(None)
@@ -1536,6 +1569,11 @@ class TCPStore:
                     # socket.timeout is an OSError subclass, so a silent
                     # server (deadline) and a dead one (RST/FIN) both
                     # land here; keepalive converts long silences too.
+                    # tsalint: allow[lock-blocking] failover's bounded
+                    # connect-retry sleeps run under self._lock on purpose:
+                    # every other request MUST queue until the new leader is
+                    # adopted — releasing the lock would just let them race
+                    # the same dead socket
                     self._failover_locked(e, op)
                     continue
             if resp.get("not_leader"):
@@ -1544,6 +1582,9 @@ class TCPStore:
                 with self._lock:
                     if self._dead is not None:
                         raise self._dead
+                    # tsalint: allow[lock-blocking] same deliberate hold as
+                    # the exception path above: requests queue behind the
+                    # bounded failover rather than racing a deposed leader
                     self._failover_locked(
                         ConnectionError(
                             f"{self.addr} is no longer the store leader "
@@ -1723,6 +1764,9 @@ class TCPStore:
                 return
             try:
                 self._sock.settimeout(STORE_RPC_TIMEOUT_S)
+                # tsalint: allow[lock-blocking] deadline-bounded by the
+                # settimeout above, and best-effort: any socket failure just
+                # returns and the next response retriggers the refresh
                 _send_msg(self._sock, {"op": "replicas"})
                 rs = _recv_msg(self._sock)
                 self._sock.settimeout(None)
@@ -2041,8 +2085,15 @@ def peer_connect(addr: str, timeout: float = PEER_CONNECT_TIMEOUT_S) -> socket.s
     small end/abort control frames aren't Nagle-delayed behind payload."""
     host, _, port = addr.rpartition(":")
     sock = socket.create_connection((host, int(port)), timeout=timeout)
-    sock.settimeout(None)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except BaseException:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise
     return sock
 
 

@@ -698,6 +698,10 @@ class CoopRestoreSession:
         sock, lock = entry
         try:
             with lock:
+                # tsalint: allow[lock-blocking] the per-peer lock exists to
+                # serialize frames onto this one socket; a wedged subscriber
+                # surfaces as ConnectionError/OSError below and is dropped
+                # to _send_dead, never retried
                 send_peer_frame(sock, header, payload)
         except (ConnectionError, OSError):
             # The subscriber is gone: it will direct-read; skip it from
@@ -795,6 +799,9 @@ class CoopRestoreSession:
             try:
                 if r not in self._send_dead:
                     with lock:
+                        # tsalint: allow[lock-blocking] best-effort goodbye
+                        # on shutdown: a tiny frame to a socket we close on
+                        # the next line either way; errors are swallowed
                         send_peer_frame(sock, {"op": "bye"})
             except (ConnectionError, OSError):
                 pass

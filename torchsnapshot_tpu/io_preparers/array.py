@@ -252,6 +252,7 @@ class _StagingPool:
 
     def _store_native(self, view: np.ndarray) -> None:
         evict = False
+        # tsalint: allow[restricted-context] safe from the _put_native finalizer: its acquire(blocking=False) gate proved this thread does NOT hold the pool lock (a holder defers instead), and no pool path blocks while holding it (lock-blocking enforces that), so this acquire cannot self-deadlock
         with self._lock:
             # After a mid-run degrade the free lists feed _get_py, which
             # must never pop an unowned native view (its eviction path

@@ -284,6 +284,7 @@ class FSStoragePlugin(StoragePlugin):
                     f"short read: {path} is {fsize} bytes; range "
                     f"[{lo}, {lo + size}) extends past EOF"
                 )
+            # tsalint: allow[resource-lifecycle] ownership transfers to the returned memoryview: CPython deallocates an mmap (munmap) when the last exporting view is released, and nothing between mmap() and return can raise (memoryview() of a fresh map and pure-int slicing cannot fail)
             m = _mmap.mmap(
                 f.fileno(),
                 size + (lo - aligned),

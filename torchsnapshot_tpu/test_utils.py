@@ -220,9 +220,11 @@ def _find_free_port() -> int:
     port = 0
     for _ in range(128):
         s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        try:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        finally:
+            s.close()
         if port not in _handed_out_ports:
             _handed_out_ports.add(port)
             return port
