@@ -405,6 +405,93 @@ def _coop_restore_leg(timeout_s: float = 420.0):
     return summary["worlds"]
 
 
+def _reshard_leg(timeout_s: float = 420.0):
+    """Planned-reshard legs (ISSUE 12), persisted to BENCH_r11.json and
+    embedded in the main record:
+
+    - benchmarks/reshard_throughput.py: the world-2 tp2 -> world-4
+      column cross-cut on throttled storage, RESHARD=never vs =always
+      (the script asserts <= 1.3x planned vs ~4x direct amplification
+      and a >= 1.5x aggregate speedup itself);
+    - benchmarks/manifest_scale.py's plan-time leg: the minimal-movement
+      plan over a ~50k-shard manifest under its own wall bound.
+
+    Each runs in its own process group with a hard timeout; failures
+    degrade to an absent key, never a dead bench."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _log(f"running planned-reshard legs ({timeout_s:.0f}s budget) ...")
+    deadline = time.monotonic() + timeout_s
+    r = _run_in_own_group(
+        [sys.executable, os.path.join(here, "benchmarks", "reshard_throughput.py")],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"reshard-throughput leg rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    records = _json_records(r.stdout)
+    summary = records.get("reshard_throughput/summary")
+    if summary is None:
+        _log("reshard-throughput leg produced no summary; omitting")
+        return None
+    legs = [
+        rec
+        for name, rec in records.items()
+        if name.startswith("reshard_throughput/")
+        and name != "reshard_throughput/summary"
+    ]
+
+    plan = None
+    remaining = max(30.0, deadline - time.monotonic())
+    r2 = _run_in_own_group(
+        [sys.executable, os.path.join(here, "benchmarks", "manifest_scale.py")],
+        timeout=remaining,
+    )
+    if not r2.killed and r2.returncode == 0:
+        ms = _json_records(r2.stdout).get("manifest_scale")
+        if ms is not None:
+            plan = {
+                "shard_leaves": ms.get("shard_leaves"),
+                "planned_units": ms.get("reshard_planned_units"),
+                "plan_s": ms.get("reshard_plan_s"),
+            }
+    if plan is None:
+        _log(
+            f"manifest-scale plan leg rc={r2.returncode} killed={r2.killed}; "
+            "omitting plan numbers"
+        )
+
+    out = os.path.join(here, "BENCH_r11.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "metric": "planned_reshard",
+                "unit": "storage-read amplification (x payload) / GB/s",
+                "summary": summary,
+                "legs": legs,
+                "plan_scale": plan,
+                "platform": "cpu",
+                "env": {"JAX_PLATFORMS": "cpu"},
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    _log(
+        f"reshard leg ok: speedup {summary.get('speedup')}x, "
+        f"amplification {summary.get('direct_amplification')}x -> "
+        f"{summary.get('planned_amplification')}x; written to {out}"
+    )
+    compact = dict(summary)
+    compact.pop("benchmark", None)
+    if plan is not None:
+        compact["plan_scale"] = plan
+    return compact
+
+
 def _native_io_leg(tmp: str, app_state, state, nbytes: int):
     """Side-by-side native-engine vs Python-path legs (ISSUE 9),
     persisted to BENCH_r10.json and embedded in the main record.
@@ -809,6 +896,11 @@ def main() -> None:
     coop = _coop_restore_leg()
     if coop is not None:
         record["coop_restore"] = coop
+    # Planned-reshard side-leg (BENCH_r11.json): never vs always on the
+    # tp2 -> tp4 cross-cut, plus the 50k-shard plan-time bound.
+    reshard_leg = _reshard_leg()
+    if reshard_leg is not None:
+        record["reshard"] = reshard_leg
     print(json.dumps(record), flush=True)
 
 

@@ -101,6 +101,28 @@ def main() -> int:
         SnapshotMetadata.from_yaml(f.read())
         t_read = time.perf_counter() - t0
 
+    # Reshard plan-time leg (ISSUE 12): the minimal-movement planner over
+    # the full-size manifest — a tp16 -> col-parallel world-32 cross-cut
+    # where every saved shard overlaps every destination strip (the
+    # worst-case unit count: one planned unit per shard). The plan is
+    # pure geometry on the manifest; it must stay far off the restore
+    # critical path even at ~50k shards.
+    from torchsnapshot_tpu.layout import LayoutSpec
+    from torchsnapshot_tpu.reshard import plan_entry_transfers
+
+    dst = LayoutSpec([("x", 32)])
+    t0 = time.perf_counter()
+    total_units = 0
+    for entry in manifest.values():
+        boxes = dst.boxes_by_rank(entry.shape, [(), ("x",)], 32)
+        total_units += len(plan_entry_transfers(entry, boxes))
+    t_plan = time.perf_counter() - t0
+    assert total_units == n_shards, (total_units, n_shards)
+    assert t_plan < 60.0, (
+        f"planning {n_shards} shards took {t_plan:.1f}s — the planner "
+        "fell onto the restore critical path"
+    )
+
     report(
         "manifest_scale",
         {
@@ -113,6 +135,8 @@ def main() -> int:
             "commit_write_s": round(t_write, 3),
             "restore_read_s": round(t_read, 3),
             "available_entries_s": round(t_avail, 3),
+            "reshard_plan_s": round(t_plan, 3),
+            "reshard_planned_units": total_units,
         },
     )
     return 0

@@ -455,3 +455,59 @@ def test_info_dedups_replicated_payloads(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "payload:     32B" in out  # 8 * 4 bytes, once
     assert "checksums:   0/1 payloads" in out
+
+
+def test_plan_dry_run(tmp_path, capsys):
+    """``plan`` reports the planner's byte accounting for a layout
+    change from manifest geometry alone — here the row->column
+    cross-cut where direct restore reads every shard on every rank."""
+    jax = pytest.importorskip("jax")
+    import json
+
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    vals = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+    arr = jax.make_array_from_callback(
+        vals.shape, NamedSharding(mesh, P("x", None)), lambda i: vals[i]
+    )
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"app": StateDict(w=arr, step=3)})
+    layout = str(tmp_path / "dst.json")
+    with open(layout, "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "mesh": [["x", 4]],
+                "rules": [{"pattern": "app/w$", "spec": [[], ["x"]]}],
+            },
+            f,
+        )
+
+    assert main(["plan", path, layout, "--world", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "app/w" in out
+    assert "4.0x reduction" in out
+
+    assert main(["plan", path, layout, "--world", "4", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    totals = doc["totals"]
+    assert totals["planned_units"] == totals["shards"] == len(jax.devices())
+    assert (
+        totals["direct_bytes_from_storage"]
+        == 4 * totals["planned_bytes_from_storage"]
+    )
+    assert totals["planned_peer_bytes"] > 0
+
+    # Sub-threshold worlds leave every shard on direct reads.
+    assert main(
+        ["plan", path, layout, "--world", "4", "--min-requesters", "9"]
+    ) == 0
+    assert "0/8 unit(s) claimed" in capsys.readouterr().out
+
+    # An unreadable destination layout is exit 2, not a traceback.
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("{")
+    assert main(["plan", path, bad, "--world", "4"]) == 2

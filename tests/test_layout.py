@@ -1,0 +1,286 @@
+"""Partition-rule layout compiler (ISSUE 12): rule matching, spec
+compilation, serialization, and the DEVICE-FREE box geometry pinned
+against jax's real ``NamedSharding.devices_indices_map`` — the planner
+and the ``tstpu plan`` dry-run trust ``LayoutSpec.boxes_for`` to
+reproduce exactly what jax will do at restore time."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.layout import (
+    LAYOUT_FORMAT_VERSION,
+    LayoutSpec,
+    Rule,
+    resolve_layout,
+)
+
+
+def _spec2():
+    return LayoutSpec(
+        [("x", 2), ("y", 4)],
+        [
+            Rule.of(r"attention/(wq|wk|wv)/kernel$", [None, "y"], dtype="bfloat16"),
+            Rule.of(r"attention/.*", ["y", None]),
+            Rule.of(r"mlp/w_in", [None, ("x", "y")]),
+            Rule.of(r"bias$", [None]),
+        ],
+    )
+
+
+# ---------------------------------------------------------------- matching
+
+
+def test_first_matching_rule_wins() -> None:
+    spec = _spec2()
+    # 'attention/wq/kernel' matches rule 0 AND rule 1; rule 0 wins.
+    rule = spec.match("model/attention/wq/kernel")
+    assert rule is not None and rule.dtype == "bfloat16"
+    assert spec.spec_for("model/attention/wq/kernel", 2) == ((), ("y",))
+    # 'attention/out' only matches the catch-all attention rule.
+    assert spec.spec_for("model/attention/out", 2) == (("y",), ())
+    # re.search semantics: the pattern may match anywhere in the path.
+    assert spec.match("deep/nested/mlp/w_in/kernel") is not None
+
+
+def test_unmatched_path_is_replicated() -> None:
+    spec = _spec2()
+    assert spec.match("model/step") is None
+    assert spec.spec_for("model/step", 0) == ()
+    assert spec.spec_for("model/embedding", 3) == ((), (), ())
+    assert spec.dtype_for("model/step") is None
+
+
+def test_spec_padding_and_overlong() -> None:
+    spec = _spec2()
+    # Shorter spec pads with replicated dims.
+    assert spec.spec_for("model/attention/out", 4) == (("y",), (), (), ())
+    # Longer spec with only-replicated extras truncates silently...
+    assert spec.spec_for("model/bias", 0) == ()
+    # ...but dropping a PARTITIONED dim is an error.
+    with pytest.raises(ValueError, match="spec dims"):
+        spec.spec_for("model/attention/out", 0)
+
+
+def test_match_partition_rules_idiom() -> None:
+    spec = _spec2()
+    compiled = spec.match_partition_rules(
+        {"a/attention/wq/kernel": 2, "a/mlp/w_in": 2, "a/step": 0}
+    )
+    assert compiled == {
+        "a/attention/wq/kernel": ((), ("y",)),
+        "a/mlp/w_in": ((), ("x", "y")),
+        "a/step": (),
+    }
+
+
+def test_dtype_policy() -> None:
+    spec = _spec2()
+    assert spec.dtype_for("m/attention/wq/kernel") == "bfloat16"
+    assert spec.dtype_for("m/attention/out") is None
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_mesh_validation() -> None:
+    with pytest.raises(ValueError, match="at least one"):
+        LayoutSpec([])
+    with pytest.raises(ValueError, match="size 0"):
+        LayoutSpec([("x", 0)])
+    with pytest.raises(ValueError, match="duplicate"):
+        LayoutSpec([("x", 2), ("x", 4)])
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        LayoutSpec([("x", 2)], [Rule.of("w", ["z"])])
+
+
+def test_dict_round_trip() -> None:
+    spec = _spec2()
+    d = spec.to_dict()
+    assert d["version"] == LAYOUT_FORMAT_VERSION
+    back = LayoutSpec.from_dict(d)
+    assert back.mesh_axes == spec.mesh_axes
+    assert back.rules == spec.rules
+    assert back.to_dict() == d
+    # dtype is omitted when unset, kept when set.
+    assert "dtype" not in d["rules"][1]
+    assert d["rules"][0]["dtype"] == "bfloat16"
+
+
+def test_version_gate() -> None:
+    d = _spec2().to_dict()
+    d["version"] = LAYOUT_FORMAT_VERSION + 1
+    with pytest.raises(ValueError, match="newer"):
+        LayoutSpec.from_dict(d)
+
+
+def test_resolve_layout() -> None:
+    assert resolve_layout(None) is None
+    spec = _spec2()
+    assert resolve_layout(spec) == spec.to_dict()
+    assert resolve_layout(spec.to_dict()) == spec.to_dict()
+    with pytest.raises(TypeError, match="LayoutSpec or dict"):
+        resolve_layout(42)
+    # Malformed dicts fail eagerly (at take time, not a later plan).
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        resolve_layout(
+            {"version": 1, "mesh": [["x", 2]],
+             "rules": [{"pattern": "w", "spec": [["nope"]]}]}
+        )
+
+
+# -------------------------------------------------- device-free geometry
+
+
+def test_boxes_replicated_spec() -> None:
+    spec = _spec2()
+    boxes = spec.boxes_for((6, 5), ())
+    assert len(boxes) == 8
+    assert all(b == ((0, 6), (0, 5)) for b in boxes)
+
+
+def test_boxes_single_axis_rows() -> None:
+    spec = LayoutSpec([("x", 4)])
+    boxes = spec.boxes_for((8, 3), [("x",)])
+    assert boxes == [
+        ((0, 2), (0, 3)),
+        ((2, 4), (0, 3)),
+        ((4, 6), (0, 3)),
+        ((6, 8), (0, 3)),
+    ]
+
+
+def test_boxes_ceil_division_tail() -> None:
+    # 10 rows over 4 shards: ceil(10/4)=3 -> 3,3,3,1 (jax's tiling).
+    spec = LayoutSpec([("x", 4)])
+    boxes = spec.boxes_for((10, 2), [("x",)])
+    assert [b[0] for b in boxes] == [(0, 3), (3, 6), (6, 9), (9, 10)]
+
+
+def test_boxes_empty_shard_rejected() -> None:
+    # 4 rows over 8 shards would leave empty shards.
+    spec = LayoutSpec([("x", 8)])
+    with pytest.raises(ValueError, match="non-empty"):
+        spec.boxes_for((4, 4), [("x",)])
+
+
+def test_boxes_by_rank_dedups_replicas() -> None:
+    # Dim 0 split over x only: the 4 y-devices per x-coord hold the SAME
+    # box, so each rank's distinct-box list collapses.
+    spec = _spec2()  # x=2, y=4 -> 8 devices
+    by_rank = spec.boxes_by_rank((8, 4), [("x",), ()], world_size=2)
+    assert by_rank == {0: [((0, 4), (0, 4))], 1: [((4, 8), (0, 4))]}
+    # world=8 (1 device/rank): same boxes, one per rank.
+    by_rank8 = spec.boxes_by_rank((8, 4), [("x",), ()], world_size=8)
+    assert all(len(v) == 1 for v in by_rank8.values())
+    assert by_rank8[0] == by_rank8[3] == [((0, 4), (0, 4))]
+    assert by_rank8[4] == by_rank8[7] == [((4, 8), (0, 4))]
+
+
+def test_rank_of_device_requires_divisibility() -> None:
+    spec = _spec2()
+    assert [spec.rank_of_device(d, 2) for d in range(8)] == [0] * 4 + [1] * 4
+    with pytest.raises(ValueError, match="do not divide"):
+        spec.rank_of_device(0, 3)
+
+
+# ------------------------------------------------ pinned against real jax
+#
+# conftest.py forces 8 host CPU devices, so the jax helpers run
+# in-process; every spec below must produce byte-identical geometry from
+# the device-free compiler and from jax's devices_indices_map.
+
+_JAX_CASES = [
+    ((16, 8), [("x",), ()]),
+    ((16, 8), [(), ("y",)]),
+    ((16, 8), [("x", "y"), ()]),
+    ((16, 8), [("y",), ("x",)]),
+    ((12, 8), [("y",), ()]),  # non-power-of-two rows
+    # (uneven dims are exercised device-free in
+    # test_boxes_ceil_division_tail: this jax build's
+    # devices_indices_map rejects non-dividing shapes outright)
+    ((16, 8), []),  # fully replicated
+    ((12, 6, 4), [("y",), (), ("x",)]),
+]
+
+
+def _normalize_indices(idx, shape):
+    out = []
+    for sl, dim in zip(idx, shape):
+        lo = 0 if sl.start is None else sl.start
+        hi = dim if sl.stop is None else sl.stop
+        out.append((lo, hi))
+    return tuple(out)
+
+
+@pytest.mark.parametrize("shape,spec", _JAX_CASES)
+def test_boxes_match_jax_named_sharding(shape, spec) -> None:
+    jax = pytest.importorskip("jax")
+    layout = _spec2()
+    order = {dev: i for i, dev in enumerate(jax.devices())}
+    mesh = layout.build_mesh()
+    sharding = layout.named_sharding(spec, mesh=mesh)
+    jax_boxes = {
+        order[dev]: _normalize_indices(idx, shape)
+        for dev, idx in sharding.devices_indices_map(tuple(shape)).items()
+    }
+    ours = layout.boxes_for(shape, spec)
+    assert jax_boxes == {d: ours[d] for d in range(len(ours))}
+
+
+def test_shardings_for_whole_tree() -> None:
+    jax = pytest.importorskip("jax")  # noqa: F841
+    layout = _spec2()
+    mesh = layout.build_mesh()
+    shardings = layout.shardings_for(
+        {"m/attention/out": 2, "m/step": 0}, mesh=mesh
+    )
+    assert set(shardings) == {"m/attention/out", "m/step"}
+    # The sharding geometry agrees with the compiled spec's boxes.
+    got = {
+        dev: _normalize_indices(idx, (16, 8))
+        for dev, idx in shardings["m/attention/out"]
+        .devices_indices_map((16, 8))
+        .items()
+    }
+    order = {dev: i for i, dev in enumerate(jax.devices())}
+    ours = layout.boxes_for((16, 8), layout.spec_for("m/attention/out", 2))
+    assert {order[d]: b for d, b in got.items()} == dict(enumerate(ours))
+
+
+# ----------------------------------------------- recorded in the snapshot
+
+
+def test_take_records_layout_in_metadata(tmp_path) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    spec = LayoutSpec([("x", 2)], [Rule.of("w", ["x"])])
+    state = {"model": StateDict(w=np.arange(32, dtype=np.float32))}
+    Snapshot.take(str(tmp_path / "snap"), state, layout=spec)
+    with open(str(tmp_path / "snap" / ".snapshot_metadata")) as f:
+        metadata = SnapshotMetadata.from_yaml(f.read())
+    assert metadata.layout == spec.to_dict()
+    # Round trip: the recorded dict rebuilds the rule set.
+    back = LayoutSpec.from_dict(metadata.layout)
+    assert back.rules == spec.rules
+
+    # No layout -> no key in the metadata at all.
+    Snapshot.take(str(tmp_path / "plain"), state)
+    with open(str(tmp_path / "plain" / ".snapshot_metadata")) as f:
+        raw = f.read()
+    assert "layout" not in raw
+    assert SnapshotMetadata.from_yaml(raw).layout is None
+
+
+def test_take_rejects_malformed_layout(tmp_path) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    state = {"model": StateDict(w=np.arange(8, dtype=np.float32))}
+    with pytest.raises((TypeError, ValueError)):
+        Snapshot.take(str(tmp_path / "bad"), state, layout="tp4")
+    # The failed take must not have committed anything.
+    import os
+
+    assert not os.path.exists(str(tmp_path / "bad" / ".snapshot_metadata"))

@@ -71,6 +71,8 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES",
     "TORCHSNAPSHOT_TPU_PREVERIFY",
     "TORCHSNAPSHOT_TPU_PROGRESS_S",
+    "TORCHSNAPSHOT_TPU_RESHARD",
+    "TORCHSNAPSHOT_TPU_RESHARD_MIN_REQUESTERS",
     "TORCHSNAPSHOT_TPU_STAGING_POOL_BYTES",
     "TORCHSNAPSHOT_TPU_STORE_ADDR",
     "TORCHSNAPSHOT_TPU_STORE_CONNECT_RETRIES",

@@ -48,12 +48,18 @@ PINNED_SITE_FILES = {
     # plugin's native submit/yield boundaries.
     "fs.native_pwrite": os.path.join("storage_plugins", "fs.py"),
     "fs.native_pread": os.path.join("storage_plugins", "fs.py"),
+    # The planned-reshard bundle site (ISSUE 12) is pinned to the
+    # planner: the chaos drills corrupt/kill "the bundle as it leaves
+    # the owner", which is only that while the site sits on reshard.py's
+    # forwarding boundary.
+    "reshard.peer_xfer": "reshard.py",
 }
 
 # Regression floor: the registry started at 15 sites (ISSUE 5), grew
-# the replication/lease sites (ISSUE 6) and the native-engine sites
-# (ISSUE 9). Shrinking it means a drill surface was silently unthreaded.
-MIN_SITES = 20
+# the replication/lease sites (ISSUE 6), the native-engine sites
+# (ISSUE 9), and the planned-reshard bundle site (ISSUE 12). Shrinking
+# it means a drill surface was silently unthreaded.
+MIN_SITES = 21
 
 
 def check_source(

@@ -25,10 +25,13 @@ RULES = ("peer-channel",)
 REPO = REPO_DIR
 PKG = PACKAGE_DIR
 
-# The peer plane: the fan-out protocol/session module and the transport
+# The peer plane: the fan-out protocol/session module, the transport
 # sidecar it rides (dist_store also hosts the KV store — equally
-# device-free by the same invariant).
-PEER_PLANE_FILES = ("fanout.py", "dist_store.py")
+# device-free by the same invariant), and the planned-reshard tier
+# (reshard.py) — its consumers run on the same background restore
+# threads and its planner must stay runnable device-free (CLI dry-run,
+# 50k-shard benchmarks).
+PEER_PLANE_FILES = ("fanout.py", "dist_store.py", "reshard.py")
 
 
 def check_source(source: str, filename: str) -> list:

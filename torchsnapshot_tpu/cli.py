@@ -42,6 +42,10 @@ on-call asks, so they get first-class commands here:
   rank, at what measured rate, and what to tune. Exit code 1 means
   storage-bound, 0 pipeline-bound — benches assert the ROADMAP claim
   with it.
+- ``plan``     — dry-run the minimal-movement reshard plan (reshard.py)
+  for restoring under a different layout at a different world size:
+  per-entry and total storage bytes (planned vs direct) and
+  peer-channel bundle bytes, from manifest geometry alone.
 - ``blackbox`` — merge the per-rank flight-recorder dumps an aborted
   operation left under ``<snapshot>/.flight/`` into one causal
   cross-rank timeline: who deserted whom at which barrier, store
@@ -1230,6 +1234,105 @@ def cmd_explain(args: argparse.Namespace) -> int:
     return critpath.binding_exit_code(doc)
 
 
+def cmd_plan(args: argparse.Namespace) -> int:
+    """Dry-run the minimal-movement reshard plan (reshard.py) for
+    restoring this snapshot under a DIFFERENT layout at a DIFFERENT
+    world size — the byte accounting an on-call wants BEFORE committing
+    a topology change: what the existing direct path would read from
+    storage fleet-wide, what the planner would read instead, and how
+    many bytes ride the peer channel.
+
+    The destination layout is a LayoutSpec dict (the same
+    ``{version, mesh, rules}`` shape ``Snapshot.take(..., layout=)``
+    records in the metadata), loaded from a JSON file. The plan is pure
+    geometry on the manifest: no storage payload is touched.
+
+    Exit codes: 0 plan computed, 2 the layout file or an entry's
+    geometry is unusable."""
+    import json
+
+    from .layout import LayoutSpec
+    from .manifest import ShardedArrayEntry
+    from .reshard import plan_summary
+
+    meta = _load_metadata(args.path)
+    try:
+        with open(args.layout) as f:
+            dst = LayoutSpec.from_dict(json.load(f))
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        print(
+            f"error: cannot load destination layout {args.layout}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+    rows = []
+    totals = {
+        "shards": 0,
+        "planned_units": 0,
+        "direct_bytes_from_storage": 0,
+        "planned_bytes_from_storage": 0,
+        "planned_peer_bytes": 0,
+    }
+    seen = set()
+    bad = 0
+    # Sharded entries repeat under every rank prefix but describe the
+    # same global array; plan each logical entry once.
+    for path, entry in meta.manifest.items():
+        if not isinstance(entry, ShardedArrayEntry):
+            continue
+        logical = path.split("/", 1)[1] if "/" in path else path
+        if logical in seen:
+            continue
+        seen.add(logical)
+        try:
+            spec = dst.spec_for(logical, len(entry.shape))
+            boxes = dst.boxes_by_rank(entry.shape, spec, args.world)
+        except ValueError as e:
+            rows.append({"path": logical, "error": str(e)})
+            bad += 1
+            continue
+        s = plan_summary(entry, boxes, args.min_requesters)
+        s["path"] = logical
+        s["spec"] = [list(dims) for dims in spec]
+        rows.append(s)
+        for k in totals:
+            totals[k] += s[k]
+    if args.json:
+        print(
+            json.dumps(
+                {"world": args.world, "entries": rows, "totals": totals},
+                indent=1,
+            )
+        )
+        return 2 if bad else 0
+    print(f"plan: {args.path} -> world {args.world} under {args.layout}")
+    for s in rows:
+        if "error" in s:
+            print(f"  {s['path']:50s} UNPLANNABLE: {s['error']}")
+            continue
+        print(
+            f"  {s['path']:50s} {s['shards']:4d} shard(s) "
+            f"{s['planned_units']:4d} unit(s)  storage "
+            f"{_fmt_bytes(s['planned_bytes_from_storage']):>10s} "
+            f"(direct {_fmt_bytes(s['direct_bytes_from_storage'])})  "
+            f"peer {_fmt_bytes(s['planned_peer_bytes'])}"
+        )
+    if not rows:
+        print("  (no sharded entries: a pure layout change moves nothing)")
+        return 0
+    direct = totals["direct_bytes_from_storage"]
+    planned = totals["planned_bytes_from_storage"]
+    reduction = direct / planned if planned else float("inf")
+    print(
+        f"totals: storage {_fmt_bytes(planned)} planned vs "
+        f"{_fmt_bytes(direct)} direct ({reduction:.1f}x reduction), "
+        f"peer {_fmt_bytes(totals['planned_peer_bytes'])}, "
+        f"{totals['planned_units']}/{totals['shards']} unit(s) claimed"
+    )
+    return 2 if bad else 0
+
+
 def cmd_consolidate(args: argparse.Namespace) -> int:
     from .dedup import consolidate
 
@@ -1464,6 +1567,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="store_true",
                    help="include the governor's recorded elections")
     p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser(
+        "plan",
+        help="dry-run the minimal-movement reshard plan for restoring "
+             "under a different layout/world: per-entry and total "
+             "storage bytes (planned vs direct) and peer-channel bytes",
+    )
+    p.add_argument("path")
+    p.add_argument("layout", help="destination LayoutSpec JSON file "
+                                  "({version, mesh, rules})")
+    p.add_argument("--world", type=int, required=True,
+                   help="destination world size")
+    p.add_argument("--min-requesters", type=int, default=2,
+                   help="claim threshold: shards with fewer overlapping "
+                        "ranks stay on direct reads (default 2)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the per-entry plan accounting as JSON")
+    p.set_defaults(fn=cmd_plan)
 
     p = sub.add_parser(
         "blackbox",

@@ -342,13 +342,24 @@ class _Offer:
     """One rank's election-time offer: the peer-channel address it will
     serve on (None = not opting in). Created BEFORE the election
     all-gather so the address can ride it; ``engage`` finalizes (or
-    closes the listener when the fleet did not unanimously opt in)."""
+    closes the listener when the fleet did not unanimously opt in).
+
+    The listener/session is a shared TRANSPORT: coop dedup and the
+    planned-reshard tier (reshard.py) both ride it. ``coop_in`` records
+    whether THIS subsystem (coop dedup) opted in — an address may be
+    offered for the reshard tier alone, in which case the engaged
+    session carries reshard bundles but ``plan_for_key`` must not run
+    (the caller gates it on a unanimous ``coop_in``)."""
 
     def __init__(
-        self, addr: Optional[str], listener: Optional[PeerListener]
+        self,
+        addr: Optional[str],
+        listener: Optional[PeerListener],
+        coop_in: Optional[bool] = None,
     ) -> None:
         self.addr = addr
         self._listener = listener
+        self.coop_in = coop_in if coop_in is not None else addr is not None
 
     def engage(
         self,
@@ -379,12 +390,19 @@ class CoopRestoreSession:
     connections, the per-key plan collective, and the failure state."""
 
     @classmethod
-    def local_offer(cls, plugin_name: str, pg_wrapper: Any) -> _Offer:
+    def local_offer(
+        cls, plugin_name: str, pg_wrapper: Any, extra_opt_in: bool = False
+    ) -> _Offer:
         """This rank's election-time opt-in decision. Opting in binds
         the listener (cheap) so the address can ride the election
-        all-gather; a failed election closes it again."""
+        all-gather; a failed election closes it again.
+
+        ``extra_opt_in``: another subsystem (the planned-reshard tier)
+        wants the transport even if coop dedup itself declines — bind
+        and advertise the listener for it; ``_Offer.coop_in`` still
+        reflects only the coop decision."""
         if pg_wrapper.get_world_size() <= 1:
-            return _Offer(None, None)
+            return _Offer(None, None, False)
         mode = coop_restore_mode()
         opt_in = False
         read_bps = None
@@ -402,8 +420,8 @@ class CoopRestoreSession:
             opt_in=opt_in,
             read_bps=read_bps,
         )
-        if not opt_in:
-            return _Offer(None, None)
+        if not (opt_in or extra_opt_in):
+            return _Offer(None, None, False)
         ip = cls._local_ip(pg_wrapper)
         if ip is None:
             # Can't determine an address peers can reach: advertising a
@@ -414,13 +432,13 @@ class CoopRestoreSession:
                 "cannot determine this rank's peer-reachable address; "
                 "opting out of cooperative restore"
             )
-            return _Offer(None, None)
+            return _Offer(None, None, False)
         try:
             listener = PeerListener()
         except OSError:
             logger.exception("peer listener bind failed; opting out")
-            return _Offer(None, None)
-        return _Offer(f"{ip}:{listener.port}", listener)
+            return _Offer(None, None, False)
+        return _Offer(f"{ip}:{listener.port}", listener, opt_in)
 
     @staticmethod
     def _local_ip(pg_wrapper: Any) -> Optional[str]:

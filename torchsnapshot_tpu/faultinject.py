@@ -103,6 +103,10 @@ SITES: Dict[str, str] = {
     # pipeline
     "scheduler.stage": "control",  # per-entry staging admission
     "commit.metadata": "data",    # the .snapshot_metadata commit point
+    # planned-reshard tier (reshard.py): the owner-side bundle just
+    # before it hits the peer channel — corrupt/truncate exercise the
+    # receiver's CRC-then-fallback contract, delay/kill the death drills
+    "reshard.peer_xfer": "data",
 }
 
 KNOWN_SITES = frozenset(SITES)

@@ -167,6 +167,7 @@ def prepare_read(
     buffer_size_limit_bytes: Optional[int] = None,
     device_digests: bool = False,
     assume_verified: bool = False,
+    reshard: Optional[Any] = None,
 ) -> List[ReadReq]:
     """Plan reads for ``entry`` into/for ``obj_out``.
 
@@ -188,6 +189,11 @@ def prepare_read(
     entry's exact content by DISTRIBUTED digest verification (partial
     fingerprint lanes summed across processes over the coordination
     plane, snapshot.py) — plan no reads and keep it.
+
+    ``reshard``: an active ``reshard.ReshardContext`` — sharded entries
+    route multi-requester shards over the planned-peer tier (one storage
+    read on an elected owner, minimal region bundles to everyone else)
+    instead of N direct storage reads.
 
     PrimitiveEntry requires no I/O and must be handled by the caller
     (reference: io_preparer.py:888-890).
@@ -216,7 +222,11 @@ def prepare_read(
         from .sharded import ShardedArrayIOPreparer
 
         return ShardedArrayIOPreparer.prepare_read(
-            entry, obj_out, callback=callback, device_digests=device_digests
+            entry,
+            obj_out,
+            callback=callback,
+            device_digests=device_digests,
+            reshard=reshard,
         )
 
     if not isinstance(entry, (ArrayEntry, ChunkedArrayEntry)):

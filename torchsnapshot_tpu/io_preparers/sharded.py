@@ -164,7 +164,11 @@ class _ShardScatterConsumer(BufferConsumer):
         self.targets = targets  # (dst_buffer, src_slices, dst_slices)
         self.completion = completion
 
-    def _consume_sync(self, buf: BufferType) -> None:
+    def _decode(self, buf: BufferType) -> np.ndarray:
+        """Stored payload -> decoded shard array (verify -> decompress ->
+        view). Shared with the planned-reshard owner consumer
+        (reshard.PlannedOwnerConsumer), which must forward regions of the
+        decoded array before scattering."""
         if self.shard.array.checksum is not None:
             from ..integrity import verification_enabled, verify_checksum
 
@@ -184,13 +188,18 @@ class _ShardScatterConsumer(BufferConsumer):
                     self.shard.array.shape, self.shard.array.dtype
                 ),
             )
-        arr = array_from_buffer(
+        return array_from_buffer(
             buf, self.shard.array.dtype, self.shard.array.shape
         )
+
+    def _scatter(self, arr: np.ndarray) -> None:
         for dst_buf, src_slices, dst_slices in self.targets:
             target = dst_buf[dst_slices] if dst_slices else dst_buf
             fast_copyto(target, arr[src_slices] if src_slices else arr)
         self.completion.part_done()
+
+    def _consume_sync(self, buf: BufferType) -> None:
+        self._scatter(self._decode(buf))
 
     async def consume_buffer(self, buf: BufferType, executor=None) -> None:
         if executor is not None:
@@ -599,6 +608,7 @@ class ShardedArrayIOPreparer:
         obj_out: Any = None,
         callback: Optional[Callable[[Any], None]] = None,
         device_digests: bool = False,
+        reshard: Optional[Any] = None,  # reshard.ReshardContext
     ) -> List[ReadReq]:
         shape = tuple(entry.shape)
         np_dtype = string_to_dtype(entry.dtype)
@@ -643,7 +653,30 @@ class ShardedArrayIOPreparer:
                 if callback is not None:
                     callback(restored)
 
-            return cls._plan_scatter_reads(entry, boxes, finalize)
+            # Planned-peer source tier: with an active reshard context,
+            # project EVERY rank's destination boxes out of the global
+            # device->index map (identical on all ranks — no gather) and
+            # let the planner claim multi-requester shards. Claimed
+            # shards read from storage once (on the elected owner) and
+            # arrive here as peer region bundles; everything else keeps
+            # the direct tier below.
+            reshard_roles = None
+            if reshard is not None:
+                global_boxes: Dict[int, set] = {}
+                for device, index in sharding.devices_indices_map(
+                    shape
+                ).items():
+                    global_boxes.setdefault(device.process_index, set()).add(
+                        _normalize_index(index, shape)
+                    )
+                reshard_roles = reshard.plan_entry(
+                    entry,
+                    {r: sorted(bs) for r, bs in global_boxes.items()},
+                )
+
+            return cls._plan_scatter_reads(
+                entry, boxes, finalize, reshard_roles=reshard_roles
+            )
 
         # numpy / no destination: single box covering the whole array
         if isinstance(obj_out, np.ndarray) and obj_out.flags["WRITEABLE"]:
@@ -675,9 +708,20 @@ class ShardedArrayIOPreparer:
         entry: ShardedArrayEntry,
         boxes: Dict[Box, np.ndarray],
         finalize: Callable[[], None],
+        reshard_roles: Optional[Dict[int, Any]] = None,
     ) -> List[ReadReq]:
-        relevant: List[Tuple[Shard, List]] = []
-        for shard in entry.shards:
+        """One ReadReq per saved shard overlapping a destination box.
+
+        ``reshard_roles`` (shard index -> reshard.OwnerUnit | RecvUnit)
+        upgrades individual shards onto the planned-peer tier: an owner
+        gets a forwarding consumer (reads storage, bundles regions out),
+        a receiver gets a dual-mode consumer whose ReadReq still names
+        the shard's real storage location — the peer path delivers a
+        region bundle, and any peer failure re-reads the SAME request
+        from storage (scheduler fallback), keeping correctness
+        independent of the plan."""
+        relevant: List[Tuple[int, Shard, List]] = []
+        for i, shard in enumerate(entry.shards):
             targets = []
             for box, buf in boxes.items():
                 ov = _overlap(shard.offsets, shard.sizes, box)
@@ -685,7 +729,7 @@ class ShardedArrayIOPreparer:
                     src_slices, dst_slices = ov
                     targets.append((buf, src_slices, dst_slices))
             if targets:
-                relevant.append((shard, targets))
+                relevant.append((i, shard, targets))
 
         if not relevant:
             # nothing overlaps (e.g. zero-size destination) — finalize now
@@ -694,8 +738,20 @@ class ShardedArrayIOPreparer:
 
         completion = _Completion(len(relevant), finalize)
         read_reqs = []
-        for shard, targets in relevant:
-            consumer = _ShardScatterConsumer(shard, targets, completion)
+        for i, shard, targets in relevant:
+            consumer: Any = _ShardScatterConsumer(shard, targets, completion)
+            role = reshard_roles.get(i) if reshard_roles else None
+            if role is not None:
+                from .. import reshard as reshard_mod
+
+                if isinstance(role, reshard_mod.OwnerUnit):
+                    consumer = reshard_mod.PlannedOwnerConsumer(
+                        consumer, role
+                    )
+                else:
+                    consumer = reshard_mod.PlannedRecvConsumer(
+                        consumer, role, boxes
+                    )
             byte_range = (
                 tuple(shard.array.byte_range)
                 if shard.array.byte_range is not None

@@ -1,0 +1,358 @@
+"""Partition-rule layout compiler: regex rules over tree paths -> shardings.
+
+The ecosystem idiom for declaring a model's GSPMD layout (fmengine's
+``match_partition_rules`` / pjit partition specs) is a small ordered list
+of regex rules over '/'-joined tree paths, each mapping to a per-dim
+partition spec — not a hand-written sharding per leaf. This module makes
+that idiom first-class for snapshots:
+
+- :class:`LayoutSpec` compiles an ordered rule list over a named mesh
+  into per-path partition specs with optional attached dtype policies
+  (the storage dtype a matching leaf should be saved in).
+- The spec serializes to a plain dict (:meth:`LayoutSpec.to_dict`) that
+  ``Snapshot.take(..., layout=...)`` records in the snapshot metadata
+  (``SnapshotMetadata.layout``), so a snapshot carries its SOURCE rule
+  set and tooling can plan a restore into a DESTINATION rule set
+  without opening a device (``tstpu plan``).
+- The DEVICE-FREE box compiler (:meth:`boxes_for`,
+  :meth:`boxes_by_rank`) reproduces jax's named-sharding tiling
+  geometry — row-major device placement on the mesh, ceil-division
+  blocks along each partitioned dim — so the reshard planner
+  (reshard.py) and the CLI dry-run can compute every rank's destination
+  boxes from the rule set alone, at 50k-shard cardinality, with no jax
+  import. The jax-gated helpers at the bottom build real
+  ``NamedSharding``s from the same specs; tests pin the two geometries
+  against each other.
+
+At restore time the DESTINATION arrays' real shardings are the source
+of truth (the planner reads ``sharding.devices_indices_map``); the rule
+set is how callers BUILD those destinations (:meth:`named_sharding`)
+and how offline tooling plans without devices. The emulated
+device->rank mapping is contiguous blocks in device order (device ``d``
+belongs to rank ``d * world // n_devices``), matching jax's default
+ordering of one-device-per-process CPU fleets.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+Box = Tuple[Tuple[int, int], ...]  # ((start, stop) per dim)
+
+LAYOUT_FORMAT_VERSION = 1
+
+# A per-dim spec entry: the mesh axes the dim is partitioned over, in
+# order; empty = replicated along every mesh axis (the dim is whole).
+DimSpec = Tuple[str, ...]
+
+
+def _normalize_dim(dim: Any) -> DimSpec:
+    if dim is None:
+        return ()
+    if isinstance(dim, str):
+        return (dim,)
+    return tuple(str(a) for a in dim)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One partition rule: paths matching ``pattern`` (``re.search``,
+    the fmengine convention — anchor with ``^...$`` for exact matches)
+    shard per ``spec``; ``dtype`` optionally names the storage dtype
+    policy for matching leaves (consumed by save tooling / the CLI
+    dry-run's byte estimates, never silently applied)."""
+
+    pattern: str
+    spec: Tuple[DimSpec, ...]
+    dtype: Optional[str] = None
+
+    @classmethod
+    def of(
+        cls, pattern: str, spec: Sequence[Any], dtype: Optional[str] = None
+    ) -> "Rule":
+        return cls(pattern, tuple(_normalize_dim(d) for d in spec), dtype)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "pattern": self.pattern,
+            "spec": [list(dim) for dim in self.spec],
+        }
+        if self.dtype is not None:
+            d["dtype"] = self.dtype
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Rule":
+        return cls.of(d["pattern"], d["spec"], d.get("dtype"))
+
+
+class LayoutSpec:
+    """An ordered rule set over a named mesh. First matching rule wins;
+    a path no rule matches is replicated (every dim whole) — scalars
+    and odd leaves never need an explicit rule."""
+
+    def __init__(
+        self,
+        mesh_axes: Sequence[Tuple[str, int]],
+        rules: Sequence[Rule] = (),
+    ) -> None:
+        self.mesh_axes: Tuple[Tuple[str, int], ...] = tuple(
+            (str(name), int(size)) for name, size in mesh_axes
+        )
+        if not self.mesh_axes:
+            raise ValueError("layout needs at least one mesh axis")
+        seen = set()
+        for name, size in self.mesh_axes:
+            if size < 1:
+                raise ValueError(f"mesh axis {name!r} has size {size}")
+            if name in seen:
+                raise ValueError(f"duplicate mesh axis {name!r}")
+            seen.add(name)
+        self.rules: Tuple[Rule, ...] = tuple(rules)
+        self._axis_size = dict(self.mesh_axes)
+        self._compiled = [
+            (re.compile(rule.pattern), rule) for rule in self.rules
+        ]
+        for rule in self.rules:
+            used: set = set()
+            for dim in rule.spec:
+                for axis in dim:
+                    if axis not in self._axis_size:
+                        raise ValueError(
+                            f"rule {rule.pattern!r} references unknown mesh "
+                            f"axis {axis!r} (mesh: {list(self._axis_size)})"
+                        )
+                    if axis in used:
+                        # jax's PartitionSpec invariant: reusing an axis
+                        # would tile the SAME device coordinate into two
+                        # dims and leave off-diagonal holes.
+                        raise ValueError(
+                            f"rule {rule.pattern!r} uses mesh axis "
+                            f"{axis!r} more than once"
+                        )
+                    used.add(axis)
+
+    # ------------------------------------------------------------- matching
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, size in self.mesh_axes:
+            n *= size
+        return n
+
+    def match(self, path: str) -> Optional[Rule]:
+        """First rule whose pattern matches ``path`` (``re.search``), or
+        None (replicated)."""
+        for regex, rule in self._compiled:
+            if regex.search(path):
+                return rule
+        return None
+
+    def spec_for(self, path: str, ndim: int) -> Tuple[DimSpec, ...]:
+        """The path's per-dim spec, padded with replicated dims to
+        ``ndim``. A matched spec longer than ``ndim`` is an error —
+        silently dropping a partitioned dim would change the layout."""
+        rule = self.match(path)
+        spec: Tuple[DimSpec, ...] = rule.spec if rule is not None else ()
+        if len(spec) > ndim:
+            if any(spec[ndim:]):
+                raise ValueError(
+                    f"rule {rule.pattern!r} has {len(spec)} spec dims but "
+                    f"{path!r} has only {ndim}"
+                )
+            spec = spec[:ndim]
+        return spec + ((),) * (ndim - len(spec))
+
+    def dtype_for(self, path: str) -> Optional[str]:
+        rule = self.match(path)
+        return rule.dtype if rule is not None else None
+
+    def match_partition_rules(
+        self, paths_ndim: Dict[str, int]
+    ) -> Dict[str, Tuple[DimSpec, ...]]:
+        """The fmengine idiom over a flattened tree: '/'-joined path ->
+        compiled per-dim spec, for every leaf at once."""
+        return {
+            path: self.spec_for(path, ndim)
+            for path, ndim in paths_ndim.items()
+        }
+
+    # --------------------------------------------------- device-free boxes
+
+    def _shards_per_dim(self, spec: Sequence[DimSpec], ndim: int) -> List[int]:
+        counts = []
+        for i in range(ndim):
+            n = 1
+            for axis in (spec[i] if i < len(spec) else ()):
+                n *= self._axis_size[axis]
+            counts.append(n)
+        return counts
+
+    def boxes_for(
+        self, shape: Sequence[int], spec: Sequence[DimSpec]
+    ) -> List[Box]:
+        """One destination box per device, indexed by device id (row-major
+        placement over the mesh axes, jax's default ``Mesh`` order).
+        Blocks use ceil division per partitioned dim — the named-sharding
+        tiling — and every shard must be non-empty."""
+        shape = tuple(int(s) for s in shape)
+        spec = tuple(_normalize_dim(d) for d in spec)
+        ndim = len(shape)
+        if len(spec) > ndim and any(spec[ndim:]):
+            raise ValueError(
+                f"spec has {len(spec)} dims for a rank-{ndim} array"
+            )
+        used: set = set()
+        for dim_axes in spec:
+            for axis in dim_axes:
+                if axis in used:
+                    raise ValueError(
+                        f"spec uses mesh axis {axis!r} more than once"
+                    )
+                used.add(axis)
+        counts = self._shards_per_dim(spec, ndim)
+        for dim, n in zip(shape, counts):
+            if n > 1 and math.ceil(dim / n) * (n - 1) >= dim:
+                raise ValueError(
+                    f"dim of size {dim} cannot be tiled into {n} non-empty "
+                    f"shards"
+                )
+        mesh_names = [name for name, _ in self.mesh_axes]
+        mesh_sizes = [size for _, size in self.mesh_axes]
+        boxes: List[Box] = []
+        for device in range(self.n_devices):
+            # Row-major unravel of the device id over the mesh axes.
+            coords: Dict[str, int] = {}
+            rem = device
+            for name, size in zip(reversed(mesh_names), reversed(mesh_sizes)):
+                coords[name] = rem % size
+                rem //= size
+            box: List[Tuple[int, int]] = []
+            for i, dim in enumerate(shape):
+                axes = spec[i] if i < len(spec) else ()
+                idx = 0
+                for axis in axes:  # row-major over the listed axes
+                    idx = idx * self._axis_size[axis] + coords[axis]
+                block = math.ceil(dim / counts[i]) if counts[i] > 1 else dim
+                lo = min(idx * block, dim)
+                hi = min(lo + block, dim)
+                box.append((lo, hi))
+            boxes.append(tuple(box))
+        return boxes
+
+    def rank_of_device(self, device: int, world_size: int) -> int:
+        """Emulated device->rank mapping: contiguous equal blocks in
+        device order (jax's ordering for one-device-per-process CPU
+        fleets and the common pod topology)."""
+        n = self.n_devices
+        if world_size < 1 or n % world_size:
+            raise ValueError(
+                f"{n} devices do not divide into {world_size} rank(s)"
+            )
+        return device // (n // world_size)
+
+    def boxes_by_rank(
+        self, shape: Sequence[int], spec: Sequence[DimSpec], world_size: int
+    ) -> Dict[int, List[Box]]:
+        """Each rank's DISTINCT destination boxes, sorted — the planner's
+        input shape (reshard.plan_transfers). Replication across mesh
+        axes collapses: a rank holding the same box on two devices needs
+        its bytes once."""
+        per_rank: Dict[int, set] = {r: set() for r in range(world_size)}
+        for device, box in enumerate(self.boxes_for(shape, spec)):
+            per_rank[self.rank_of_device(device, world_size)].add(box)
+        return {r: sorted(boxes) for r, boxes in per_rank.items()}
+
+    # --------------------------------------------------------- serialization
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": LAYOUT_FORMAT_VERSION,
+            "mesh": [[name, size] for name, size in self.mesh_axes],
+            "rules": [rule.to_dict() for rule in self.rules],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LayoutSpec":
+        version = d.get("version", 1)
+        if version > LAYOUT_FORMAT_VERSION:
+            raise ValueError(
+                f"layout format version {version} is newer than this "
+                f"build understands ({LAYOUT_FORMAT_VERSION})"
+            )
+        return cls(
+            [(name, size) for name, size in d["mesh"]],
+            [Rule.from_dict(r) for r in d.get("rules", [])],
+        )
+
+    def __repr__(self) -> str:
+        mesh = ", ".join(f"{n}={s}" for n, s in self.mesh_axes)
+        return f"LayoutSpec(mesh=({mesh}), rules={len(self.rules)})"
+
+    # ---------------------------------------------------------- jax helpers
+    #
+    # Everything below may import jax; nothing above ever does (the box
+    # compiler must stay usable from the device-free planner and CLI).
+
+    def build_mesh(self, devices: Optional[Iterable[Any]] = None):
+        """A ``jax.sharding.Mesh`` over this layout's axes (row-major
+        placement, matching the device-free box compiler)."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) != self.n_devices:
+            raise ValueError(
+                f"layout wants {self.n_devices} device(s), have {len(devs)}"
+            )
+        shape = tuple(size for _, size in self.mesh_axes)
+        names = tuple(name for name, _ in self.mesh_axes)
+        return Mesh(np.array(devs, dtype=object).reshape(shape), names)
+
+    def named_sharding(self, spec: Sequence[Any], mesh=None):
+        """A ``NamedSharding`` for one compiled per-dim spec."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if mesh is None:
+            mesh = self.build_mesh()
+        parts: List[Any] = []
+        for dim in (_normalize_dim(d) for d in spec):
+            if not dim:
+                parts.append(None)
+            elif len(dim) == 1:
+                parts.append(dim[0])
+            else:
+                parts.append(tuple(dim))
+        return NamedSharding(mesh, PartitionSpec(*parts))
+
+    def shardings_for(self, paths_ndim: Dict[str, int], mesh=None):
+        """'/'-joined path -> ``NamedSharding`` for a whole flattened
+        tree (the ``make_shard_and_gather_fns`` use case: build every
+        destination array under the rule set, then restore into them)."""
+        if mesh is None:
+            mesh = self.build_mesh()
+        return {
+            path: self.named_sharding(spec, mesh=mesh)
+            for path, spec in self.match_partition_rules(paths_ndim).items()
+        }
+
+
+def resolve_layout(layout: Any) -> Optional[Dict[str, Any]]:
+    """Coerce a user-supplied layout (LayoutSpec or an already-plain
+    dict) into the serializable metadata form; None passes through."""
+    if layout is None:
+        return None
+    if isinstance(layout, LayoutSpec):
+        return layout.to_dict()
+    if isinstance(layout, dict):
+        # Validate eagerly: a malformed rule set must fail the take, not
+        # a later plan/restore that reads it back.
+        return LayoutSpec.from_dict(layout).to_dict()
+    raise TypeError(
+        f"layout must be a LayoutSpec or dict, not {type(layout).__name__}"
+    )

@@ -481,6 +481,14 @@ class SnapshotMetadata:
     # lost (see storage_plugins/mirror.py).
     mirror_url: Optional[str] = None
     origin_mirrors: Optional[Dict[str, str]] = None
+    # The SOURCE partition-rule layout this snapshot was taken under
+    # (layout.LayoutSpec.to_dict() — mesh axes + regex rules + dtype
+    # policies), when the caller declared one via Snapshot.take(...,
+    # layout=...). Purely descriptive metadata: restores never require
+    # it (the destination arrays' real shardings are authoritative), but
+    # `tstpu plan` uses it to dry-run a reshard into a destination rule
+    # set without opening a device. Omitted from YAML when unset.
+    layout: Optional[Dict[str, Any]] = None
 
     def to_yaml(self) -> str:
         """Serialize to the on-disk metadata format.
@@ -513,6 +521,8 @@ class SnapshotMetadata:
             d["mirror_url"] = self.mirror_url
         if self.origin_mirrors:
             d["origin_mirrors"] = self.origin_mirrors
+        if self.layout:
+            d["layout"] = self.layout
         # allow_nan=False: a non-finite float would silently emit
         # JSON-invalid tokens; no entry field legitimately carries one
         # (primitives serialize through reprs).
@@ -535,6 +545,7 @@ class SnapshotMetadata:
             manifest=manifest,
             mirror_url=d.get("mirror_url"),
             origin_mirrors=d.get("origin_mirrors"),
+            layout=d.get("layout"),
         )
 
 

@@ -397,6 +397,19 @@ class IOGovernor:
         bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
         return bps is not None and bps < _STREAM_READ_LATENCY_BPS
 
+    def should_planned_reshard(self, plugin: Optional[str] = None) -> bool:
+        """Economic gate for the planned-reshard tier (reshard.py, under
+        ``TORCHSNAPSHOT_TPU_RESHARD=auto``): replacing R storage reads
+        of a multi-requester shard with one read plus minimal peer
+        region bundles wins exactly when storage bandwidth — not the
+        host network — is the bottleneck, which is the same knee the
+        coop-restore and streamed-read elections sit on. Memcpy-speed
+        local fs (page-cache reads) stays on the direct overlap-scatter
+        path; no recorded read rate means no evidence, so the status quo
+        stays."""
+        bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
+        return bps is not None and bps < _STREAM_READ_LATENCY_BPS
+
 
 def preverify_mode() -> str:
     """THE parser for ``TORCHSNAPSHOT_TPU_PREVERIFY`` — every consumer
@@ -1494,11 +1507,20 @@ class _ReadPipeline:
                 return self
             # Peer delivery failed (owner death / abort / timeout /
             # integrity): degrade to a direct storage read — the budget
-            # difference was already re-charged. The fallback is a REAL
-            # storage request that dispatch's slot exemption never
-            # counted, so it takes a slot here: a mass peer failure
-            # (dead owner with many units) must not flood the backend
-            # with more concurrent reads than the governor's cap.
+            # difference was already re-charged. Dual-mode consumers
+            # (reshard.PlannedRecvConsumer, whose peer payload is a
+            # region BUNDLE rather than the stored payload) are told
+            # first, so the re-read of the same request decodes as raw
+            # storage bytes. The fallback is a REAL storage request that
+            # dispatch's slot exemption never counted, so it takes a
+            # slot here: a mass peer failure (dead owner with many
+            # units) must not flood the backend with more concurrent
+            # reads than the governor's cap.
+            on_fallback = getattr(
+                self.read_req.buffer_consumer, "on_peer_fallback", None
+            )
+            if on_fallback is not None:
+                on_fallback()
             if self.fallback_gate is not None:
                 async with self.fallback_gate:
                     await self._buffered_read_and_consume(

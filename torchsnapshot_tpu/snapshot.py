@@ -207,6 +207,7 @@ class Snapshot:
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
         device_digests: Optional[bool] = None,
+        layout: Optional[Any] = None,
     ) -> "Snapshot":
         """Persist ``app_state`` at ``path``.
 
@@ -241,6 +242,14 @@ class Snapshot:
         ``TORCHSNAPSHOT_TPU_COMPRESSION`` env var, else off. The codec is
         recorded per entry, so mixed-codec snapshots/chains restore
         transparently (see compression.py for the full design rules).
+
+        ``layout`` declares the partition-rule layout this state was
+        built under (a :class:`layout.LayoutSpec` or its ``to_dict()``
+        form): the rule set is recorded in the snapshot metadata as the
+        snapshot's SOURCE layout, so ``tstpu plan`` can dry-run a
+        reshard into a destination rule set and operators can see what
+        layout a checkpoint was written from. Descriptive only — shard
+        geometry always comes from the arrays' real shardings.
         """
         cls._validate_app_state(app_state)
         cls._validate_save_dtype(save_dtype)
@@ -282,6 +291,7 @@ class Snapshot:
                     compression=compression,
                     save_dtype=save_dtype,
                     device_digests=device_digests,
+                    layout=layout,
                     streaming=True,
                 )
             # Drain + commit, with the cross-rank error channel armed:
@@ -381,14 +391,15 @@ class Snapshot:
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
         device_digests: Optional[bool] = None,
+        layout: Optional[Any] = None,
     ) -> "PendingSnapshot":
         """Non-blocking take. Returns once *staging* (DtoH copy + serialize)
         completes — after that, mutations to the app state do not affect the
         snapshot. Storage I/O and the metadata commit continue on a
         background thread; call ``.wait()`` on the returned handle
         (reference: snapshot.py:245-313). ``incremental_base`` /
-        ``record_digests`` / ``save_dtype`` / ``device_digests`` as in
-        :meth:`take`."""
+        ``record_digests`` / ``save_dtype`` / ``device_digests`` /
+        ``layout`` as in :meth:`take`."""
         cls._validate_app_state(app_state)
         cls._validate_save_dtype(save_dtype)
         event_loop = asyncio.new_event_loop()
@@ -419,6 +430,7 @@ class Snapshot:
                 compression=compression,
                 save_dtype=save_dtype,
                 device_digests=device_digests,
+                layout=layout,
             )
         except BaseException as e:  # noqa: B036
             telemetry.flightrec.record(
@@ -462,11 +474,18 @@ class Snapshot:
         compression: Optional[str] = None,
         save_dtype: Optional[Dict[str, str]] = None,
         device_digests: Optional[bool] = None,
+        layout: Optional[Any] = None,
         streaming: bool = False,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         timer = timer or _PhaseTimer("Snapshot.take")  # unlogged unless the caller logs
         rank = pg_wrapper.get_rank()
         world_size = pg_wrapper.get_world_size()
+        # Validate/serialize the declared layout BEFORE any staging: a
+        # malformed rule set must fail the take here, not a later plan
+        # or restore that reads the metadata back.
+        from .layout import resolve_layout
+
+        layout_dict = resolve_layout(layout)
         app_state = dict(app_state)
 
         from .compression import compression_staging, env_codec, resolve_codec
@@ -731,6 +750,7 @@ class Snapshot:
                 manifest=global_manifest,
                 mirror_url=own_mirror,
                 origin_mirrors=origin_mirrors or None,
+                layout=layout_dict,
             )
             # Runtime-only commit context (never serialized — to_yaml
             # walks declared fields only): the fence token the commit
@@ -882,7 +902,15 @@ class Snapshot:
             # gate) RIDES THE SAME all-gather: a multi-rank restore pays
             # one flag round trip, not two. Each rank's peer-channel
             # address travels with its opt-in; cooperation engages only
-            # when every rank offered one.
+            # when every rank offered one. The planned-reshard election
+            # (reshard.py — TORCHSNAPSHOT_TPU_RESHARD + the governor's
+            # should_planned_reshard gate) rides it as well: its vote is
+            # one more element of the SAME gathered tuple, never a
+            # second round trip (pinned by tests — the tuple is
+            # (preverify, addr, coop, reshard)). The peer listener and
+            # session are a shared transport: either subsystem opting in
+            # binds it, and each engages only on its own unanimous vote,
+            # so env skew in one knob cannot half-enable the other.
             manifest_verifiable = any(
                 isinstance(e, ShardedArrayEntry)
                 and e.shards
@@ -890,7 +918,10 @@ class Snapshot:
                 for e in available.values()
             )
             dist_verify = False
+            use_coop = False
+            reshard_min_req = 0
             if pg_wrapper.get_world_size() > 1:
+                from . import reshard as reshard_mod
                 from .fanout import CoopRestoreSession
 
                 local_pre = False
@@ -900,14 +931,26 @@ class Snapshot:
                     ) and self._preverify_worthwhile(
                         storage, explicit=explicit_digests
                     )
+                # Reshard vote: 0 = opted out, else this rank's
+                # min-requesters knob (the fleet negotiates max() so a
+                # skewed env still yields ONE deterministic plan).
+                local_reshard = (
+                    reshard_mod.reshard_min_requesters()
+                    if reshard_mod.local_opt_in(
+                        type(storage).__name__, pg_wrapper
+                    )
+                    else 0
+                )
                 offer = CoopRestoreSession.local_offer(
-                    type(storage).__name__, pg_wrapper
+                    type(storage).__name__,
+                    pg_wrapper,
+                    extra_opt_in=local_reshard > 0,
                 )
                 gathered_flags = pg_wrapper.all_gather_object(
-                    (bool(local_pre), offer.addr)
+                    (bool(local_pre), offer.addr, offer.coop_in, local_reshard)
                 )
                 if manifest_verifiable:
-                    dist_verify = all(bool(p) for p, _ in gathered_flags)
+                    dist_verify = all(f[0] for f in gathered_flags)
                     if local_pre and not dist_verify:
                         logger.info(
                             "distributed digest verification disabled for "
@@ -915,8 +958,12 @@ class Snapshot:
                             "skew or rate-gate divergence); reading normally"
                         )
                 coop_session = offer.engage(
-                    [a for _, a in gathered_flags], rank, event_loop
+                    [f[1] for f in gathered_flags], rank, event_loop
                 )
+                if coop_session is not None:
+                    use_coop = all(f[2] for f in gathered_flags)
+                    if all(f[3] > 0 for f in gathered_flags):
+                        reshard_min_req = max(f[3] for f in gathered_flags)
             for key in ordered:
                 prepared = None
                 if key in app_state:
@@ -940,6 +987,24 @@ class Snapshot:
                 # gather is by slot, and a deserted one would hang
                 # peers. A rank contributing nothing simply isn't a
                 # requester; its would-be units stay direct elsewhere.
+                # Planned-reshard context for this key: the plan is a
+                # pure function of (manifest, destination shardings,
+                # world size) — devices_indices_map is global — so every
+                # rank computes identical roles with no communication. A
+                # rank that never plans (missing key, planning failure)
+                # simply never forwards; its subscribers time out into
+                # counted storage fallbacks, trading speed, never
+                # correctness.
+                reshard_ctx = None
+                if reshard_min_req > 0 and coop_session is not None:
+                    from . import reshard as reshard_mod
+
+                    reshard_ctx = reshard_mod.ReshardContext(
+                        coop_session,
+                        rank,
+                        pg_wrapper.get_world_size(),
+                        min_requesters=reshard_min_req,
+                    )
                 groups = None
                 flattened = None
                 if prepared is not None:
@@ -952,6 +1017,7 @@ class Snapshot:
                             device_digests=device_digests,
                             prepared=prepared,
                             preverified=preverified,
+                            reshard=reshard_ctx,
                         )
                         groups = self._group_read_reqs(read_reqs)
                     except BaseException as e:  # noqa: B036
@@ -959,10 +1025,25 @@ class Snapshot:
                             exc = e
                         groups = None
                 coop_plan = None
-                if coop_session is not None:
+                if coop_session is not None and use_coop:
+                    # Reshard-claimed requests stay OUT of the coop unit
+                    # gather: their roles are already assigned by the
+                    # (identical-on-every-rank) plan, so the filter is
+                    # symmetric and the two subsystems can never hand
+                    # one request conflicting roles.
                     coop_plan = coop_session.plan_for_key(
-                        [rr for _, reqs in (groups or []) for rr in reqs],
+                        [
+                            rr
+                            for _, reqs in (groups or [])
+                            for rr in reqs
+                            if reshard_ctx is None
+                            or not reshard_mod.is_reshard_claimed(rr)
+                        ],
                         pg_wrapper,
+                    )
+                if reshard_ctx is not None:
+                    coop_plan = reshard_mod.ComposedRestorePlan(
+                        reshard_ctx, coop_plan
                     )
                 if groups is not None:
                     try:
@@ -1247,6 +1328,7 @@ class Snapshot:
         device_digests: bool,
         prepared: "Tuple[Any, Dict[str, Any]]",
         preverified: "Optional[set]" = None,
+        reshard: "Optional[Any]" = None,
     ) -> "Tuple[List[ReadReq], Dict[str, Any]]":
         """Plan one app-state key's reads WITHOUT executing them.
 
@@ -1254,7 +1336,10 @@ class Snapshot:
         (fanout.py) can run between planning and execution — the plan is
         an all-gather of each rank's actual request set, so requests
         must exist before it and execution must wait for it. Primitive
-        entries are resolved into ``flattened`` here (no I/O)."""
+        entries are resolved into ``flattened`` here (no I/O).
+        ``reshard`` (reshard.ReshardContext) routes multi-requester
+        sharded shards over the planned-peer tier; the planner needs no
+        collective of its own, so this stays pure planning."""
         _, flattened = prepared
         preverified = preverified or set()
 
@@ -1292,6 +1377,7 @@ class Snapshot:
                     callback=_cb,
                     device_digests=device_digests,
                     assume_verified=logical_path in preverified,
+                    reshard=reshard,
                 )
             )
         return read_reqs, flattened
@@ -1410,7 +1496,18 @@ class Snapshot:
         produced while its peers consume group N — never a group apart
         by construction. Batching (read coalescing) runs per group
         BEFORE the cooperative plan is gathered, so unit keys name the
-        exact requests the scheduler will execute."""
+        exact requests the scheduler will execute.
+
+        Interaction with the planned-reshard tier (reshard.py): sharded
+        shard reads carry ``byte_range=None`` and pass through
+        ``batch_read_requests`` untouched, so a reshard-claimed request
+        can never be merged away between planning and execution. The
+        reshard plan needs no gather at all (it is a pure function of
+        manifest + destination shardings), and its election vote rides
+        the SAME preverify-gate all-gather as the coop election — the
+        restore prologue pays exactly ONE flag round trip however many
+        peer subsystems engage (pinned by
+        tests/test_reshard_restore.py::test_single_election_gather)."""
         groups: Dict[Optional[str], List[ReadReq]] = {}
         for rr in read_reqs:
             groups.setdefault(rr.origin, []).append(rr)

@@ -78,6 +78,12 @@ SPAN_CATEGORIES: Dict[str, str] = {
     "coop_read": "peer_transfer",
     "peer_send": "peer_transfer",
     "peer_recv": "peer_transfer",
+    # Planned-reshard tier (reshard.py): plan computation and owner-side
+    # region-bundle forwarding ride the peer_transfer lane — both exist
+    # only to replace storage reads with peer traffic, so attribution
+    # groups them with the coop fan-out they extend.
+    "reshard_plan": "peer_transfer",
+    "peer_reshard": "peer_transfer",
     # Native-engine waits (fs plugin, io_uring reap/drain): time the
     # pipeline spent blocked on queued kernel I/O — submissions are
     # non-blocking, so these spans ARE the engine's storage wait.

@@ -44,6 +44,9 @@ EVENTS: Dict[str, str] = {
     "mirror.failover": "primary-tier read failed over to the mirror (path, kind)",
     # cooperative restore (fanout.py)
     "fanout.fallback": "peer-fed unit degraded to a direct storage read (key, owner)",
+    # planned reshard (reshard.py)
+    "reshard.plan": "one entry's minimal-movement reshard plan computed "
+    "(shards, planned, owned, recv)",
     # commit protocol (snapshot.py)
     "fence.plant": "rank 0 planted the commit fence (gen)",
     "commit.decision": "fenced commit decision (gen, found, ok) — StaleCommitError when not ok",
