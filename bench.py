@@ -841,6 +841,47 @@ def main() -> None:
         else:
             _log("host contaminated: telemetry overhead assert skipped")
 
+        # Forensics leg: the main leg's saves ran with the hang watchdog
+        # armed (the shipping default — telemetry/forensics.py). A few
+        # watchdog-disabled saves bound its always-on cost the other way
+        # around: overhead = main-leg best MINUS disabled best. Same
+        # early-stop recipe as the telemetry leg (bimodal host).
+        from torchsnapshot_tpu.telemetry import forensics as _forensics
+
+        forensics_budget_s = max(0.01 * dt, 0.05)
+        noforensics_times = []
+        _forensics.set_enabled(False)
+        try:
+            for nf_trial in range(6):
+                shutil.rmtree(f"{tmp}/snap", ignore_errors=True)
+                t0 = time.perf_counter()
+                Snapshot.take(f"{tmp}/snap", app_state)
+                noforensics_times.append(time.perf_counter() - t0)
+                _log(
+                    f"forensics-disabled save {nf_trial}: "
+                    f"{noforensics_times[-1]:.2f}s "
+                    f"({nbytes / 1e9 / noforensics_times[-1]:.2f} GB/s)"
+                )
+                if nf_trial >= 1 and (dt - min(noforensics_times)) < forensics_budget_s:
+                    break
+        finally:
+            _forensics.set_enabled(True)
+        forensics_overhead_pct = round(
+            (dt - min(noforensics_times)) / min(noforensics_times) * 100, 2
+        )
+        _log(
+            f"forensics leg: overhead {forensics_overhead_pct:+.2f}% "
+            "(enabled main-leg best vs disabled best)"
+        )
+        if not calibration["contaminated"]:
+            assert (dt - min(noforensics_times)) < forensics_budget_s, (
+                f"always-on hang-watchdog overhead {forensics_overhead_pct:.2f}% "
+                f">= 1% budget (disabled best {min(noforensics_times):.3f}s vs "
+                f"enabled best {dt:.3f}s, floor 50 ms)"
+            )
+        else:
+            _log("host contaminated: forensics overhead assert skipped")
+
         # Timed restores into a device-resident destination (mmap read
         # path + zero-copy device_put).
         dst = {"model": StateDict({k: jnp.zeros_like(v) for k, v in state.items()})}
@@ -882,6 +923,9 @@ def main() -> None:
         # Enabled-vs-disabled cost of the telemetry subsystem (full
         # per-take summary + trace in BENCH_TELEMETRY.json).
         "telemetry_overhead_pct": telemetry_overhead_pct,
+        # Always-on hang-watchdog cost (telemetry/forensics.py): main-leg
+        # best (watchdog armed, the default) vs watchdog-disabled best.
+        "forensics_overhead_pct": forensics_overhead_pct,
     }
     if discarded_trials:
         # Trials where the post-trial memcpy probe showed the host was

@@ -34,6 +34,13 @@ Two legs:
     the same 50 ms floor. The recorder records tens of events per save,
     never per-sub-chunk samples, so the gate has enormous margin — it
     exists to keep that invariant pinned.
+    And gates the hang watchdog's ALWAYS-ON cost (ISSUE 13): the same
+    2 GiB save with the stall-forensics watchdog armed (the shipping
+    default — a daemon thread sampling every thread's stack twice a
+    second plus duration-ring bookkeeping at every storage guard) vs
+    ``forensics.set_enabled(False)``, best-vs-best < 1% with the 50 ms
+    floor. Sampling is O(threads) every half second, off the hot path
+    entirely.
     And gates the latency-histogram instrument (ISSUE 8): the same
     2 GiB save with the telemetry bus ENABLED and the histograms fully
     wired (per-sub-chunk and per-entry observations recording) vs the
@@ -450,6 +457,89 @@ def flightrec_overhead(trials: int = 5) -> None:
     )
 
 
+def forensics_overhead(trials: int = 5) -> None:
+    """Always-on hang-watchdog overhead on a ~2 GiB save: the shipping
+    default (watchdog armed per op, stack sampler ticking on its own
+    daemon thread, storage guards feeding the per-kind duration rings)
+    vs hard-disabled (``forensics.set_enabled(False)`` — ``arm``
+    returns ``None``, no thread, guards fall through). Asserts
+    best-vs-best delta < 1% with a 50 ms floor (ISSUE 13 acceptance;
+    same paired/alternating bimodal-host recipe as the legs above)."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.telemetry import forensics
+
+    nbytes = 2 << 30
+    n_arrays = 8
+    per = nbytes // n_arrays // 4
+    state = {
+        "model": StateDict(
+            **{
+                f"p{i}": np.random.default_rng(i)
+                .standard_normal(per)
+                .astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+    }
+
+    def timed_save() -> float:
+        root = tempfile.mkdtemp(prefix="forensics_overhead_")
+        try:
+            t0 = time.perf_counter()
+            Snapshot.take(os.path.join(root, "s"), state)
+            return time.perf_counter() - t0
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    def disabled(fn):
+        forensics.set_enabled(False)
+        try:
+            return fn()
+        finally:
+            forensics.set_enabled(True)
+
+    forensics.set_enabled(True)  # the shipping default, made explicit
+    timed_save()  # discarded warmup (staging-pool first-touch faults)
+    on_walls, off_walls = [], []
+    max_pairs = 2 * trials
+    for pair in range(max_pairs):
+        if pair % 2 == 0:
+            off = disabled(timed_save)
+            on = timed_save()
+        else:
+            on = timed_save()
+            off = disabled(timed_save)
+        on_walls.append(on)
+        off_walls.append(off)
+        budget_s = max(0.01 * min(off_walls), 0.05)
+        if pair + 1 >= trials and (min(on_walls) - min(off_walls)) < budget_s:
+            break
+    off_best, on_best = min(off_walls), min(on_walls)
+    budget_s = max(0.01 * off_best, 0.05)
+    delta = (on_best - off_best) / off_best
+    report(
+        "forensics_overhead",
+        {
+            "gib": round(nbytes / (1 << 30), 2),
+            "pairs": len(on_walls),
+            "sample_cadence_s": forensics.sample_cadence_s(),
+            "disabled_trials_s": [round(t, 3) for t in off_walls],
+            "enabled_trials_s": [round(t, 3) for t in on_walls],
+            "disabled_best_s": round(off_best, 3),
+            "enabled_best_s": round(on_best, 3),
+            "overhead_pct": round(delta * 100, 3),
+        },
+        data_bytes=nbytes,
+    )
+    assert (on_best - off_best) < budget_s, (
+        f"always-on hang-watchdog overhead {delta * 100:.2f}% over the 1% "
+        f"budget (disabled best {off_best:.3f}s vs enabled best "
+        f"{on_best:.3f}s, floor 50 ms)"
+    )
+
+
 def histogram_overhead(trials: int = 5) -> None:
     """Histogram-instrument overhead on a ~2 GiB save with the telemetry
     bus ENABLED (the configuration where the instruments actually fire):
@@ -734,6 +824,7 @@ def main() -> None:
     if args.overhead:
         overhead(args.trials)
         flightrec_overhead(args.trials)
+        forensics_overhead(args.trials)
         histogram_overhead(args.trials)
         native_io_overhead(args.trials)
         store_overhead(args.trials)

@@ -1,0 +1,491 @@
+"""Stall forensics (telemetry/forensics.py): the always-on hang
+watchdog, stack sampling/classification, self- and remote-triggered
+dumps, and the blackbox WEDGE/frames merge.
+
+The end-to-end hang drill (delay-injected w2 take -> stalled rank
+self-dumps -> watch --dump round trip) lives in test_watch_cli.py next
+to the health-plane drill it extends.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.telemetry import flightrec, forensics, health
+
+
+@pytest.fixture(autouse=True)
+def _forensics_clean():
+    """Every test starts enabled with empty registries and leaves the
+    module the way the shipping default has it."""
+    forensics.set_enabled(True)
+    forensics._reset_registries_for_tests()
+    health.clear()
+    yield
+    forensics.set_enabled(True)
+    forensics._reset_registries_for_tests()
+    health.clear()
+
+
+# ----------------------------------------------------------- env gating
+
+
+def test_enabled_by_default_and_env_gate(monkeypatch):
+    monkeypatch.delenv(forensics.FORENSICS_ENV_VAR, raising=False)
+    assert forensics.refresh_from_env() is True
+    for off in ("0", "off", "false", "no", "never"):
+        monkeypatch.setenv(forensics.FORENSICS_ENV_VAR, off)
+        assert forensics.refresh_from_env() is False
+    monkeypatch.setenv(forensics.FORENSICS_ENV_VAR, "1")
+    assert forensics.refresh_from_env() is True
+
+
+def test_knob_accessors_parse_and_floor(monkeypatch):
+    monkeypatch.setenv(forensics.SAMPLE_ENV_VAR, "0.001")
+    assert forensics.sample_cadence_s() == 0.05  # floored
+    monkeypatch.setenv(forensics.SAMPLE_ENV_VAR, "junk")
+    assert forensics.sample_cadence_s() == 0.5  # default on parse failure
+    monkeypatch.setenv(forensics.DEADLINE_FRAC_ENV_VAR, "0.25")
+    assert forensics.deadline_fraction() == 0.25
+    monkeypatch.setenv(forensics.STALL_ENV_VAR, "2.5")
+    assert forensics.stall_window_s() == 2.5
+
+
+# ------------------------------------------- classification and sampling
+
+
+def _pkg(rel):
+    return os.path.join(os.sep + "x", "torchsnapshot_tpu", rel)
+
+
+def test_classify_frames_maps_modules_to_critpath_lanes():
+    cases = [
+        ("pg_wrapper.py", "collective_wait"),
+        ("native_io.py", "native_io"),
+        (os.path.join("io_preparers", "array.py"), "stage_copy"),
+        ("integrity.py", "hash"),
+        ("compression.py", "decode"),
+        ("partial_reader.py", "storage_read"),
+        ("fanout.py", "peer_transfer"),
+    ]
+    for rel, want in cases:
+        cat, frame = forensics.classify_frames([(_pkg(rel), "f", 10)])
+        assert cat == want, rel
+        assert frame.endswith(":f:10")
+
+
+def test_classify_frames_storage_plugin_read_write_split():
+    wr = forensics.classify_frames(
+        [(_pkg(os.path.join("storage_plugins", "fs.py")), "write", 99)]
+    )
+    rd = forensics.classify_frames(
+        [(_pkg(os.path.join("storage_plugins", "fs.py")), "read", 120)]
+    )
+    assert wr[0] == "storage_write"
+    assert rd[0] == "storage_read"
+
+
+def test_classify_frames_skips_observer_modules():
+    """faultinject and telemetry frames never take the blame: a delay
+    injected at fs.write attributes to the fs.py frame above it."""
+    frames = [
+        (_pkg("snapshot.py"), "take", 1),
+        (_pkg(os.path.join("storage_plugins", "fs.py")), "write", 99),
+        (_pkg("faultinject.py"), "_delay", 50),
+    ]
+    cat, frame = forensics.classify_frames(frames)
+    assert cat == "storage_write"
+    assert "fs.py:write:99" in frame
+
+
+def test_classify_frames_non_package_is_idle():
+    assert forensics.classify_frames([("/usr/lib/python3/ast.py", "x", 1)]) == (
+        None,
+        None,
+    )
+
+
+def test_sample_stacks_covers_every_thread():
+    ev = threading.Event()
+    t = threading.Thread(target=ev.wait, name="parked", daemon=True)
+    t.start()
+    try:
+        threads = forensics.sample_stacks()
+        names = {rec["name"] for rec in threads}
+        assert "parked" in names
+        assert any(rec["name"] == "MainThread" for rec in threads)
+        for rec in threads:
+            assert set(rec) >= {"name", "daemon", "idle", "category",
+                                "leaf", "frames"}
+            assert len(rec["frames"]) <= forensics.MAX_FRAMES
+    finally:
+        ev.set()
+        t.join()
+
+
+def test_fold_into_counts_and_evicts():
+    profile = {}
+    threads = [{"name": "T", "frames": ["a.py:f:1", "b.py:g:2"]}]
+    forensics.fold_into(profile, threads)
+    forensics.fold_into(profile, threads)
+    (key, count), = profile.items()
+    assert count == 2
+    assert key == "T;a.py:f:1;b.py:g:2"
+
+
+def test_pick_wedge_prefers_trigger_category():
+    threads = [
+        {"name": "A", "idle": True, "category": None, "leaf": None},
+        {"name": "B", "idle": False, "category": "stage_copy", "leaf": "x"},
+        {"name": "C", "idle": False, "category": "storage_write", "leaf": "y"},
+    ]
+    assert forensics.pick_wedge(threads)["name"] == "B"  # first non-idle
+    assert forensics.pick_wedge(threads, prefer="storage")["name"] == "C"
+    assert forensics.pick_wedge(
+        threads, prefer="collective_wait")["name"] == "B"  # fall through
+    assert forensics.pick_wedge([threads[0]]) is None
+
+
+# ----------------------------------------------------- trigger registries
+
+
+def test_collective_registry_and_overdue_fraction():
+    forensics.collective_begin("barrier", "ns", 1, 10.0)
+    now = forensics.monotonic()
+    assert forensics.collectives_overdue(now + 1.0, 0.5) == []
+    over = forensics.collectives_overdue(now + 6.0, 0.5)
+    assert len(over) == 1 and over[0]["kind"] == "barrier"
+    forensics.collective_end("ns", 1)
+    assert forensics.collectives_overdue(now + 60.0, 0.5) == []
+
+
+def test_collective_without_deadline_never_triggers():
+    forensics.collective_begin("barrier", "ns", 2, None)
+    assert forensics.collectives_overdue(
+        forensics.monotonic() + 9e6, 0.5) == []
+
+
+def test_storage_op_feeds_p99_ring():
+    for _ in range(forensics._MIN_P99_SAMPLES):
+        with forensics.storage_op("storage_write", path="p"):
+            pass
+    assert forensics._p99("storage_write") is not None
+    assert forensics._p99("storage_read") is None  # no samples yet
+
+
+def test_storage_overdue_uses_no_history_floor():
+    release = threading.Event()
+
+    def slow():
+        with forensics.storage_op("storage_write", path="/p"):
+            release.wait(5.0)
+
+    t = threading.Thread(target=slow, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.05)
+        now = forensics.monotonic()
+        # Below the 30 s no-history floor: quiet.
+        assert forensics.storage_overdue(now) == []
+        # Past it: overdue, naming the kind and path.
+        over = forensics.storage_overdue(
+            now + forensics.NO_HISTORY_FLOOR_S + 1.0)
+        assert len(over) == 1
+        assert over[0]["kind"] == "storage_write"
+        assert over[0]["path"] == "/p"
+    finally:
+        release.set()
+        t.join()
+    # Completed op leaves the in-flight table.
+    assert forensics.storage_overdue(forensics.monotonic() + 9e6) == []
+
+
+def test_disabled_guards_are_no_ops():
+    forensics.set_enabled(False)
+    forensics.collective_begin("barrier", "ns", 3, 1.0)
+    with forensics.storage_op("storage_write"):
+        pass
+    assert forensics.collectives_overdue(
+        forensics.monotonic() + 9e6, 0.5) == []
+    assert forensics._p99("storage_write") is None
+
+
+# ------------------------------------------------------- dumps and loads
+
+
+def test_dump_and_load_roundtrip(tmp_path):
+    p = forensics.dump_stacks(str(tmp_path), 3, "test reason",
+                              trigger="remote")
+    assert p is not None and p.endswith("rank_3.stacks.jsonl")
+    # Append, not overwrite: the WEDGE rule needs consecutive dumps.
+    forensics.dump_stacks(str(tmp_path), 3, "again", trigger="remote")
+    loaded = forensics.load_stack_dumps(str(tmp_path))
+    assert list(loaded) == [3] and len(loaded[3]) == 2
+    rec = loaded[3][0]
+    assert rec["reason"] == "test reason"
+    assert rec["trigger"] == "remote"
+    assert rec["threads"]
+
+
+def test_dump_disabled_returns_none(tmp_path):
+    forensics.set_enabled(False)
+    assert forensics.dump_stacks(str(tmp_path), 0, "r") is None
+    assert forensics.load_stack_dumps(str(tmp_path)) == {}
+
+
+def test_flight_ring_dump_also_dumps_stacks(tmp_path):
+    """The on-abort pairing: every flight-ring dump brings the stacks
+    with it (the hook lives inside flightrec.dump, so every abort path
+    inherits it)."""
+    flightrec.record("take.begin", path=str(tmp_path))
+    out = flightrec.dump(str(tmp_path), 0, "test abort")
+    assert out is not None
+    stacks = forensics.load_stack_dumps(str(tmp_path))
+    assert 0 in stacks
+    assert stacks[0][-1]["trigger"] == "abort"
+    # And the ring loader does not choke on the stacks file next door.
+    rings = flightrec.load_dumps(str(tmp_path))
+    assert 0 in rings
+
+
+def test_stacks_file_survives_fsck_clean_and_repair(tmp_path):
+    """A snapshot whose .flight/ holds both ring and stack dumps fscks
+    clean — forensic artifacts are internal, not orphans — and --repair
+    leaves them in place."""
+    from torchsnapshot_tpu.cli import run_fsck
+
+    snap = tmp_path / "snap"
+    Snapshot.take(str(snap), {"model": StateDict(
+        a=np.arange(64, dtype=np.float32))})
+    flightrec.record("take.begin", path=str(snap))
+    assert flightrec.dump(str(snap), 0, "post-commit dump") is not None
+    assert forensics.dump_stacks(str(snap), 0, "manual") is not None
+    code, report = run_fsck(str(snap))
+    assert code == 0, report
+    code, report = run_fsck(str(snap), repair=True)
+    assert code == 0, report
+    assert os.path.exists(snap / ".flight" / "rank_0.stacks.jsonl")
+    assert os.path.exists(snap / ".flight" / "rank_0.jsonl")
+
+
+# --------------------------------------------------- watchdog lifecycle
+
+
+def test_arm_returns_none_when_disabled():
+    forensics.set_enabled(False)
+
+    class PG:
+        def get_rank(self):
+            return 0
+
+        def get_world_size(self):
+            return 1
+
+    assert forensics.arm(PG(), "take", "/tmp/x") is None
+
+
+def test_take_arms_and_disarms_watchdog(tmp_path):
+    """A plain take starts exactly one watchdog thread and its finally
+    stops it (no 'tsnap-forensics' thread outlives the op)."""
+    Snapshot.take(str(tmp_path / "s"), {"model": StateDict(
+        a=np.arange(256, dtype=np.float32))})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not [t for t in threading.enumerate()
+                if t.name == "tsnap-forensics"]:
+            break
+        time.sleep(0.02)
+    assert not [t for t in threading.enumerate()
+                if t.name == "tsnap-forensics"]
+
+
+def test_watchdog_frozen_progress_self_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv(forensics.STALL_ENV_VAR, "0.2")
+    health.update(op="take", phase="write", written_bytes=5)
+    wd = forensics.Watchdog(0, "take", str(tmp_path), cadence_s=0.05)
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            loaded = forensics.load_stack_dumps(str(tmp_path))
+            if loaded.get(0):
+                break
+            time.sleep(0.05)
+        loaded = forensics.load_stack_dumps(str(tmp_path))
+        assert loaded.get(0), "watchdog never self-dumped"
+        rec = loaded[0][0]
+        assert rec["trigger"] == "frozen-progress"
+        assert "frozen" in rec["reason"]
+    finally:
+        wd.stop()
+
+
+def test_watchdog_collective_deadline_self_dump(tmp_path):
+    forensics.collective_begin("barrier", "ckpt", 9, 0.2)
+    wd = forensics.Watchdog(1, "take", str(tmp_path), cadence_s=0.05)
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if forensics.load_stack_dumps(str(tmp_path)).get(1):
+                break
+            time.sleep(0.05)
+        recs = forensics.load_stack_dumps(str(tmp_path)).get(1)
+        assert recs, "watchdog never fired on the overdue collective"
+        assert recs[0]["trigger"] == "collective-deadline"
+        assert "barrier #9" in recs[0]["reason"]
+    finally:
+        wd.stop()
+        forensics.collective_end("ckpt", 9)
+
+
+def test_remote_dump_request_roundtrip(tmp_path):
+    """watch --dump protocol over a real local store: request key in,
+    stacks on disk + summary under forensic_out/, retraction on stop."""
+    from torchsnapshot_tpu.dist_store import TCPStore
+
+    store = TCPStore("127.0.0.1", is_server=True, timeout=10.0)
+    wd = None
+    try:
+        wd = forensics.Watchdog(
+            1, "take", str(tmp_path), store=store, cadence_s=0.05)
+        wd.start()
+        store.set(f"{forensics.FORENSIC_REQ_PREFIX}1", b"1")
+        out_key = f"{forensics.FORENSIC_OUT_PREFIX}1"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if store.check(out_key):
+                break
+            time.sleep(0.05)
+        assert store.check(out_key), "watchdog never answered the request"
+        payload = json.loads(store.get(out_key).decode("utf-8"))
+        assert payload["rank"] == 1
+        assert payload["trigger"] == "remote"
+        # The request key was consumed; the stacks landed on disk.
+        assert not store.check(f"{forensics.FORENSIC_REQ_PREFIX}1")
+        assert forensics.load_stack_dumps(str(tmp_path)).get(1)
+        wd.stop()
+        wd = None
+        assert not store.check(out_key)  # retracted on the way out
+    finally:
+        if wd is not None:
+            wd.stop()
+        store.close()
+
+
+# ------------------------------------------------------ blackbox merging
+
+
+def _rec(leaf, category, thread="pipeline"):
+    return {
+        "threads": [
+            {"name": thread, "idle": False, "leaf": leaf,
+             "category": category, "daemon": True, "frames": [leaf]},
+            {"name": "MainThread", "idle": True, "leaf": None,
+             "category": None, "daemon": False, "frames": []},
+        ],
+        "wedge": {"thread": thread, "frame": leaf, "category": category},
+    }
+
+
+def test_derive_wedge_findings_needs_consecutive_identical_leaves():
+    same = [_rec("fs.py:write:99", "storage_write")] * 2
+    moving = [_rec("a.py:f:1", "stage_copy"), _rec("b.py:g:2", "hash")]
+    found = forensics.derive_wedge_findings({0: same, 1: moving})
+    assert len(found) == 1
+    f = found[0]
+    assert (f["class"], f["rank"], f["dumps"]) == ("wedge", 0, 2)
+    assert f["frame"] == "fs.py:write:99"
+    assert f["category"] == "storage_write"
+    # A single dump is a snapshot, not a wedge.
+    assert forensics.derive_wedge_findings(
+        {2: [_rec("x.py:f:1", "hash")]}) == []
+
+
+def test_latest_wedge_renders_category_and_frame():
+    stacks = {1: [_rec("fs.py:write:99", "storage_write")]}
+    assert forensics.latest_wedge(stacks, 1) == (
+        "storage_write @ fs.py:write:99")
+    assert forensics.latest_wedge(stacks, 7) is None
+
+
+def test_merge_stack_findings_annotates_desertion_and_appends_wedge():
+    merged = {
+        "findings": [{
+            "class": "desertion", "kind": "barrier", "ns": "ckpt",
+            "cseq": 4, "entered": [1], "never_arrived": [0],
+            "stuck": [1], "errored": [], "errors": {},
+        }],
+    }
+    stacks = {1: [_rec("pg_wrapper.py:_wait:310", "collective_wait")] * 2}
+    forensics.merge_stack_findings(merged, stacks)
+    assert merged["stack_ranks"] == [1]
+    assert merged["stack_dumps"] == {1: 2}
+    desertion = merged["findings"][0]
+    assert desertion["frames"][1] == (
+        "collective_wait @ pg_wrapper.py:_wait:310")
+    wedges = [f for f in merged["findings"] if f["class"] == "wedge"]
+    assert len(wedges) == 1 and wedges[0]["rank"] == 1
+    rendered = flightrec.render_timeline(merged)
+    assert "WEDGE" in rendered
+    assert "pg_wrapper.py:_wait:310" in rendered
+    assert "executing: r1 collective_wait" in rendered
+
+
+def test_blackbox_cli_reads_stacks_only_wreck(tmp_path, capsys):
+    """A hang that never aborted leaves stack dumps and no ring dumps;
+    blackbox still reads the wreck and exits 1 on the WEDGE finding."""
+    from torchsnapshot_tpu.cli import main as cli_main
+
+    flight = tmp_path / ".flight"
+    flight.mkdir()
+    rec = _rec("fs.py:write:99", "storage_write")
+    rec.update(rank=1, seq=1, t=0.0, reason="r", trigger="storage-p99")
+    with open(flight / "rank_1.stacks.jsonl", "w") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps(rec) + "\n")
+    code = cli_main(["blackbox", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert code == 1
+    assert "WEDGE" in out
+    assert "fs.py:write:99" in out
+
+
+def test_blackbox_cli_exit_2_only_when_nothing_at_all(tmp_path, capsys):
+    from torchsnapshot_tpu.cli import main as cli_main
+
+    assert cli_main(["blackbox", str(tmp_path)]) == 2
+    assert "stack dumps" in capsys.readouterr().err
+
+
+# -------------------------------------------------------- watch rendering
+
+
+def test_render_fleet_shows_wedged_frame_inline():
+    fleet = {
+        0: {"op": "take", "phase": "write", "seq": 3, "wall_s": 2.0},
+        1: {"op": "take", "phase": "write", "seq": 2, "wall_s": 2.1},
+    }
+    out = health.render_fleet(
+        fleet, {0: 0.1, 1: 9.0}, stall_s=5.0,
+        wedged={1: "storage_write @ fs.py:write:99"},
+    )
+    stalled_row = [ln for ln in out.splitlines() if "STALLED" in ln][0]
+    assert "wedged storage_write @ fs.py:write:99" in stalled_row
+    clean_row = [ln for ln in out.splitlines()
+                 if ln.lstrip().startswith("0")][0]
+    assert "wedged" not in clean_row
+
+
+def test_native_degrade_event_registered():
+    from torchsnapshot_tpu.telemetry import taxonomy
+
+    assert "native.degrade" in taxonomy.EVENTS
+    assert "forensic.dump" in taxonomy.EVENTS

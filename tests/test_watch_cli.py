@@ -141,6 +141,10 @@ def _throttled_take_worker(rank: int, world_size: int, root: str):
 
     os.environ["TORCHSNAPSHOT_TPU_HEARTBEAT_S"] = "0.1"
     os.environ["TORCHSNAPSHOT_TPU_PROGRESS_S"] = "0.15"
+    # Stall forensics tuned to drill speed: sample fast, call a 0.4 s
+    # frozen fingerprint a stall (the injected delay holds it for 1 s).
+    os.environ["TORCHSNAPSHOT_TPU_FORENSICS_SAMPLE_S"] = "0.1"
+    os.environ["TORCHSNAPSHOT_TPU_FORENSICS_STALL_S"] = "0.4"
     store = get_default_pg().store
     if rank == 0:
         # Publish the coordination-store address for the out-of-band
@@ -210,6 +214,7 @@ def test_watch_observes_live_take_flags_straggler_and_survives_failover(
             [
                 sys.executable, "-m", "torchsnapshot_tpu", "watch", addr,
                 "--interval", "0.15", "--stall", "0.5", "--ticks", "80",
+                "--dump", "1",
             ],
             capture_output=True,
             text=True,
@@ -269,3 +274,46 @@ def test_watch_observes_live_take_flags_straggler_and_survives_failover(
     assert adopted or recovered or not unreachable_idx or (
         min(unreachable_idx) > max(success_idx)
     ), watch.stderr[-2000:]
+
+    # --- ISSUE 13: stall forensics rode the same drill ---------------
+    from torchsnapshot_tpu.telemetry import forensics
+
+    # The stalled rank self-dumped its stacks (frozen-progress trigger:
+    # the 1 s injected delay holds the fingerprint past the 0.4 s
+    # window), and at least one dump catches a thread executing under
+    # the injected site's category — the delay is wired at fs.write, so
+    # the honest attribution is storage_write (faultinject's own frames
+    # are observer-excluded).
+    stacks = forensics.load_stack_dumps(str(tmp_path / "cur"))
+    assert stacks.get(1), "stalled rank 1 never self-dumped its stacks"
+    assert any(
+        rec.get("trigger") in ("frozen-progress", "remote")
+        for rec in stacks[1]
+    ), [r.get("trigger") for r in stacks[1]]
+    assert any(
+        t.get("category") == "storage_write" and "fs.py" in (t.get("leaf") or "")
+        for rec in stacks[1]
+        for t in rec.get("threads", [])
+    ), [t.get("leaf") for rec in stacks[1] for t in rec.get("threads", [])]
+    # The remote request (--dump 1) round-tripped: the watchdog answered
+    # on the store (surviving the leader kill like every client) and the
+    # watcher rendered the wedged frame inline on rank 1's row.
+    wedged_rows = [
+        line for fr in frames for line in fr.splitlines()
+        if line.lstrip().startswith("1 ") and "wedged" in line
+    ]
+    assert wedged_rows, out[-4000:]
+    # Blackbox reads the stacks-only wreck (the take COMMITTED — no ring
+    # dumps) and names the wedge: consecutive same-frame dumps earn a
+    # WEDGE finding, exit 1.
+    blackbox = subprocess.run(
+        [sys.executable, "-m", "torchsnapshot_tpu", "blackbox",
+         str(tmp_path / "cur")],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert blackbox.returncode == 1, (blackbox.stdout, blackbox.stderr)
+    assert "WEDGE" in blackbox.stdout, blackbox.stdout
+    assert "storage_write" in blackbox.stdout, blackbox.stdout

@@ -58,6 +58,10 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_FLIGHTREC_DIR",
     "TORCHSNAPSHOT_TPU_FLIGHTREC_RING",
     "TORCHSNAPSHOT_TPU_FLIGHTREC_SIGTERM",
+    "TORCHSNAPSHOT_TPU_FORENSICS",
+    "TORCHSNAPSHOT_TPU_FORENSICS_DEADLINE_FRAC",
+    "TORCHSNAPSHOT_TPU_FORENSICS_SAMPLE_S",
+    "TORCHSNAPSHOT_TPU_FORENSICS_STALL_S",
     "TORCHSNAPSHOT_TPU_FSYNC",
     "TORCHSNAPSHOT_TPU_HEARTBEAT_S",
     "TORCHSNAPSHOT_TPU_IO_CONCURRENCY",

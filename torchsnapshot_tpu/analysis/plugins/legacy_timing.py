@@ -105,7 +105,13 @@ def _violations_in(path: str) -> list:
 # (core.py/export.py own the clock; history.py records calendar time.)
 # critpath.py consumes recorded span timestamps and promexp.py serves
 # scrapes — neither may ever grow a private clock.
-TELEMETRY_COVERED = {"flightrec.py", "health.py", "critpath.py", "promexp.py"}
+TELEMETRY_COVERED = {
+    "flightrec.py",
+    "health.py",
+    "critpath.py",
+    "promexp.py",
+    "forensics.py",
+}
 
 
 def collect_failures() -> List[Tuple[str, int, str]]:

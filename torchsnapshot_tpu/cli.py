@@ -1347,23 +1347,32 @@ def cmd_blackbox(args: argparse.Namespace) -> int:
     into one causal cross-rank timeline: who deserted whom at which
     barrier, which rank adopted which store epoch, which commit was
     refused at which generation (telemetry/flightrec.py;
-    docs/source/telemetry.rst, "Flight recorder"). Exit codes: 0 dumps
-    found with no findings, 1 findings, 2 no dumps."""
+    docs/source/telemetry.rst, "Flight recorder"). Stack dumps from the
+    hang watchdog (telemetry/forensics.py) merge into the same report:
+    DESERTION findings name where each waiter actually sat, and a rank
+    whose consecutive dumps share one non-idle leaf frame earns a WEDGE
+    finding. Exit codes: 0 dumps found with no findings, 1 findings,
+    2 neither flight dumps nor stack dumps."""
     import json
 
-    from .telemetry import flightrec
+    from .telemetry import flightrec, forensics
 
     dumps = flightrec.load_dumps(args.path)
-    if not dumps:
+    stacks = forensics.load_stack_dumps(args.path)
+    # A hang that resolved on its own leaves stack dumps but no ring
+    # dumps (the op never aborted) — that wreck is still readable.
+    if not dumps and not stacks:
         print(
             f"error: no flight dumps under {args.path}/{flightrec.FLIGHT_DIR}/ "
-            "— dumps are written per rank when an operation aborts (the "
-            "flight recorder is on by default; "
-            "TORCHSNAPSHOT_TPU_FLIGHTREC=0 disables it)",
+            "— ring dumps are written per rank when an operation aborts, "
+            "stack dumps when the hang watchdog fires (both on by default; "
+            "TORCHSNAPSHOT_TPU_FLIGHTREC=0 / TORCHSNAPSHOT_TPU_FORENSICS=0 "
+            "disable them)",
             file=sys.stderr,
         )
         return 2
     merged = flightrec.merge_timeline(dumps)
+    forensics.merge_stack_findings(merged, stacks)
     if args.json:
         print(json.dumps(merged, indent=1, default=repr))
     else:
@@ -1378,11 +1387,14 @@ def cmd_watch(args: argparse.Namespace) -> int:
     timeout turns a stall into an abort. Survives a store-leader
     failover the same way every client does (transparent adoption);
     with the whole tier down it degrades to a retry line, never a
-    crash."""
+    crash. ``--dump RANK`` posts a forensic request key the target
+    rank's hang watchdog polls (telemetry/forensics.py); the returned
+    wedge frame renders inline on that rank's row."""
+    import json as _json
     import time as _time  # frame pacing, not measurement
 
     from .dist_store import TCPStore
-    from .telemetry import health
+    from .telemetry import forensics, health
 
     host, _, port_str = args.addr.rpartition(":")
     if not host or not port_str.isdigit():
@@ -1392,6 +1404,8 @@ def cmd_watch(args: argparse.Namespace) -> int:
     tracker = health.FleetTracker(stall_s=args.stall)
     store = None
     ticks = 0
+    dump_sent = False
+    wedged: dict = {}
     while True:
         try:
             if store is None:
@@ -1402,9 +1416,35 @@ def cmd_watch(args: argparse.Namespace) -> int:
                     timeout=max(args.interval * 2, 5.0),
                     connect_retries=0,
                 )
+            # The request key survives a leader failover with the rest
+            # of the keyspace; re-sent only until one set() succeeds.
+            if getattr(args, "dump", None) is not None and not dump_sent:
+                store.set(
+                    f"{forensics.FORENSIC_REQ_PREFIX}{args.dump}", b"1"
+                )
+                dump_sent = True
             fleet = health.read_fleet(store)
             ages = tracker.observe(fleet)
-            frame = health.render_fleet(fleet, ages, args.stall)
+            # Poll ONLY the requested rank's answer, and stop once it
+            # lands: every extra round trip is load on the same store
+            # the hung job depends on.
+            if (
+                getattr(args, "dump", None) is not None
+                and args.dump not in wedged
+            ):
+                out_key = f"{forensics.FORENSIC_OUT_PREFIX}{args.dump}"
+                try:
+                    if store.check(out_key):
+                        payload = _json.loads(
+                            store.get(out_key).decode("utf-8")
+                        )
+                        if payload.get("wedge"):
+                            wedged[args.dump] = str(payload["wedge"])
+                except Exception:  # noqa: BLE001 - annotation, not data
+                    pass
+            frame = health.render_fleet(
+                fleet, ages, args.stall, wedged=wedged or None
+            )
         except Exception as e:  # noqa: BLE001 - degrade, keep watching
             # Keep the store object when we have one: its cached replica
             # set is what makes the NEXT poll fail over transparently. A
@@ -1612,6 +1652,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "without heartbeat progress (default 5.0)")
     p.add_argument("--ticks", type=int, default=0,
                    help="render N frames then exit (0 = forever)")
+    p.add_argument("--dump", type=int, default=None, metavar="RANK",
+                   help="request a live thread-stack dump from RANK's "
+                        "hang watchdog; the wedged frame renders inline "
+                        "on that rank's row")
     p.set_defaults(fn=cmd_watch)
 
     p = sub.add_parser(

@@ -185,6 +185,13 @@ class _StagingPool:
         out = self._get_native(nbytes)
         if out is None:  # allocation failure: degrade for good
             self._native = False
+            # Mid-run degradation is a fleet-visible state change, not
+            # debug noise: record it so blackbox shows the pool fell
+            # back to Python slabs partway through an operation.
+            telemetry.flightrec.record(
+                "native.degrade", site="staging_pool",
+                cause="native slab allocation failed", fallback="python",
+            )
             self._drain_native_free()
             return self._get_py(nbytes)
         return out

@@ -37,6 +37,8 @@ import os
 import threading
 from typing import Dict, Optional, Tuple
 
+from .telemetry import flightrec
+
 logger = logging.getLogger(__name__)
 
 NATIVE_IO_ENV_VAR = "TORCHSNAPSHOT_TPU_NATIVE_IO"
@@ -285,12 +287,23 @@ def engine_kind() -> Optional[str]:
                         "degrades to pwritev/O_DIRECT" if odirect_enabled()
                         else "disabled (Python path)",
                     )
+                    # Flight-recorded (not just logged once): a blackbox
+                    # post-mortem must show the run lost its native tier.
+                    flightrec.record(
+                        "native.degrade", site="probe",
+                        cause=os.strerror(-rc) if rc < 0 else str(rc),
+                        fallback="posix" if odirect_enabled() else "python",
+                    )
                     # The posix tier only beats the existing thread-pool
                     # path when O_DIRECT is in play; otherwise it is the
                     # same syscalls with extra indirection.
                     kind = "posix" if odirect_enabled() else None
         except Exception as e:  # noqa: BLE001 - probe must never raise
             logger.info("native I/O probe failed (%s); using Python path", e)
+            flightrec.record(
+                "native.degrade", site="probe", cause=repr(e),
+                fallback="python",
+            )
             kind = None
         _probe_kind = kind
         _probe_done = True

@@ -48,7 +48,7 @@ from typing import Any, Dict, List, Optional
 
 from . import telemetry
 from .dist_store import DEATH_KEY, TCPStore, create_store
-from .telemetry import flightrec
+from .telemetry import flightrec, forensics
 
 STORE_ADDR_ENV_VAR = "TORCHSNAPSHOT_TPU_STORE_ADDR"
 _HANDSHAKE_SEQ_KEY = "pgw/seq"
@@ -331,6 +331,14 @@ class PGWrapper:
         flightrec.record(
             "collective.enter", kind=kind, ns=ns, cseq=seq, deadline_s=timeout
         )
+        # Stall-forensics deadline hook: the watchdog self-dumps stacks
+        # once a collective waits past a fraction of its EFFECTIVE
+        # deadline — the collective's own bound, else the store's
+        # barrier timeout (the bound the wait actually dies at).
+        effective_deadline = timeout
+        if effective_deadline is None:
+            effective_deadline = getattr(self.pg.store, "timeout", None)
+        forensics.collective_begin(kind, ns, seq, effective_deadline)
         # With the bus on, the collective ALSO records a ``collective_wait``
         # span (cat="collective", carrying the same (ns, cseq) causal key)
         # — the segment boundary the critical-path attribution engine
@@ -344,12 +352,14 @@ class PGWrapper:
         try:
             yield
         except BaseException as e:  # noqa: B036
+            forensics.collective_end(ns, seq)
             span.__exit__(None, None, None)
             flightrec.record(
                 "collective.exit", kind=kind, ns=ns, cseq=seq, ok=False,
                 error=repr(e),
             )
             raise
+        forensics.collective_end(ns, seq)
         span.__exit__(None, None, None)
         if t0 is not None:
             telemetry.histogram_observe(

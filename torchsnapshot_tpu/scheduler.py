@@ -81,6 +81,7 @@ from typing import Any, Dict, List, Optional, Set
 import psutil
 
 from . import faultinject, telemetry
+from .telemetry import forensics
 from .io_types import (
     ReadIO,
     ReadReq,
@@ -577,7 +578,13 @@ class _WritePipeline:
         if telemetry.enabled():
             chunks = self._timed_write_chunks(chunks, type(storage).__name__)
         try:
-            with telemetry.span(
+            # The forensics guard is per ENTRY, not per sub-chunk: one
+            # registry insert/remove per storage op feeds the watchdog's
+            # own p99 baseline (the telemetry histograms are off by
+            # default, so the stall trigger cannot lean on them).
+            with forensics.storage_op(
+                "storage_write", path=self.write_req.path
+            ), telemetry.span(
                 "stream_write",
                 path=self.write_req.path,
                 bytes=self.staging_cost_bytes,
@@ -602,7 +609,9 @@ class _WritePipeline:
     async def write_buffer(self, storage: StoragePlugin) -> "_WritePipeline":
         assert self.buf is not None
         t0 = telemetry.monotonic() if telemetry.enabled() else None
-        with telemetry.span(
+        with forensics.storage_op(
+            "storage_write", path=self.write_req.path
+        ), telemetry.span(
             "storage_write", path=self.write_req.path, bytes=self.buf_size_bytes
         ):
             await storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
@@ -1453,7 +1462,9 @@ class _ReadPipeline:
                         pass
 
         try:
-            with telemetry.span(
+            with forensics.storage_op(
+                "storage_read", path=self.read_req.path
+            ), telemetry.span(
                 "stream_read",
                 path=self.read_req.path,
                 sub_chunk_bytes=self.sub_chunk_bytes,
@@ -1562,7 +1573,9 @@ class _ReadPipeline:
             read_io.buf = bytearray()
         else:
             t0 = telemetry.monotonic() if telemetry.enabled() else None
-            with telemetry.span("storage_read", path=self.read_req.path) as sp:
+            with forensics.storage_op(
+                "storage_read", path=self.read_req.path
+            ), telemetry.span("storage_read", path=self.read_req.path) as sp:
                 await storage.read(read_io)
                 sp.set(bytes=memoryview(read_io.buf).nbytes)
             if t0 is not None:

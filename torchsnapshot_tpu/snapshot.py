@@ -265,6 +265,10 @@ class Snapshot:
             "op.begin", op="take", rank=pg_wrapper.get_rank(), path=path
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
+        # The stall-forensics watchdog, armed alongside the heartbeat:
+        # self-dumps stacks on overdue collectives / slow storage ops /
+        # frozen progress, and answers `watch --dump` requests.
+        watchdog = telemetry.forensics.arm(pg_wrapper, "take", path)
         # Live /metrics endpoint (TORCHSNAPSHOT_TPU_METRICS_PORT): armed
         # once per process at the first op; a no-op with the env unset.
         telemetry.promexp.maybe_start(rank=pg_wrapper.get_rank())
@@ -350,6 +354,8 @@ class Snapshot:
         finally:
             if heartbeat is not None:
                 heartbeat.stop()
+            if watchdog is not None:
+                watchdog.stop()
             # A success flag, NOT sys.exc_info(): in a finally block
             # exc_info also reports an AMBIENT exception the caller is
             # currently handling (take() inside an except block), which
@@ -414,6 +420,7 @@ class Snapshot:
             "op.begin", op="take", rank=pg_wrapper.get_rank(), path=path
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
+        watchdog = telemetry.forensics.arm(pg_wrapper, "take", path)
         telemetry.promexp.maybe_start(rank=pg_wrapper.get_rank())
         try:
             pending_io_work, metadata = cls._take_impl(
@@ -443,6 +450,8 @@ class Snapshot:
             recorder.abandon()
             if heartbeat is not None:
                 heartbeat.stop()
+            if watchdog is not None:
+                watchdog.stop()
             raise
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -456,6 +465,7 @@ class Snapshot:
             timer=timer,
             recorder=recorder,
             heartbeat=heartbeat,
+            watchdog=watchdog,
         )
 
     @classmethod
@@ -844,6 +854,7 @@ class Snapshot:
             "op.begin", op="restore", rank=rank, path=self.path
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "restore", self.path)
+        watchdog = telemetry.forensics.arm(pg_wrapper, "restore", self.path)
         telemetry.promexp.maybe_start(rank=rank)
         coop_session = None
         try:
@@ -1103,6 +1114,8 @@ class Snapshot:
         finally:
             if heartbeat is not None:
                 heartbeat.stop()
+            if watchdog is not None:
+                watchdog.stop()
             if coop_session is not None:
                 try:
                     # Clean shutdown (bye frames) so this rank's exit is
@@ -2343,12 +2356,14 @@ class PendingSnapshot:
         timer: Optional[_PhaseTimer] = None,
         recorder: Optional["telemetry.OpRecorder"] = None,
         heartbeat: Optional[Any] = None,
+        watchdog: Optional[Any] = None,
     ) -> None:
         self.path = path
         self.pg = pg_wrapper.pg
         self._timer = timer
         self._recorder = recorder
         self._heartbeat = heartbeat
+        self._watchdog = watchdog
         self._storage_options = storage_options
         self._done_event = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -2455,6 +2470,11 @@ class PendingSnapshot:
             if self._heartbeat is not None:
                 try:
                     self._heartbeat.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._watchdog is not None:
+                try:
+                    self._watchdog.stop()
                 except Exception:  # noqa: BLE001
                     pass
             try:

@@ -68,6 +68,12 @@ from .aggregate import merge_histograms, merge_summaries  # noqa: F401
 # ``events``) so it can never shadow the ``events()`` scrape function
 # exported from core above.
 from . import flightrec, health, history, taxonomy  # noqa: F401, E402
+# The stall-forensics plane (ISSUE 13): an always-on hang watchdog that
+# samples thread stacks, self-triggers on overdue collectives / slow
+# storage ops / frozen progress, answers remote dump requests from
+# `watch --dump`, and feeds the WEDGE finding class into `blackbox`.
+# Imported after flightrec/health — it consumes both.
+from . import forensics  # noqa: F401, E402
 # The performance-attribution plane (ISSUE 8): critpath reconstructs the
 # cross-rank critical path of a take/restore and names the binding
 # resource (the `explain` CLI's engine); promexp serves the live

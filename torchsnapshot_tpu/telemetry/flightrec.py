@@ -207,6 +207,16 @@ def dump(path: Optional[str], rank: int, reason: str) -> Optional[str]:
             out,
             reason,
         )
+        # Every path that dumps the flight ring also dumps stacks: the
+        # hook lives HERE (not at each abort site) so any future dump
+        # path inherits the pairing. Lazy import breaks the cycle —
+        # forensics imports this module for the spool-dir resolution.
+        try:
+            from . import forensics
+
+            forensics.dump_stacks(path, rank, reason, trigger="abort")
+        except Exception:  # noqa: BLE001 - same rule as the ring dump
+            logger.debug("abort stack dump failed (continuing)", exc_info=True)
         return out
     except Exception:  # noqa: BLE001 - a dump must never mask the abort
         logger.exception("flight-recorder dump failed (continuing)")
@@ -422,6 +432,13 @@ def render_timeline(merged: Dict[str, Any], verbose: bool = False) -> str:
         f"{len(events)} event(s)"
         + ("" if merged.get("aligned") else " [clocks not aligned: no shared anchor]")
     )
+    stack_ranks = merged.get("stack_ranks") or []
+    if stack_ranks:
+        n_dumps = sum((merged.get("stack_dumps") or {}).values())
+        lines.append(
+            f"stack dumps: {len(stack_ranks)} rank(s) "
+            f"({', '.join(map(str, stack_ranks))}), {n_dumps} dump(s)"
+        )
     findings = merged.get("findings") or []
     if findings:
         lines.append("")
@@ -442,10 +459,20 @@ def render_timeline(merged: Dict[str, Any], verbose: bool = False) -> str:
                 )
             for r in f.get("errored", []):
                 what.append(f"rank {r} raised ({f['errors'].get(r)})")
-            lines.append(
+            line = (
                 f"  DESERTION      collective {f['kind']} #{f['cseq']} "
                 f"[{f['ns']}]: " + "; ".join(what)
             )
+            # Stack-dump annotation (telemetry/forensics.py): WHERE each
+            # waiter actually sat when it last dumped — the difference
+            # between "rank 1 still waiting" and "rank 1 still waiting,
+            # wedged under storage_write @ fs.py:write".
+            frames = f.get("frames") or {}
+            if frames:
+                line += "; executing: " + ", ".join(
+                    f"r{r} {frames[r]}" for r in sorted(frames)
+                )
+            lines.append(line)
         elif cls == "store-failover":
             lines.append(
                 f"  STORE-FAILOVER rank {f['rank']} adopted leader "
@@ -467,6 +494,13 @@ def render_timeline(merged: Dict[str, Any], verbose: bool = False) -> str:
             lines.append(
                 f"  FAULT-TRIP     rank {f['rank']} site {f.get('site')} "
                 f"hit #{f.get('hit')} -> {f.get('action')}"
+            )
+        elif cls == "wedge":
+            lines.append(
+                f"  WEDGE          rank {f['rank']} wedged in "
+                f"{f.get('category')} at {f.get('frame')} "
+                f"({f.get('dumps')} consecutive dump(s), "
+                f"thread {f.get('thread')})"
             )
     lines.append("")
     lines.append("timeline (relative seconds):")

@@ -282,6 +282,7 @@ def render_fleet(
     fleet: Dict[int, Dict[str, Any]],
     ages: Dict[int, float],
     stall_s: float,
+    wedged: Optional[Dict[int, str]] = None,
 ) -> str:
     """One watch frame: a per-rank table plus skew/straggler summary."""
     from .export import fmt_bytes
@@ -300,6 +301,11 @@ def render_fleet(
         age = ages.get(rank, 0.0)
         stalled = age >= stall_s
         status = f"STALLED {age:.0f}s" if stalled else "ok"
+        # The forensic wedge frame (watch --dump, telemetry/forensics.py)
+        # rides inline on the row: a STALLED rank that also says
+        # "wedged storage_write @ fs.py:write:99" needs no second tool.
+        if wedged and rank in wedged:
+            status += f"  wedged {wedged[rank]}"
         eta = rec.get("eta_s")
         walls.append((rec.get("wall_s") or 0.0, rank))
         # The binding-resource hint (scheduler reporter -> critpath

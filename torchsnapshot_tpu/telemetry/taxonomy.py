@@ -55,10 +55,17 @@ EVENTS: Dict[str, str] = {
     "measured rates at decision time) — recorded wherever the governor "
     "picks streaming on/off, sub-chunk size, I/O concurrency, the "
     "preverify gate, or cooperative restore",
+    # native I/O engine (native_io.py / io_preparers/array.py)
+    "native.degrade": "the native I/O tier degraded (site, cause) — the "
+    "capability probe failed at startup or the staging pool fell back to "
+    "Python slabs mid-run",
     # cross-cutting
     "fault.trip": "a fault-injection rule fired (site, hit, action)",
     "preempt.signal": "a termination signal was observed (signum)",
     "flight.dump": "ring dump header (rank, reason, events, dropped)",
+    # stall forensics (forensics.py)
+    "forensic.dump": "the hang watchdog dumped thread stacks (rank, "
+    "trigger, reason) — self-triggered or remote-requested",
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
