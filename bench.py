@@ -492,6 +492,70 @@ def _reshard_leg(timeout_s: float = 420.0):
     return compact
 
 
+def _journal_leg(timeout_s: float = 420.0):
+    """Delta-journal RPO leg (ISSUE 14), persisted to BENCH_r12.json and
+    embedded in the main record: benchmarks/journal_rpo.py measures the
+    cost of one journal epoch (a small hot set over a mostly-frozen
+    state, many small arrays) vs a full save on 50 MB/s-throttled
+    storage, expresses both as recoverable-state intervals at a 1%
+    sustained-overhead budget, and asserts the >= 10x RPO reduction
+    itself. Runs in its own process group with a hard timeout; failures
+    degrade to an absent key, never a dead bench."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _log(f"running delta-journal RPO leg ({timeout_s:.0f}s budget) ...")
+    r = _run_in_own_group(
+        [sys.executable, os.path.join(here, "benchmarks", "journal_rpo.py")],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"journal RPO leg rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    records = _json_records(r.stdout)
+    summary = records.get("journal_rpo/summary")
+    if summary is None:
+        _log("journal RPO leg produced no summary; omitting")
+        return None
+    legs = [
+        rec
+        for name, rec in records.items()
+        if name.startswith("journal_rpo/") and name != "journal_rpo/summary"
+    ]
+    out = os.path.join(here, "BENCH_r12.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "metric": "journal_rpo",
+                "unit": "seconds of recoverable-state interval at 1% "
+                "sustained checkpoint overhead / MiB/s append",
+                "summary": summary,
+                "legs": legs,
+                "platform": "cpu",
+                "env": {
+                    "JAX_PLATFORMS": "cpu",
+                    "TORCHSNAPSHOT_TPU_JOURNAL": "1",
+                    "TORCHSNAPSHOT_TPU_NATIVE_IO": "never",
+                },
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    _log(
+        f"journal leg ok: RPO {summary.get('rpo_full_save_s')}s -> "
+        f"{summary.get('rpo_journal_s')}s "
+        f"({summary.get('rpo_reduction_x')}x) at equal overhead, "
+        f"append {summary.get('append_throughput_mib_s')} MiB/s; "
+        f"written to {out}"
+    )
+    compact = dict(summary)
+    compact.pop("benchmark", None)
+    return compact
+
+
 def _native_io_leg(tmp: str, app_state, state, nbytes: int):
     """Side-by-side native-engine vs Python-path legs (ISSUE 9),
     persisted to BENCH_r10.json and embedded in the main record.
@@ -945,6 +1009,12 @@ def main() -> None:
     reshard_leg = _reshard_leg()
     if reshard_leg is not None:
         record["reshard"] = reshard_leg
+    # Delta-journal RPO side-leg (BENCH_r12.json): epoch append vs full
+    # save on throttled storage — recoverable-state interval at equal
+    # sustained overhead.
+    journal_leg = _journal_leg()
+    if journal_leg is not None:
+        record["journal"] = journal_leg
     print(json.dumps(record), flush=True)
 
 

@@ -804,6 +804,157 @@ def test_chaos_store_host_kill_no_replicas_fails_bounded(tmp_path, monkeypatch):
     assert elapsed < 120, elapsed
 
 
+# ------------------------------------------------- delta-journal schedules
+#
+# The ISSUE 14 RPO drills: the journal's crash consistency under the same
+# binary invariant — a faulted journal either replays bit-exact to the
+# last COMMITTED epoch or is rejected whole (base-snapshot fallback),
+# never a partial splice; torn tails are truncated, never trusted.
+
+
+def _w2_journal_kill_worker(rank: int, world_size: int, root: str):
+    os.environ["TORCHSNAPSHOT_TPU_JOURNAL"] = "1"
+    from torchsnapshot_tpu import CheckpointManager
+    from torchsnapshot_tpu import faultinject as fi
+
+    mgr = CheckpointManager(root, save_interval_steps=100)
+    st = _w2_state(rank, 0)
+    mgr.save(0, st)
+    # Epoch 1 commits cleanly on both ranks.
+    st["model"]["w"] = np.asarray(st["model"]["w"]) + 1.0
+    st["model"]["step"] = np.array([1], dtype=np.int64)
+    assert mgr.journal_step(1, st)
+    # Epoch 2: SIGKILL fires mid-append (frame prefix already on disk —
+    # a genuinely torn record) on BOTH ranks.
+    st["model"]["w"] = np.asarray(st["model"]["w"]) + 1.0
+    st["model"]["step"] = np.array([2], dtype=np.int64)
+    fi.configure("journal.append@1=kill")
+    mgr.journal_step(2, st)
+    return "survived"  # unreachable
+
+
+def _w2_journal_restore_worker(rank: int, world_size: int, root: str):
+    from torchsnapshot_tpu import CheckpointManager
+
+    expected = _w2_state(rank, 0)
+    expected["model"]["w"] = np.asarray(expected["model"]["w"]) + 1.0
+    expected["model"]["step"] = np.array([1], dtype=np.int64)
+    dst = _zeros_like(expected)
+    step = CheckpointManager(root, save_interval_steps=100).restore(dst)
+    return {"step": step, "bit_exact": _equal(dst, expected)}
+
+
+def test_chaos_w2_journal_sigkill_mid_append(tmp_path):
+    """The headline RPO drill: both ranks of a w2 world are SIGKILLed
+    mid-append of journal epoch 2. A second world restores base + replay
+    bit-exact to the last COMMITTED epoch (1), the torn epoch-2 tails are
+    truncated, and the snapshot fscks clean after the stale epoch fence
+    is repaired."""
+    from torchsnapshot_tpu import journal
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    run_with_subprocesses(
+        _w2_journal_kill_worker, 2, str(tmp_path),
+        timeout=180.0, expect_dead=(0, 1),
+    )
+    snap = str(tmp_path / "step_0000000000")
+    jdir = os.path.join(snap, journal.JOURNAL_DIRNAME)
+    metas = journal.read_epoch_metas(jdir)
+    committed = journal.committed_epochs(metas)
+    assert [m["epoch"] for m in committed] == [1]
+    # The killed epoch left its fence and torn tails behind.
+    assert os.path.exists(os.path.join(jdir, journal.FENCE_FNAME))
+    offsets = committed[-1]["offsets"]
+    torn_before = {
+        r: os.path.getsize(os.path.join(jdir, journal.segment_name(int(r))))
+        for r in offsets
+    }
+    assert any(torn_before[r] > offsets[r] for r in offsets), torn_before
+
+    # A fresh world restores bit-exact to epoch 1 on every rank...
+    results = run_with_subprocesses(
+        _w2_journal_restore_worker, 2, str(tmp_path), timeout=180.0
+    )
+    for rank, out in results.items():
+        assert out["step"] == 0, (rank, out)
+        assert out["bit_exact"], (rank, out)
+    # ...and replay truncated every torn tail back to the committed
+    # offset (the tail is never trusted, never spliced).
+    for r in offsets:
+        seg = os.path.join(jdir, journal.segment_name(int(r)))
+        assert os.path.getsize(seg) == offsets[r], r
+    # fsck: only the stale epoch fence remains, and --repair clears it.
+    code, report = run_fsck(snap)
+    assert code == 1 and report.classes() == {"stale-fence"}, report.findings
+    assert run_fsck(snap, repair=True)[0] == 0
+    assert run_fsck(snap)[0] == 0
+
+
+def test_chaos_journal_corrupt_record_falls_back(tmp_path, monkeypatch):
+    """A journal record corrupted at append time (CRCs were computed over
+    the true bytes, so the damage is on disk inside a COMMITTED epoch):
+    replay must CRC-reject the whole journal and restore the base
+    snapshot exactly — bounded fallback, no partial splice — and fsck
+    must name the unrepairable journal-corrupt-record."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL", "1")
+    from torchsnapshot_tpu import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    state0 = _state(0)
+    mgr.save(0, state0)
+    st = _state(0)
+    st["model"]["w"] = np.asarray(st["model"]["w"]) + 1.0
+    faultinject.configure("journal.append@1=corrupt;seed=31")
+    try:
+        assert mgr.journal_step(1, st)  # commits — the damage is latent
+    finally:
+        faultinject.disable()
+
+    dst = _zeros_like(state0)
+    assert CheckpointManager(str(tmp_path)).restore(dst) == 0
+    assert _equal(dst, state0), "fallback must be the base, bit-exact"
+    snap = str(tmp_path / "step_0000000000")
+    code, report = run_fsck(snap, repair=True)
+    assert code == 1
+    assert "journal-corrupt-record" in report.classes()
+    assert not report.repaired
+
+
+def test_chaos_journal_preemption_sigterm_flushes_epoch(tmp_path, monkeypatch):
+    """A real SIGTERM mid-epoch (between journal steps): the manager's
+    emergency path flushes one final journal epoch instead of a
+    synchronous full save, and restore is bit-exact to the preempted
+    state."""
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL", "1")
+    from torchsnapshot_tpu import CheckpointManager
+    from torchsnapshot_tpu.preemption import PreemptionWatcher
+
+    watcher = PreemptionWatcher()
+    try:
+        mgr = CheckpointManager(
+            str(tmp_path), save_interval_steps=100, preemption=watcher
+        )
+        state0 = _state(0)
+        mgr.save(0, state0)
+        st = _state(0)
+        st["model"]["w"] = np.asarray(st["model"]["w"]) + 1.0
+        assert mgr.journal_step(1, st)
+        st["model"]["w"] = np.asarray(st["model"]["w"]) + 1.0
+        st["model"]["step"] = np.array([2], dtype=np.int64)
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Off-cadence save: the flush replaces the full emergency save.
+        assert mgr.save(2, st) is False
+        assert watcher.consumed
+        assert mgr.all_steps() == [0]  # no emergency snapshot directory
+    finally:
+        watcher.close()
+
+    dst = _zeros_like(st)
+    assert CheckpointManager(str(tmp_path)).restore(dst) == 0
+    assert _equal(dst, st), "the flushed epoch must restore bit-exact"
+    assert run_fsck(str(tmp_path / "step_0000000000"))[0] == 0
+
+
 def test_matrix_is_large_enough():
     """The acceptance floor: >= 30 deterministic schedules across
     backends and world sizes (kills and w2 drills included)."""
@@ -819,5 +970,7 @@ def test_matrix_is_large_enough():
         + len(W2_TAKE_PLANS)
         + 2  # w2 restore drill + rpc-death drill
         + 2  # store-host SIGKILL: failover commit + no-replica bounded
+        + 3  # delta-journal: w2 SIGKILL mid-append, corrupt record,
+        #      preemption-SIGTERM epoch flush (ISSUE 14)
     )
     assert n >= 30, n

@@ -1,0 +1,245 @@
+"""``dedup.consolidate`` over snapshots carrying delta-journal epochs
+(journal.py): compaction folds the final committed value of every
+journaled leaf into the destination payloads, the destination carries no
+journal, its integrity fields agree with the new bytes (fsck-clean), and
+incremental origin chains keep resolving — including through a base's
+mirror tier. Unfoldable journals raise instead of silently dropping
+committed state.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import CheckpointManager, Snapshot, StateDict
+from torchsnapshot_tpu.cli import run_fsck
+from torchsnapshot_tpu.dedup import consolidate
+
+
+@pytest.fixture
+def journaling(monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL", "1")
+
+
+def _journaled_base(root, epochs=2, **mgr_kwargs):
+    """A committed base snapshot plus ``epochs`` journal epochs touching an
+    array, a scalar, and a string. Returns (snapshot_path, live_state)."""
+    mgr = CheckpointManager(str(root), save_interval_steps=100, **mgr_kwargs)
+    st = StateDict(
+        w=np.arange(1024, dtype=np.float32),
+        b=np.full((32,), 0.0, np.float64),
+        step=0,
+        name="run-0",
+    )
+    mgr.save(0, {"app": st})
+    for epoch in range(1, epochs + 1):
+        st["w"] = np.arange(1024, dtype=np.float32) + epoch
+        st["step"] = epoch
+        st["name"] = f"run-{epoch}"
+        assert mgr.journal_step(epoch, {"app": st})
+    return mgr.path_for(0), st
+
+
+def _restore(path):
+    dst = StateDict(
+        w=np.zeros(1024, np.float32),
+        b=np.ones((32,), np.float64),
+        step=-1,
+        name="",
+    )
+    Snapshot(str(path)).restore({"app": dst})
+    return dst
+
+
+def test_consolidate_folds_journal_epochs(tmp_path, journaling):
+    src, live = _journaled_base(tmp_path / "root", epochs=3)
+    dst = str(tmp_path / "flat")
+    consolidate(src, dst)
+
+    # The destination is journal-free and self-contained...
+    assert not os.path.isdir(os.path.join(dst, ".journal"))
+    code, report = run_fsck(dst)
+    assert code == 0, report.findings
+
+    # ...and equals base + replay, bit-exact, across entry types:
+    # chunked array, primitive scalar, primitive string.
+    out = _restore(dst)
+    np.testing.assert_array_equal(out["w"], live["w"])
+    np.testing.assert_array_equal(out["b"], live["b"])
+    assert out["step"] == live["step"] == 3
+    assert out["name"] == live["name"] == "run-3"
+
+    # The source (base + journal) restores to the same state.
+    srcout = _restore(src)
+    np.testing.assert_array_equal(srcout["w"], out["w"])
+    assert srcout["step"] == out["step"]
+
+
+def test_consolidate_without_journal_unchanged(tmp_path):
+    """No journal present: consolidation behaves exactly as before."""
+    src = str(tmp_path / "snap")
+    Snapshot.take(src, {"app": StateDict(w=np.arange(64, dtype=np.float32))})
+    dst = str(tmp_path / "flat")
+    consolidate(src, dst)
+    assert run_fsck(dst)[0] == 0
+    out = StateDict(w=np.zeros(64, np.float32))
+    Snapshot(dst).restore({"app": out})
+    np.testing.assert_array_equal(out["w"], np.arange(64, dtype=np.float32))
+
+
+def test_consolidate_incremental_chain_with_journal(tmp_path, journaling):
+    """An incremental child whose payloads dedup against a base, PLUS a
+    journal on the child: consolidation must both resolve the origin deps
+    and fold the journal."""
+    mgr = CheckpointManager(
+        str(tmp_path / "root"), save_interval_steps=1, incremental=True
+    )
+    frozen = np.arange(4096, dtype=np.float32)
+    st = StateDict(frozen=frozen, head=np.full((64,), 0.0, np.float32), step=0)
+    mgr.save(0, {"app": st})
+    st["head"] = np.full((64,), 1.0, np.float32)
+    st["step"] = 1
+    mgr.save(1, {"app": st})  # frozen dedups against step 0
+    st["head"] = np.full((64,), 2.0, np.float32)
+    st["step"] = 2
+    assert mgr.journal_step(2, {"app": st})
+
+    dst = str(tmp_path / "flat")
+    consolidate(mgr.path_for(1), dst)
+    assert run_fsck(dst)[0] == 0, "consolidated chain must be self-contained"
+
+    import shutil
+
+    shutil.rmtree(mgr.path_for(0))  # base gone: dst must not need it
+    out = StateDict(
+        frozen=np.zeros(4096, np.float32),
+        head=np.zeros(64, np.float32),
+        step=-1,
+    )
+    Snapshot(dst).restore({"app": out})
+    np.testing.assert_array_equal(out["frozen"], frozen)
+    np.testing.assert_array_equal(out["head"], np.full((64,), 2.0, np.float32))
+    assert out["step"] == 2
+
+
+def test_consolidate_reads_origin_through_mirror(tmp_path, journaling):
+    """Origin-mirror-aware compaction: the base's primary payload is lost
+    but its mirror is intact — consolidating a journaled child still
+    succeeds (the same failover the restore path uses)."""
+    base = str(tmp_path / "base")
+    opts = {"mirror_url": str(tmp_path / "mirror")}
+    frozen = np.arange(4096, dtype=np.float32)
+    Snapshot.take(
+        base,
+        {"app": StateDict(frozen=frozen, head=np.zeros(8, np.float32))},
+        storage_options=opts,
+        record_digests=True,
+    )
+    inc = str(tmp_path / "inc")
+    Snapshot.take(
+        inc,
+        {"app": StateDict(frozen=frozen, head=np.ones(8, np.float32))},
+        incremental_base=base,
+        record_digests=True,
+    )
+    # Journal an epoch on the incremental child.
+    from torchsnapshot_tpu import journal
+
+    st = StateDict(frozen=frozen, head=np.full((8,), 5.0, np.float32))
+    j = journal.DeltaJournal(inc, base_step=0, rank=0)
+    j.capture_baseline({"app": StateDict(frozen=frozen, head=np.ones(8, np.float32))})
+    assert j.append_epoch({"app": st}) == 1
+
+    # Lose the base's primary copy of a frozen payload.
+    lost = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(os.path.join(base, "0"))
+        for f in fs
+        if "frozen" in f
+    ]
+    assert lost
+    os.remove(lost[0])
+
+    dst = str(tmp_path / "flat")
+    consolidate(inc, dst)
+    assert run_fsck(dst)[0] == 0
+    out = StateDict(frozen=np.zeros(4096, np.float32), head=np.zeros(8, np.float32))
+    Snapshot(dst).restore({"app": out})
+    np.testing.assert_array_equal(out["frozen"], frozen)
+    np.testing.assert_array_equal(out["head"], np.full((8,), 5.0, np.float32))
+
+
+def test_consolidate_refuses_corrupt_journal(tmp_path, journaling):
+    """A journal whose committed region fails CRC must abort consolidation
+    with a diagnosis pointing at fsck — never silently drop the epochs."""
+    src, _ = _journaled_base(tmp_path / "root")
+    seg = os.path.join(src, ".journal", "rank_0.seg")
+    with open(seg, "r+b") as f:
+        f.seek(16)
+        byte = f.read(1)
+        f.seek(16)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(ValueError, match="fsck"):
+        consolidate(src, str(tmp_path / "flat"))
+
+
+def test_consolidate_refuses_new_leaf_in_journal(tmp_path, journaling):
+    """A journaled key absent from the base manifest (state grew a leaf
+    between base and epoch) cannot be folded — explicit refusal."""
+    src, _ = _journaled_base(tmp_path / "root", epochs=1)
+    from torchsnapshot_tpu import journal
+
+    jdir = os.path.join(src, ".journal")
+    committed = journal.committed_epochs(journal.read_epoch_metas(jdir))
+    gen = committed[-1]["gen"]
+    fields, payload = journal._serialize_leaf(123, "object")
+    header = {"v": 1, "gen": gen, "epoch": 1, "key": "app/brand_new"}
+    header.update(fields)
+    seg = os.path.join(jdir, journal.segment_name(0))
+    with open(seg, "ab") as f:
+        f.write(journal.encode_record(header, payload))
+    # Extend the committed offset over the forged record.
+    import json
+
+    meta_path = os.path.join(jdir, journal.epoch_meta_name(1))
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["offsets"]["0"] = os.path.getsize(seg)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    with pytest.raises(ValueError, match="restore and retake"):
+        consolidate(src, str(tmp_path / "flat"))
+
+
+def test_consolidated_journal_snapshot_serves_as_base(tmp_path, journaling):
+    """Chain-dep integrity after compaction: the consolidated snapshot's
+    digests reflect the FOLDED content, so it works as a future
+    incremental base without false dedup hits."""
+    src, live = _journaled_base(
+        tmp_path / "root", epochs=2, incremental=True
+    )
+    dst = str(tmp_path / "flat")
+    consolidate(src, dst)
+
+    nxt = str(tmp_path / "next")
+    Snapshot.take(
+        nxt,
+        {
+            "app": StateDict(
+                w=np.asarray(live["w"]),  # unchanged vs folded dst
+                b=np.asarray(live["b"]),
+                step=live["step"],
+                name=live["name"],
+            )
+        },
+        incremental_base=dst,
+        record_digests=True,
+    )
+    out = _restore(nxt)
+    np.testing.assert_array_equal(out["w"], live["w"])
+    assert out["step"] == live["step"]
+    assert run_fsck(nxt)[0] == 0

@@ -368,3 +368,143 @@ def test_fsck_verifies_batched_slab_ranges(tmp_path, monkeypatch):
     code, report = run_fsck(str(snap))
     assert code == 1
     assert "checksum-mismatch" in report.classes()
+
+
+# ------------------------------------------------- journal artifact class
+
+
+def _take_with_journal(tmp_path, monkeypatch, epochs: int = 2):
+    """A committed snapshot carrying a journal chain of ``epochs`` epochs."""
+    from torchsnapshot_tpu import CheckpointManager
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL", "1")
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    state = StateDict(w=np.arange(512, dtype=np.float32), step=0)
+    mgr.save(0, {"app": state})
+    for epoch in range(1, epochs + 1):
+        state["step"] = epoch
+        assert mgr.journal_step(epoch, {"app": state})
+    snap = os.path.join(str(tmp_path), sorted(
+        n for n in os.listdir(tmp_path)
+        if os.path.isdir(os.path.join(str(tmp_path), n))
+    )[0])
+    return snap, os.path.join(snap, ".journal")
+
+
+def test_all_internal_artifact_classes_fsck_clean(tmp_path, monkeypatch):
+    """The internal-artifact registry regression: a snapshot carrying
+    EVERY registered artifact class — telemetry summary, critpath,
+    flight-recorder dumps, a quarantine dir, and a journal chain — must
+    fsck clean, and ``--repair`` must leave all of them in place."""
+    snap, jdir = _take_with_journal(tmp_path, monkeypatch)
+    os.makedirs(os.path.join(snap, ".flight"))
+    with open(os.path.join(snap, ".flight", "rank_0.jsonl"), "w") as f:
+        f.write("{}\n")
+    os.makedirs(os.path.join(snap, ".fsck_quarantine"))
+    with open(os.path.join(snap, ".fsck_quarantine", "old_orphan"), "w") as f:
+        f.write("x")
+    os.makedirs(os.path.join(snap, ".telemetry"))
+    with open(os.path.join(snap, ".telemetry", "r0.json"), "w") as f:
+        f.write("{}")
+    for fname in (".snapshot_telemetry", ".snapshot_critpath"):
+        with open(os.path.join(snap, fname), "w") as f:
+            f.write("{}")
+
+    code, report = run_fsck(snap)
+    assert code == 0, report.findings
+
+    before = sorted(
+        os.path.relpath(os.path.join(dp, f), snap)
+        for dp, _, fs in os.walk(snap)
+        for f in fs
+    )
+    code, report = run_fsck(snap, repair=True)
+    assert code == 0 and not report.repaired
+    after = sorted(
+        os.path.relpath(os.path.join(dp, f), snap)
+        for dp, _, fs in os.walk(snap)
+        for f in fs
+    )
+    assert after == before
+
+
+def test_internal_artifact_registry_is_the_single_source(tmp_path):
+    """Every registry row answers internal_artifact_class; unregistered
+    paths do not."""
+    from torchsnapshot_tpu.cli import (
+        INTERNAL_ARTIFACTS,
+        internal_artifact_class,
+    )
+
+    for art in INTERNAL_ARTIFACTS:
+        for f in art.files:
+            assert internal_artifact_class(f) == art.name
+        for p in art.prefixes:
+            assert internal_artifact_class(os.path.join(p, "x")) == art.name
+    assert internal_artifact_class("0/model/w_0") is None
+    assert internal_artifact_class("stray") is None
+    names = [art.name for art in INTERNAL_ARTIFACTS]
+    assert "journal" in names and len(names) == len(set(names))
+
+
+def test_journal_torn_tail_detected_and_repaired(tmp_path, monkeypatch):
+    snap, jdir = _take_with_journal(tmp_path, monkeypatch)
+    seg = os.path.join(jdir, "rank_0.seg")
+    committed = os.path.getsize(seg)
+    with open(seg, "ab") as f:
+        f.write(b"TSJR\x20\x00\x00\x00torn")
+
+    code, report = run_fsck(snap)
+    assert code == 1
+    assert report.classes() == {"journal-torn-tail"}
+
+    code, report = run_fsck(snap, repair=True)
+    assert code == 0, report.findings
+    assert os.path.getsize(seg) == committed  # truncated to committed offset
+    # Reversible: the tail bytes are quarantined, not deleted.
+    tail = os.path.join(snap, ".fsck_quarantine", ".journal", "rank_0.seg.tail")
+    assert os.path.isfile(tail) and os.path.getsize(tail) == 12
+    # Convergent, and the committed chain still replays.
+    assert run_fsck(snap)[0] == 0
+    from torchsnapshot_tpu import CheckpointManager
+
+    dst = StateDict(w=np.zeros(512, np.float32), step=-1)
+    CheckpointManager(str(tmp_path)).restore({"app": dst})
+    assert dst["step"] == 2
+
+
+def test_journal_orphan_epoch_detected_and_repaired(tmp_path, monkeypatch):
+    snap, jdir = _take_with_journal(tmp_path, monkeypatch)
+    os.remove(os.path.join(jdir, "epoch_000001.json"))  # epoch 2 past the gap
+    code, report = run_fsck(snap)
+    assert code == 1
+    assert "journal-orphan-epoch" in report.classes()
+    code, report = run_fsck(snap, repair=True)
+    assert code == 0, report.findings
+    assert run_fsck(snap)[0] == 0
+
+
+def test_journal_corrupt_record_is_not_repairable(tmp_path, monkeypatch):
+    snap, jdir = _take_with_journal(tmp_path, monkeypatch)
+    seg = os.path.join(jdir, "rank_0.seg")
+    with open(seg, "r+b") as f:
+        f.seek(20)
+        byte = f.read(1)
+        f.seek(20)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    code, report = run_fsck(snap, repair=True)
+    assert code == 1
+    assert "journal-corrupt-record" in report.classes()
+    assert not report.repaired  # corruption is never quarantined away
+
+
+def test_journal_stale_fence_detected_and_repaired(tmp_path, monkeypatch):
+    snap, jdir = _take_with_journal(tmp_path, monkeypatch)
+    with open(os.path.join(jdir, ".fence"), "w") as f:
+        f.write('{"gen": "dead", "epoch": 3}')
+    code, report = run_fsck(snap)
+    assert code == 1
+    assert "stale-fence" in report.classes()
+    code, report = run_fsck(snap, repair=True)
+    assert code == 0, report.findings
+    assert run_fsck(snap)[0] == 0

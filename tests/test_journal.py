@@ -1,0 +1,340 @@
+"""Delta journal (journal.py): sub-second RPO between full snapshots.
+
+The contract under test (ISSUE 14): ``journal_step`` appends only the
+leaves that changed since the last durable state, as fenced, CRC32C'd,
+generation-stamped records; restore is base + bounded replay of the
+committed epoch chain; a torn tail is truncated and never replayed; a
+corrupt committed record rejects the whole journal and falls back to the
+base snapshot (never a partial splice); the configured bounds convert a
+journal step into a full save; preemption flushes the open journal
+instead of taking a synchronous full emergency save.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import CheckpointManager, StateDict
+from torchsnapshot_tpu import journal
+
+
+@pytest.fixture
+def journaling(monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL", "1")
+
+
+def _state(v: float) -> StateDict:
+    return StateDict(
+        w=np.arange(512, dtype=np.float32) + v,
+        b=np.full((32,), v, np.float64),
+        step=int(v),
+        name=f"run-{int(v)}",
+    )
+
+
+def _assert_state(dst: StateDict, v: float) -> None:
+    np.testing.assert_array_equal(
+        dst["w"], np.arange(512, dtype=np.float32) + v
+    )
+    np.testing.assert_array_equal(dst["b"], np.full((32,), v, np.float64))
+    assert dst["step"] == int(v)
+    assert dst["name"] == f"run-{int(v)}"
+
+
+def _snap_dir(mgr: CheckpointManager, step: int) -> str:
+    from torchsnapshot_tpu.storage_plugin import local_fs_root
+
+    local = local_fs_root(mgr.path_for(step))
+    assert local is not None
+    return local
+
+
+def _segment(mgr: CheckpointManager, step: int, rank: int = 0) -> str:
+    return os.path.join(
+        _snap_dir(mgr, step), journal.JOURNAL_DIRNAME, journal.segment_name(rank)
+    )
+
+
+def test_disabled_by_default(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"app": _state(0)})
+    assert not mgr.journal_step(1, {"app": _state(1)})
+    assert not os.path.exists(
+        os.path.join(_snap_dir(mgr, 0), journal.JOURNAL_DIRNAME)
+    )
+
+
+def test_journal_step_needs_a_committed_base(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=5)
+    # No base snapshot yet: nothing to journal against.
+    assert not mgr.journal_step(0, {"app": _state(0)})
+
+
+def test_roundtrip_replay_bit_exact(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    for v in (1, 2, 3):
+        st["w"] = np.arange(512, dtype=np.float32) + v
+        st["b"] = np.full((32,), float(v), np.float64)
+        st["step"] = v
+        st["name"] = f"run-{v}"
+        assert mgr.journal_step(v, {"app": st})
+
+    jdir = os.path.join(_snap_dir(mgr, 0), journal.JOURNAL_DIRNAME)
+    metas = journal.read_epoch_metas(jdir)
+    assert [m["epoch"] for m in journal.committed_epochs(metas)] == [1, 2, 3]
+    # Fence never outlives a committed epoch.
+    assert not os.path.exists(os.path.join(jdir, journal.FENCE_FNAME))
+
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    _assert_state(dst, 3)
+
+
+def test_only_dirty_leaves_are_appended(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["step"] = 1  # one scalar dirty; the arrays unchanged
+    assert mgr.journal_step(1, {"app": st})
+    records, err = journal.scan_segment(_segment(mgr, 0))
+    assert err is None
+    assert [h["key"] for h, _ in records] == ["app/step"]
+    # An epoch with nothing dirty still commits (an explicit durability
+    # point), just with zero records.
+    assert mgr.journal_step(2, {"app": st})
+    assert len(journal.read_epoch_metas(os.path.dirname(_segment(mgr, 0)))) == 2
+
+
+def test_torn_tail_truncated_on_replay(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["step"] = 1
+    assert mgr.journal_step(1, {"app": st})
+
+    seg = _segment(mgr, 0)
+    committed = os.path.getsize(seg)
+    with open(seg, "ab") as f:  # writer died mid-append
+        f.write(b"TSJR\x40\x00\x00\x00{\"v\": 1, \"gen\"")
+
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    np.testing.assert_array_equal(dst["w"], np.arange(512, dtype=np.float32))
+    assert dst["step"] == 1  # the committed epoch replayed
+    assert os.path.getsize(seg) == committed  # tail truncated, records kept
+
+
+def test_corrupt_committed_record_falls_back_to_base(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["w"] = st["w"] + 5
+    st["step"] = 5
+    assert mgr.journal_step(1, {"app": st})
+
+    seg = _segment(mgr, 0)
+    with open(seg, "r+b") as f:
+        f.seek(os.path.getsize(seg) // 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    # CRC rejects the record; the WHOLE journal is refused (bounded
+    # fallback, never a partial splice) and the base restores intact.
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    _assert_state(dst, 0)
+    # The corrupt segment is left in place as fsck evidence.
+    assert os.path.getsize(seg) > 0
+
+
+def test_fenced_off_straggler_records_never_spliced(tmp_path, journaling):
+    """A record inside the committed byte range whose generation matches
+    no committed epoch (a resurrected straggler's write that slipped in
+    before its fence check) is skipped on replay."""
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["step"] = 1
+    assert mgr.journal_step(1, {"app": st})
+
+    seg = _segment(mgr, 0)
+    jdir = os.path.dirname(seg)
+    fields, payload = journal._serialize_leaf(99, "object")
+    header = {"v": 1, "gen": "deadbeef" * 4, "epoch": 2, "key": "app/step"}
+    header.update(fields)
+    stale = journal.encode_record(header, payload)
+    with open(seg, "ab") as f:
+        f.write(stale)
+    # Forge the committed offset to cover the stale record.
+    meta_path = os.path.join(jdir, journal.epoch_meta_name(1))
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["offsets"]["0"] = os.path.getsize(seg)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    assert dst["step"] == 1  # the committed epoch applied; 99 never did
+
+
+def test_epoch_gap_stops_replay(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    for v in (1, 2):
+        st["step"] = v
+        assert mgr.journal_step(v, {"app": st})
+    os.remove(
+        os.path.join(
+            os.path.dirname(_segment(mgr, 0)), journal.epoch_meta_name(1)
+        )
+    )
+    # Epoch 2 sits past a gap: nothing is committed, base restores.
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    _assert_state(dst, 0)
+
+
+def test_epoch_bytes_cap_forces_full_save(tmp_path, journaling, monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL_EPOCH_BYTES", "64")
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["w"] = st["w"] + 1  # 2 KiB of dirty payload > the 64-byte cap
+    assert mgr.journal_step(1, {"app": st})  # durable — via a full save
+    assert mgr.latest_step() == 1
+    # The new base re-armed a fresh journal; small deltas journal again.
+    st["step"] = 2
+    assert mgr.journal_step(2, {"app": st})
+    assert mgr.latest_step() == 1  # no extra full save
+
+
+def test_max_epochs_bounds_the_replay_chain(tmp_path, journaling, monkeypatch):
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_JOURNAL_MAX_EPOCHS", "2")
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    for v in (1, 2):
+        st["step"] = v
+        assert mgr.journal_step(v, {"app": st})
+    assert mgr.latest_step() == 0
+    st["step"] = 3
+    assert mgr.journal_step(3, {"app": st})  # epoch 3 > cap: full save
+    assert mgr.latest_step() == 3
+
+
+def test_preemption_flushes_journal_not_full_save(tmp_path, journaling):
+    from torchsnapshot_tpu.preemption import (
+        PreemptionWatcher,
+        simulate_preemption_now,
+    )
+
+    watcher = PreemptionWatcher()
+    mgr = CheckpointManager(
+        str(tmp_path), save_interval_steps=100, preemption=watcher
+    )
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["step"] = 1
+    assert mgr.journal_step(1, {"app": st})
+
+    st["w"] = st["w"] + 7
+    st["step"] = 2
+    simulate_preemption_now()
+    try:
+        # Off-cadence save: the journal flush replaces the synchronous
+        # full emergency save — no new snapshot directory appears.
+        assert mgr.save(2, {"app": st}) is False
+        assert watcher.consumed
+        assert mgr.all_steps() == [0]
+    finally:
+        watcher.close()
+
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    np.testing.assert_array_equal(
+        dst["w"], np.arange(512, dtype=np.float32) + 7
+    )
+    assert dst["step"] == 2
+
+
+def test_restore_rearms_and_continues_the_chain(tmp_path, journaling):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["step"] = 1
+    assert mgr.journal_step(1, {"app": st})
+
+    # A resumed run: restore re-arms the journal against the same base...
+    mgr2 = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st2 = _state(-1)
+    assert mgr2.restore({"app": st2}) == 0
+    assert st2["step"] == 1
+    st2["step"] = 2
+    assert mgr2.journal_step(2, {"app": st2})  # ...and the chain continues
+
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    assert dst["step"] == 2
+
+
+def test_journal_flight_events(tmp_path, journaling):
+    from torchsnapshot_tpu.telemetry import flightrec
+
+    flightrec.reset()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    st = _state(0)
+    mgr.save(0, {"app": st})
+    st["step"] = 1
+    assert mgr.journal_step(1, {"app": st})
+    events = {ev for _, _, ev, _ in flightrec.snapshot_ring()}
+    assert {"journal.open", "journal.commit"} <= events
+
+    dst = _state(-1)
+    assert CheckpointManager(str(tmp_path)).restore({"app": dst}) == 0
+    events = {ev for _, _, ev, _ in flightrec.snapshot_ring()}
+    assert "journal.replay" in events
+
+
+# ------------------------------------------------------- record framing unit
+
+
+def test_record_framing_roundtrip_torn_and_corrupt():
+    payload = memoryview(b"\x01\x02\x03\x04" * 8)
+    rec = journal.encode_record(
+        {
+            "v": 1,
+            "gen": "g",
+            "epoch": 1,
+            "key": "k",
+            "kind": "object",
+            "nbytes": len(payload),
+        },
+        payload,
+    )
+    header, out, off = journal._decode_one(memoryview(rec), 0)
+    assert header["key"] == "k" and bytes(out) == bytes(payload)
+    assert off == len(rec)
+
+    for cut in (2, 10, len(rec) - 1):  # torn anywhere: EOFError, no splice
+        with pytest.raises(EOFError):
+            journal._decode_one(memoryview(rec[:cut]), 0)
+
+    flipped = bytearray(rec)
+    flipped[-6] ^= 0xFF  # payload byte under the trailer CRC
+    with pytest.raises(ValueError):
+        journal._decode_one(memoryview(bytes(flipped)), 0)
+
+
+def test_committed_epochs_is_the_contiguous_prefix():
+    metas = [{"epoch": 1}, {"epoch": 2}, {"epoch": 4}]
+    assert [m["epoch"] for m in journal.committed_epochs(metas)] == [1, 2]
+    assert journal.committed_epochs([{"epoch": 2}]) == []

@@ -65,6 +65,9 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_FSYNC",
     "TORCHSNAPSHOT_TPU_HEARTBEAT_S",
     "TORCHSNAPSHOT_TPU_IO_CONCURRENCY",
+    "TORCHSNAPSHOT_TPU_JOURNAL",
+    "TORCHSNAPSHOT_TPU_JOURNAL_EPOCH_BYTES",
+    "TORCHSNAPSHOT_TPU_JOURNAL_MAX_EPOCHS",
     "TORCHSNAPSHOT_TPU_LINT_BASELINE",
     "TORCHSNAPSHOT_TPU_METRICS_PORT",
     "TORCHSNAPSHOT_TPU_MMAP_READS",

@@ -53,13 +53,20 @@ PINNED_SITE_FILES = {
     # the owner", which is only that while the site sits on reshard.py's
     # forwarding boundary.
     "reshard.peer_xfer": "reshard.py",
+    # The delta-journal sites (ISSUE 14) are pinned to the journal: the
+    # chaos drills SIGKILL "mid-append, inside one record's frame" and
+    # corrupt "the payload as replay reads it back", which is only that
+    # while the sites sit on journal.py's record framing boundaries.
+    "journal.append": "journal.py",
+    "journal.replay": "journal.py",
 }
 
 # Regression floor: the registry started at 15 sites (ISSUE 5), grew
 # the replication/lease sites (ISSUE 6), the native-engine sites
-# (ISSUE 9), and the planned-reshard bundle site (ISSUE 12). Shrinking
-# it means a drill surface was silently unthreaded.
-MIN_SITES = 21
+# (ISSUE 9), the planned-reshard bundle site (ISSUE 12), and the
+# delta-journal sites (ISSUE 14). Shrinking it means a drill surface
+# was silently unthreaded.
+MIN_SITES = 23
 
 
 def check_source(

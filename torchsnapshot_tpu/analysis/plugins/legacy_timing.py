@@ -54,6 +54,7 @@ BENCHMARK_ALLOWLIST = {
     "dma_overlap.py",
     "embedding_save.py",
     "manifest_scale.py",
+    "journal_rpo.py",  # epoch-append vs full-save walls time wall clock
     "reshard_throughput.py",  # planned vs direct restore walls time wall clock
     "restore_overlap.py",  # read/consume overlap legs time wall clock
     "sharded_save.py",

@@ -13,7 +13,10 @@ on-call asks, so they get first-class commands here:
   (end-to-end CRC32C integrity, see integrity.py).
 - ``fsck``     — full consistency check: manifest<->payload existence/
   size/CRC agreement, incremental-chain (deps) integrity, orphan and
-  partial-commit detection; ``--repair`` quarantines orphans under
+  partial-commit detection, and delta-journal integrity (torn tails,
+  orphan epochs, corrupt committed records; internal artifact dirs are
+  recognized via ``INTERNAL_ARTIFACTS``, one registry); ``--repair``
+  quarantines orphans and truncates torn journal tails under
   ``.fsck_quarantine/``. Exit codes: 0 clean, 1 findings, 2 cannot-check
   (see docs/source/fault_tolerance.rst).
 - ``migrate``  — convert a reference-format (pytorch/torchsnapshot)
@@ -70,11 +73,13 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from .analysis import runner as analysis_runner
 from .integrity import IntegrityError, verify_checksum
 from .io_types import ReadIO
+from .journal import JOURNAL_DIRNAME
 from .manifest import (
     ArrayEntry,
     ChunkedArrayEntry,
@@ -392,18 +397,72 @@ def cmd_verify(args: argparse.Namespace) -> int:
 # exit codes: 0 clean, 1 findings, 2 cannot-check.
 
 
+@dataclass(frozen=True)
+class InternalArtifact:
+    """One class of internal (non-payload) artifact a COMMITTED snapshot
+    may legitimately carry alongside its manifest-referenced payloads."""
+
+    name: str
+    files: Tuple[str, ...] = ()  # exact snapshot-relative paths
+    prefixes: Tuple[str, ...] = ()  # top-level directory names
+
+
+#: The single registry of internal artifacts fsck must not flag as
+#: orphans. Grown ad hoc across PRs (telemetry, critpath, quarantine,
+#: flight recorder) as scattered literals inside the orphan scan; any new
+#: artifact class registers HERE, in one place, or fsck will quarantine
+#: it. ``.snapshot_metadata`` is a literal (not imported from .snapshot)
+#: to keep this module's top-level imports light.
+INTERNAL_ARTIFACTS: Tuple[InternalArtifact, ...] = (
+    InternalArtifact("metadata", files=(".snapshot_metadata",)),
+    InternalArtifact(
+        "telemetry", files=(".snapshot_telemetry",), prefixes=(".telemetry",)
+    ),
+    InternalArtifact("critpath", files=(".snapshot_critpath",)),
+    InternalArtifact("quarantine", prefixes=(".fsck_quarantine",)),
+    InternalArtifact("flight", prefixes=(".flight",)),
+    # Delta journal (journal.py): fenced epoch segments between full
+    # snapshots. Exempt from the orphan scan, but NOT unchecked — it has
+    # its own fsck pass (_fsck_journal) with dedicated finding classes.
+    InternalArtifact("journal", prefixes=(JOURNAL_DIRNAME,)),
+)
+
+
+def internal_artifact_class(rel: str) -> Optional[str]:
+    """The registered internal-artifact class owning the snapshot-relative
+    path ``rel``, or None for payload/user data."""
+    import os
+
+    top = rel.split(os.sep, 1)[0].split("/", 1)[0]
+    for art in INTERNAL_ARTIFACTS:
+        if rel in art.files or top in art.prefixes:
+            return art.name
+    return None
+
+
 class FsckReport:
     """Findings grouped by class. ``findings`` holds what is wrong NOW
     (after any repair); ``repaired`` what --repair quarantined."""
 
-    #: finding classes --repair may quarantine (never payload data)
-    REPAIRABLE = ("orphan", "temp-file", "stale-fence")
+    #: finding classes --repair may quarantine (never payload data).
+    #: journal-torn-tail is special-cased in _fsck_repair: only the bytes
+    #: PAST the committed offset are quarantined, then the segment is
+    #: truncated back to its committed length.
+    REPAIRABLE = (
+        "orphan",
+        "temp-file",
+        "stale-fence",
+        "journal-torn-tail",
+        "journal-orphan-epoch",
+    )
 
     def __init__(self) -> None:
         self.findings: List[Tuple[str, str, str]] = []  # (class, where, what)
         self.repaired: List[Tuple[str, str]] = []  # (class, where)
         self.payloads_ok = 0
         self.payloads_skipped = 0
+        #: rel segment path -> committed offset, for torn-tail repair
+        self.journal_tails: Dict[str, int] = {}
 
     def add(self, cls: str, where: str, what: str) -> None:
         self.findings.append((cls, where, what))
@@ -568,7 +627,7 @@ def _fsck_orphan_scan(
 ) -> None:
     import os
 
-    from .snapshot import SNAPSHOT_FENCE_FNAME, SNAPSHOT_METADATA_FNAME
+    from .snapshot import SNAPSHOT_FENCE_FNAME
 
     referenced = set()
     for entry in meta.manifest.values():
@@ -576,12 +635,9 @@ def _fsck_orphan_scan(
             if origin is None:
                 referenced.add(os.path.normpath(location))
 
-    internal_files = {
-        SNAPSHOT_METADATA_FNAME,
-        ".snapshot_telemetry",
-        ".snapshot_critpath",
-    }
-    internal_prefixes = (".telemetry", ".fsck_quarantine", ".flight")
+    internal_prefixes = tuple(
+        p for art in INTERNAL_ARTIFACTS for p in art.prefixes
+    )
     for dirpath, dirnames, filenames in os.walk(local_dir):
         rel_dir = os.path.relpath(dirpath, local_dir)
         top = (rel_dir.split(os.sep, 1)[0] if rel_dir != "." else "")
@@ -592,7 +648,7 @@ def _fsck_orphan_scan(
             rel = os.path.normpath(
                 os.path.join(rel_dir, fname) if rel_dir != "." else fname
             )
-            if rel in referenced or rel in internal_files:
+            if rel in referenced or internal_artifact_class(rel) is not None:
                 continue
             if rel == SNAPSHOT_FENCE_FNAME:
                 report.add(
@@ -612,6 +668,115 @@ def _fsck_orphan_scan(
             report.add("orphan", rel_dir, "empty directory")
 
 
+def _fsck_journal(local_dir: str, report: FsckReport) -> None:
+    """The journal artifact class (journal.py): epoch-chain contiguity,
+    committed-region CRC verification, torn-tail detection, and orphan
+    epoch metas. Finding classes:
+
+    - ``journal-torn-tail``    (repairable): bytes past the last committed
+      offset — a writer died mid-append. Replay already ignores them; the
+      repair quarantines the tail bytes and truncates the segment.
+    - ``journal-orphan-epoch`` (repairable): an epoch meta past a gap in
+      the chain, or unparseable — it never committed on the surviving
+      chain and must never be replayed.
+    - ``journal-corrupt-record`` (NOT repairable): the committed region of
+      a segment fails CRC/parse, or a committed segment is missing/short.
+      The journal is unreplayable past the damage; restore falls back to
+      the base snapshot. Retake a full snapshot.
+    - a leftover ``.journal/.fence`` reuses the ``stale-fence`` class: the
+      epoch writer died between planting the fence and committing.
+    """
+    import os
+
+    from . import journal as journal_mod
+
+    jdir = os.path.join(local_dir, JOURNAL_DIRNAME)
+    if not os.path.isdir(jdir):
+        return
+
+    def rel(name: str) -> str:
+        return os.path.join(JOURNAL_DIRNAME, name)
+
+    metas = journal_mod.read_epoch_metas(jdir)
+    committed = journal_mod.committed_epochs(metas)
+    committed_ids = {m.get("epoch") for m in committed}
+
+    try:
+        names = sorted(os.listdir(jdir))
+    except OSError as e:
+        report.add("io-error", JOURNAL_DIRNAME, f"cannot list journal: {e}")
+        return
+
+    seg_ranks = set()
+    for name in names:
+        if name == journal_mod.FENCE_FNAME:
+            report.add(
+                "stale-fence", rel(name),
+                "journal epoch fence outlived its epoch (writer died "
+                "mid-epoch; the uncommitted epoch is already ignored)",
+            )
+            continue
+        seg_m = journal_mod._SEGMENT_RE.match(name)
+        if seg_m is not None:
+            seg_ranks.add(int(seg_m.group(1)))
+            continue
+        meta_m = journal_mod._EPOCH_META_RE.match(name)
+        if meta_m is not None:
+            epoch = int(meta_m.group(1))
+            if epoch not in committed_ids:
+                parsed = any(m.get("epoch") == epoch for m in metas)
+                report.add(
+                    "journal-orphan-epoch", rel(name),
+                    f"epoch {epoch} past a gap in the committed chain "
+                    "(never replayed)" if parsed
+                    else "unparseable epoch metadata (never replayed)",
+                )
+            continue
+        if ".tmp." in name:
+            report.add(
+                "temp-file", rel(name),
+                "write temp file left behind by a dead writer",
+            )
+        else:
+            report.add("orphan", rel(name), "not a journal artifact")
+
+    # Committed-region integrity + torn tails, against the LAST committed
+    # epoch's offsets (they are monotonic across the chain by protocol).
+    offsets = committed[-1].get("offsets", {}) if committed else {}
+    for rank in sorted(seg_ranks | {int(r) for r in offsets}):
+        seg_rel = rel(journal_mod.segment_name(rank))
+        seg_path = os.path.join(local_dir, seg_rel)
+        limit = int(offsets.get(str(rank), 0))
+        if not os.path.exists(seg_path):
+            if limit > 0:
+                report.add(
+                    "journal-corrupt-record", seg_rel,
+                    f"committed segment missing ({limit} byte(s) recorded)",
+                )
+            continue
+        if limit > 0:
+            _, error = journal_mod.scan_segment(seg_path, limit)
+            if error is not None:
+                report.add(
+                    "journal-corrupt-record", seg_rel,
+                    f"committed region unreplayable: {error} — restore "
+                    "falls back to the base snapshot; retake a full "
+                    "snapshot",
+                )
+                continue  # size vs limit is meaningless past corruption
+        try:
+            size = os.path.getsize(seg_path)
+        except OSError:
+            continue
+        if size > limit:
+            report.add(
+                "journal-torn-tail", seg_rel,
+                f"{size - limit} uncommitted byte(s) past the committed "
+                f"offset {limit} (writer died mid-append; never replayed)",
+            )
+            report.journal_tails[seg_rel] = limit
+
+
 def _fsck_repair(local_dir: str, report: FsckReport, echo) -> None:
     """Quarantine repairable findings under ``.fsck_quarantine/``
     (preserving relative paths) — never deletes, never touches payload
@@ -624,6 +789,30 @@ def _fsck_repair(local_dir: str, report: FsckReport, echo) -> None:
     for cls, where, what in report.findings:
         if cls not in FsckReport.REPAIRABLE:
             remaining.append((cls, where, what))
+            continue
+        if cls == "journal-torn-tail":
+            # Repair in place: quarantine only the bytes PAST the
+            # committed offset, then truncate the segment back to its
+            # committed length — the committed records stay replayable.
+            seg = os.path.join(local_dir, where)
+            limit = report.journal_tails.get(where, 0)
+            dst = os.path.join(quarantine, where + ".tail")
+            try:
+                with open(seg, "rb") as f:
+                    f.seek(limit)
+                    tail = f.read()
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                with open(dst, "wb") as f:
+                    f.write(tail)
+                os.truncate(seg, limit)
+            except OSError as e:
+                remaining.append((cls, where, f"{what} (repair failed: {e})"))
+                continue
+            report.repaired.append((cls, where))
+            echo(
+                f"TRUNCATED    {where} -> committed offset {limit} "
+                f"(tail in .fsck_quarantine/{where}.tail)"
+            )
             continue
         src = os.path.join(local_dir, where)
         dst = os.path.join(quarantine, where)
@@ -723,6 +912,7 @@ def run_fsck(
     _fsck_payload_checks(path, meta, storage_options, report, echo, verbose)
     if local_dir is not None:
         _fsck_orphan_scan(local_dir, meta, report)
+        _fsck_journal(local_dir, report)
     else:
         echo("note: remote backend — orphan scan skipped (payload and "
              "chain checks only)")

@@ -299,6 +299,11 @@ def consolidate(
                 p.origin = None
             if isinstance(entry, ObjectEntry):
                 entry.origin = None
+        # Fold committed delta-journal epochs (journal.py) into the copied
+        # payloads, BEFORE the metadata commit: the consolidated snapshot
+        # then equals base + replay with no journal to carry (the .journal
+        # directory is never among the manifest locations copied above).
+        _compact_journal(src_path, metadata, plugins[None], event_loop)
         # The consolidated snapshot is self-contained and single-tier.
         metadata.origin_mirrors = None
         metadata.mirror_url = None
@@ -308,3 +313,159 @@ def consolidate(
             plugin.sync_close(event_loop)
         event_loop.close()
     return len(locations)
+
+
+def _compact_journal(src_path, metadata, dst_plugin, event_loop) -> int:
+    """Apply the final committed journal value of every journaled leaf to
+    the destination payloads and their manifest entries.
+
+    Raises ValueError when a record cannot be folded faithfully (corrupt
+    journal, shape/dtype drift against the base entry, sharded or
+    slab-compressed destinations) — consolidation must never silently drop
+    committed state. Returns the number of records folded.
+    """
+    import os
+
+    import numpy as np
+
+    from . import journal as journal_mod
+    from . import serialization
+    from .integrity import compute_checksum
+    from .io_types import ReadIO, WriteIO
+    from .manifest import ChunkedArrayEntry as _Chunked
+    from .manifest import PrimitiveEntry, ShardedArrayEntry
+    from .storage_plugin import local_fs_root
+
+    local = local_fs_root(src_path)
+    if local is None:
+        return 0
+    jdir = os.path.join(local, journal_mod.JOURNAL_DIRNAME)
+    if not os.path.isdir(jdir):
+        return 0
+    committed = journal_mod.committed_epochs(journal_mod.read_epoch_metas(jdir))
+    if not committed:
+        return 0
+    updates = {}  # manifest key -> (header, payload)
+    for rank_str in sorted(committed[-1].get("offsets", {}), key=int):
+        rank = int(rank_str)
+        ups, err, _tail = journal_mod.collect_rank_updates(jdir, rank, committed)
+        if err is not None:
+            raise ValueError(
+                f"journal of {src_path} cannot be read ({err}); fix it with "
+                "fsck before consolidating"
+            )
+        for key, rec in ups.items():
+            updates[f"{rank}/{key}"] = rec
+
+    def write_payload(array_entry, buf) -> None:
+        """Replace one ArrayEntry/ObjectEntry's stored bytes in dst and
+        refresh its integrity fields (uncompressed content in ``buf``)."""
+        stored = buf
+        if array_entry.codec:
+            if array_entry.byte_range is not None:
+                raise ValueError(
+                    f"cannot compact journal into compressed slab payload "
+                    f"{array_entry.location}"
+                )
+            from .compression import compress
+
+            stored = compress(array_entry.codec, buf)
+        if array_entry.byte_range is not None:
+            lo, hi = array_entry.byte_range
+            if hi - lo != len(stored):
+                raise ValueError(
+                    f"journal record size {len(stored)} != slab range "
+                    f"[{lo}, {hi}) of {array_entry.location}"
+                )
+            read_io = ReadIO(path=array_entry.location)
+            event_loop.run_until_complete(dst_plugin.read(read_io))
+            slab = bytearray(read_io.buf)
+            slab[lo:hi] = stored
+            event_loop.run_until_complete(
+                dst_plugin.write(WriteIO(path=array_entry.location, buf=slab))
+            )
+        else:
+            event_loop.run_until_complete(
+                dst_plugin.write(
+                    WriteIO(path=array_entry.location, buf=bytes(stored))
+                )
+            )
+        if array_entry.checksum is not None:
+            array_entry.checksum = compute_checksum(stored)
+        if getattr(array_entry, "digest", None) is not None:
+            array_entry.digest = compute_digest(buf)
+        if getattr(array_entry, "device_digest", None) is not None:
+            array_entry.device_digest = None  # stale: content replaced
+
+    folded = 0
+    for mkey, (header, payload) in sorted(updates.items()):
+        entry = metadata.manifest.get(mkey)
+        if entry is None:
+            raise ValueError(
+                f"journaled key {mkey!r} has no entry in the base manifest "
+                "(state grew a new leaf after the base snapshot); restore "
+                "and retake instead of consolidating"
+            )
+        kind = header.get("kind")
+        if isinstance(entry, PrimitiveEntry):
+            if kind != "object":
+                raise ValueError(
+                    f"journaled key {mkey!r} changed type against the base "
+                    "snapshot; restore and retake instead of consolidating"
+                )
+            value = serialization.object_from_bytes(payload)
+            metadata.manifest[mkey] = PrimitiveEntry.from_object(
+                value, replicated=entry.replicated
+            )
+        elif isinstance(entry, ObjectEntry):
+            if kind != "object":
+                raise ValueError(
+                    f"journaled key {mkey!r} changed type against the base "
+                    "snapshot; restore and retake instead of consolidating"
+                )
+            write_payload(entry, bytes(payload))
+            if entry.size is not None:
+                entry.size = len(payload)
+        elif isinstance(entry, ArrayEntry):
+            if kind != "array" or entry.dtype != header.get("dtype") or list(
+                entry.shape
+            ) != list(header.get("shape", [])):
+                raise ValueError(
+                    f"journaled array {mkey!r} drifted in dtype/shape "
+                    "against the base snapshot; restore and retake instead "
+                    "of consolidating"
+                )
+            write_payload(entry, payload)
+        elif isinstance(entry, _Chunked):
+            if kind != "array" or entry.dtype != header.get("dtype") or list(
+                entry.shape
+            ) != list(header.get("shape", [])):
+                raise ValueError(
+                    f"journaled array {mkey!r} drifted in dtype/shape "
+                    "against the base snapshot; restore and retake instead "
+                    "of consolidating"
+                )
+            arr = serialization.array_from_buffer(
+                payload, header["dtype"], header["shape"]
+            )
+            for chunk in entry.chunks:
+                box = tuple(
+                    slice(o, o + s)
+                    for o, s in zip(chunk.offsets, chunk.sizes)
+                )
+                piece = np.ascontiguousarray(arr[box])
+                write_payload(
+                    chunk.array, serialization.array_as_memoryview(piece)
+                )
+        elif isinstance(entry, ShardedArrayEntry):
+            raise ValueError(
+                f"journaled key {mkey!r} is a sharded array; consolidating "
+                "journaled shards is not supported — restore and retake"
+            )
+        else:
+            raise ValueError(
+                f"journaled key {mkey!r} maps to unsupported entry type "
+                f"{type(entry).__name__}; restore and retake"
+            )
+        folded += 1
+    return folded

@@ -345,6 +345,37 @@ def device_fingerprint(arr) -> Optional[str]:
     return _finalize(arr, pending)
 
 
+def fingerprint_any(value) -> "tuple[str, str]":
+    """Content fingerprint + leaf kind (``"array"`` | ``"object"``) for ANY
+    state leaf — the delta journal's dirty detector (journal.py).
+
+    jax arrays use the on-device digest when dispatchable (no DtoH copy);
+    host-visible arrays hash their exact bytes; everything else (python
+    scalars, opaque objects) hashes its pickle. The kind tells the journal
+    which serialization path round-trips the leaf.
+    """
+    fp = device_fingerprint(value)
+    if fp is not None:
+        return fp, "array"
+    import hashlib
+
+    from . import serialization
+
+    arr = None
+    if isinstance(value, np.ndarray):
+        arr = value
+    elif type(value).__module__.split(".")[0] == "jax" and hasattr(value, "dtype"):
+        try:
+            arr = np.asarray(value)
+        except Exception:
+            arr = None
+    if arr is not None:
+        data = serialization.array_as_memoryview(np.ascontiguousarray(arr))
+        return "sha256:" + hashlib.sha256(data).hexdigest(), "array"
+    buf = serialization.object_as_bytes(value)
+    return "sha256:" + hashlib.sha256(buf).hexdigest(), "object"
+
+
 # Restore-side verification window: at most MATCH_WINDOW slices AND
 # MATCH_WINDOW_BYTES of slice data in flight per batch. The count bound
 # amortizes the host<->device roundtrip; the BYTE bound is what actually

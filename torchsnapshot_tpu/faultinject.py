@@ -107,6 +107,12 @@ SITES: Dict[str, str] = {
     # before it hits the peer channel — corrupt/truncate exercise the
     # receiver's CRC-then-fallback contract, delay/kill the death drills
     "reshard.peer_xfer": "data",
+    # delta journal (journal.py): the append site sits INSIDE one
+    # record's frame (after its 8-byte prefix hit the disk), so kill
+    # leaves a genuinely torn record and corrupt/truncate mangle bytes
+    # whose CRCs were computed first — replay must detect all three.
+    "journal.append": "data",
+    "journal.replay": "data",  # payload just read, before CRC verify
 }
 
 KNOWN_SITES = frozenset(SITES)

@@ -1,0 +1,673 @@
+"""Crash-consistent delta journaling between full snapshots (sub-second RPO).
+
+Between manager-cadence full snapshots, ``CheckpointManager.journal_step``
+detects changed state leaves via device fingerprints (device_digest.py) and
+appends them as fenced, CRC32C'd, generation-stamped records to a per-rank
+O_APPEND segment under the committed base snapshot directory
+(``<base>/.journal/rank_<r>.seg``). Restore becomes base + bounded replay:
+``maybe_replay`` folds the committed epochs back onto the restored state, so
+the loss window on a crash or eviction shrinks from a full save cadence to
+one journal epoch.
+
+Crash-consistency contract (composes with the snapshot commit protocol):
+
+- A record is ``TSJR | u32 header_len | header JSON | u32 header_crc |
+  payload | u32 payload_crc``. CRCs use the same CRC32C as integrity.py
+  (native SSE4.2 or the identical-value Python table fallback), so a torn
+  tail or a flipped bit is always detectable — never silently replayed.
+- An epoch commits with the two-phase fence/metadata-last protocol from
+  PR 5: rank 0 plants ``.journal/.fence`` carrying a fresh generation, every
+  rank appends generation-stamped records and fsyncs, and only after a
+  cross-rank offset gather does rank 0 re-check the fence and publish
+  ``epoch_<n>.json`` (temp + rename). A resurrected straggler writing under
+  a stale generation can never splice its deltas into a committed epoch:
+  its records carry a generation no epoch metadata names, and replay skips
+  them.
+- Replay is verify-then-apply: every record in the committed region is
+  parsed and CRC-verified FIRST; state is mutated only if the whole chain
+  checks out on every rank (cross-rank verdict gather), else restore falls
+  back to the base snapshot unchanged. Bytes past the last committed offset
+  (a torn tail) are truncated, counted, and never replayed.
+
+The journal requires the snapshot root to be a shared local filesystem
+(every rank appends its own segment into the same ``.journal`` directory;
+rank 0 writes the fence and epoch metadata). On remote roots journaling is
+skipped.
+
+Env:
+  TORCHSNAPSHOT_TPU_JOURNAL=1              - enable delta journaling
+  TORCHSNAPSHOT_TPU_JOURNAL_EPOCH_BYTES=N  - per-epoch total payload cap
+                                             (default 1 GiB); exceeding it
+                                             raises JournalLimitError, which
+                                             the manager converts into a
+                                             forced full save
+  TORCHSNAPSHOT_TPU_JOURNAL_MAX_EPOCHS=N   - epoch-chain length bound
+                                             (default 64, same conversion)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import struct
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import faultinject, serialization, telemetry
+from ._native import crc32c
+from .device_digest import fingerprint_any
+from .flatten import flatten, inflate
+from .stateful import AppState
+from .storage_plugin import local_fs_root
+from .telemetry import flightrec
+
+logger = logging.getLogger(__name__)
+
+JOURNAL_ENV_VAR = "TORCHSNAPSHOT_TPU_JOURNAL"
+EPOCH_BYTES_ENV_VAR = "TORCHSNAPSHOT_TPU_JOURNAL_EPOCH_BYTES"
+MAX_EPOCHS_ENV_VAR = "TORCHSNAPSHOT_TPU_JOURNAL_MAX_EPOCHS"
+
+JOURNAL_DIRNAME = ".journal"
+FENCE_FNAME = ".fence"
+
+_MAGIC = b"TSJR"
+_U32 = struct.Struct("<I")
+_SEGMENT_RE = re.compile(r"^rank_(\d+)\.seg$")
+_EPOCH_META_RE = re.compile(r"^epoch_(\d{6})\.json$")
+
+DEFAULT_EPOCH_BYTES = 1 << 30
+DEFAULT_MAX_EPOCHS = 64
+
+
+class JournalError(RuntimeError):
+    """A journal epoch failed to append or commit."""
+
+
+class JournalLimitError(JournalError):
+    """An epoch would exceed the configured journal bounds; the caller
+    should take a full snapshot instead (CheckpointManager does)."""
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(JOURNAL_ENV_VAR, "0") not in ("0", "", "false")
+
+
+def epoch_bytes_cap() -> int:
+    try:
+        return int(os.environ.get(EPOCH_BYTES_ENV_VAR, DEFAULT_EPOCH_BYTES))
+    except ValueError:
+        return DEFAULT_EPOCH_BYTES
+
+
+def max_epochs() -> int:
+    try:
+        return int(os.environ.get(MAX_EPOCHS_ENV_VAR, DEFAULT_MAX_EPOCHS))
+    except ValueError:
+        return DEFAULT_MAX_EPOCHS
+
+
+def segment_name(rank: int) -> str:
+    return f"rank_{rank}.seg"
+
+
+def epoch_meta_name(epoch: int) -> str:
+    return f"epoch_{epoch:06d}.json"
+
+
+# --------------------------------------------------------------- record layer
+
+
+def encode_record(header: Dict[str, Any], payload: memoryview) -> bytes:
+    """Frame one delta record. Both CRCs are computed over the TRUE bytes
+    here, before any fault-injection mutation downstream — so an injected
+    corruption is CRC-detectable, exactly like real bit rot."""
+    hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+    return b"".join(
+        (
+            _MAGIC,
+            _U32.pack(len(hdr)),
+            hdr,
+            _U32.pack(crc32c(hdr)),
+            payload,
+            _U32.pack(crc32c(payload)),
+        )
+    )
+
+
+def _decode_one(buf: memoryview, off: int) -> Tuple[Dict[str, Any], memoryview, int]:
+    """Decode the record at ``off``; returns (header, payload, next_off).
+
+    Raises ValueError on a malformed/corrupt frame and EOFError when the
+    buffer ends mid-record (a torn frame)."""
+    end = len(buf)
+    if off + 12 > end:
+        raise EOFError("torn record header")
+    if bytes(buf[off : off + 4]) != _MAGIC:
+        raise ValueError(f"bad record magic at offset {off}")
+    (hlen,) = _U32.unpack(buf[off + 4 : off + 8])
+    hdr_start = off + 8
+    hdr_end = hdr_start + hlen
+    if hdr_end + 4 > end:
+        raise EOFError("torn record header")
+    hdr_bytes = bytes(buf[hdr_start:hdr_end])
+    (hcrc,) = _U32.unpack(buf[hdr_end : hdr_end + 4])
+    if crc32c(hdr_bytes) != hcrc:
+        raise ValueError(f"record header CRC mismatch at offset {off}")
+    try:
+        header = json.loads(hdr_bytes.decode("utf-8"))
+        nbytes = int(header["nbytes"])
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        raise ValueError(f"undecodable record header at offset {off}: {e}")
+    p_start = hdr_end + 4
+    p_end = p_start + nbytes
+    if p_end + 4 > end:
+        raise EOFError("torn record payload")
+    payload = buf[p_start:p_end]
+    (pcrc,) = _U32.unpack(buf[p_end : p_end + 4])
+    # The replay-side fault-injection site sits between the read and the
+    # verify, so an injected mutation is caught by the same CRC check that
+    # catches real corruption.
+    payload = memoryview(bytes(faultinject.mutate("journal.replay", payload)))
+    if len(payload) != nbytes or crc32c(payload) != pcrc:
+        raise ValueError(f"record payload CRC mismatch at offset {off}")
+    return header, payload, p_end + 4
+
+
+def scan_segment(
+    path: str, limit: Optional[int] = None
+) -> Tuple[List[Tuple[Dict[str, Any], memoryview]], Optional[str]]:
+    """Parse records from a segment file up to ``limit`` bytes (the committed
+    offset). Returns (records, error) where error is None on a clean parse
+    and a human-readable reason when the committed region is corrupt or
+    torn. Bytes past ``limit`` are never touched."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read() if limit is None else f.read(limit)
+    except OSError as e:
+        return [], f"unreadable segment: {e}"
+    if limit is not None and len(data) < limit:
+        return [], f"segment shorter than committed offset ({len(data)} < {limit})"
+    buf = memoryview(data)
+    records: List[Tuple[Dict[str, Any], memoryview]] = []
+    off = 0
+    while off < len(buf):
+        try:
+            header, payload, off = _decode_one(buf, off)
+        except EOFError:
+            return records, f"torn record at offset {off}"
+        except ValueError as e:
+            return records, str(e)
+        records.append((header, payload))
+    return records, None
+
+
+# ---------------------------------------------------------------- epoch layer
+
+
+def read_epoch_metas(jdir: str) -> List[Dict[str, Any]]:
+    """All parseable epoch metadata files, sorted by epoch number.
+    Unparseable metas are skipped (fsck reports them as orphan epochs)."""
+    metas = []
+    try:
+        names = os.listdir(jdir)
+    except OSError:
+        return []
+    for name in sorted(names):
+        if not _EPOCH_META_RE.match(name):
+            continue
+        try:
+            with open(os.path.join(jdir, name), "r") as f:
+                meta = json.load(f)
+            metas.append(meta)
+        except (OSError, ValueError):
+            continue
+    metas.sort(key=lambda m: m.get("epoch", 0))
+    return metas
+
+
+def committed_epochs(metas: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The contiguous committed prefix (epochs 1..k). A gap means later
+    epochs never committed on the surviving chain — they are orphans and
+    must never be replayed."""
+    out = []
+    want = 1
+    for meta in metas:
+        if meta.get("epoch") != want:
+            break
+        out.append(meta)
+        want += 1
+    return out
+
+
+def collect_rank_updates(
+    jdir: str, rank: int, committed: List[Dict[str, Any]]
+) -> Tuple[Dict[str, Tuple[Dict[str, Any], memoryview]], Optional[str], int]:
+    """Final committed value per key for one rank's segment.
+
+    Returns (updates, error, tail_bytes): ``updates`` maps the flat state
+    key to its last committed (header, payload); ``error`` is non-None when
+    the committed region fails to parse or CRC-verify (the caller must fall
+    back to the base snapshot); ``tail_bytes`` counts bytes past the last
+    committed offset (torn/uncommitted tail, safe to truncate).
+
+    Records stamped with a generation no committed epoch names were written
+    by a fenced-off straggler and are skipped — the never-splice guarantee.
+    """
+    seg = os.path.join(jdir, segment_name(rank))
+    if not committed:
+        return {}, None, 0
+    offsets = committed[-1].get("offsets", {})
+    if str(rank) not in offsets:
+        return {}, f"no committed offset for rank {rank}", 0
+    limit = int(offsets[str(rank)])
+    if not os.path.exists(seg):
+        if limit == 0:
+            return {}, None, 0
+        return {}, f"missing segment {segment_name(rank)}", 0
+    records, error = scan_segment(seg, limit)
+    if error is not None:
+        return {}, error, 0
+    gens = {m.get("gen") for m in committed}
+    updates: Dict[str, Tuple[Dict[str, Any], memoryview]] = {}
+    for header, payload in records:
+        if header.get("gen") not in gens:
+            continue  # fenced-off straggler records: never spliced in
+        updates[header["key"]] = (header, payload)
+    try:
+        tail = max(0, os.path.getsize(seg) - limit)
+    except OSError:
+        tail = 0
+    return updates, None, tail
+
+
+def _write_json_atomic(path: str, obj: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def _serialize_leaf(value: Any, kind: str) -> Tuple[Dict[str, Any], memoryview]:
+    """(header fields, payload) for one dirty leaf."""
+    if kind == "array":
+        arr = np.ascontiguousarray(np.asarray(value))
+        payload = serialization.array_as_memoryview(arr)
+        return (
+            {
+                "kind": "array",
+                "dtype": serialization.dtype_to_string(arr.dtype),
+                "shape": list(arr.shape),
+                "nbytes": len(payload),
+            },
+            payload,
+        )
+    buf = serialization.object_as_bytes(value)
+    return {"kind": "object", "nbytes": len(buf)}, memoryview(buf)
+
+
+def _materialize_leaf(header: Dict[str, Any], payload: memoryview, like: Any) -> Any:
+    """Rebuild a leaf value from a committed record, matching the type of
+    the leaf it replaces (numpy in, numpy out; jax in, jax out)."""
+    if header.get("kind") == "object":
+        return serialization.object_from_bytes(payload)
+    arr = serialization.array_from_buffer(
+        payload, header["dtype"], header["shape"]
+    )
+    if type(like).__module__.split(".")[0] == "jax":
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.array(arr))
+    return np.array(arr)
+
+
+# -------------------------------------------------------------- DeltaJournal
+
+
+class DeltaJournal:
+    """The writer side: fingerprint baselines plus the fenced epoch-append
+    protocol, bound to one committed base snapshot directory."""
+
+    def __init__(self, base_dir: str, *, base_step: int = -1, rank: int = 0) -> None:
+        self.base_dir = base_dir
+        self.base_step = base_step
+        self.rank = rank
+        self.dir = os.path.join(base_dir, JOURNAL_DIRNAME)
+        self.epoch = 0  # last committed epoch
+        self._baseline: Dict[str, str] = {}
+        self._armed = False
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def capture_baseline(self, app_state: AppState) -> None:
+        """Fingerprint every state leaf as of the base snapshot. Must run at
+        save() time, on the state as saved — capturing lazily at the first
+        journal_step would silently lose any mutation in between."""
+        baseline: Dict[str, str] = {}
+        for key, stateful in app_state.items():
+            _manifest, flattened = flatten(stateful.state_dict(), prefix=key)
+            for path, leaf in flattened.items():
+                fp, _kind = fingerprint_any(leaf)
+                baseline[path] = fp
+        self._baseline = baseline
+        self._armed = True
+
+    # -- the fenced epoch-append protocol ---------------------------------
+
+    def _pending_deltas(
+        self, app_state: AppState
+    ) -> List[Tuple[str, Dict[str, Any], memoryview, str]]:
+        """(key, header fields, payload, fingerprint) per dirty leaf."""
+        pending = []
+        for key, stateful in app_state.items():
+            _manifest, flattened = flatten(stateful.state_dict(), prefix=key)
+            for path, leaf in flattened.items():
+                fp, kind = fingerprint_any(leaf)
+                if self._baseline.get(path) == fp:
+                    continue
+                fields, payload = _serialize_leaf(leaf, kind)
+                pending.append((path, fields, payload, fp))
+        return pending
+
+    def append_epoch(self, app_state: AppState, *, pg_wrapper: Any = None) -> int:
+        """Detect dirty leaves and append them as one fenced, committed
+        journal epoch. Collective when ``pg_wrapper`` spans ranks. Returns
+        the number of records this rank appended.
+
+        Raises JournalLimitError — deterministically on every rank — when
+        the epoch would exceed the configured bounds, and JournalError when
+        any rank fails to append or the fence was usurped mid-epoch."""
+        if not self._armed:
+            raise JournalError("journal has no captured baseline")
+        world = pg_wrapper.get_world_size() if pg_wrapper is not None else 1
+        pending = self._pending_deltas(app_state)
+        local_bytes = sum(len(p) for _, _, p, _ in pending)
+        epoch = self.epoch + 1
+
+        if world > 1:
+            gen0 = uuid.uuid4().hex if self.rank == 0 else None
+            gathered = pg_wrapper.all_gather_object((gen0, local_bytes))
+            gen = gathered[0][0]
+            total_bytes = sum(b for _, b in gathered)
+        else:
+            gen = uuid.uuid4().hex
+            total_bytes = local_bytes
+
+        # Bound checks use cross-rank totals and the (collectively agreed)
+        # epoch count, so every rank raises — or none does.
+        if total_bytes > epoch_bytes_cap():
+            raise JournalLimitError(
+                f"epoch {epoch} would append {total_bytes} bytes "
+                f"(> {epoch_bytes_cap()}); take a full snapshot"
+            )
+        if epoch > max_epochs():
+            raise JournalLimitError(
+                f"journal chain reached {max_epochs()} epochs; take a full snapshot"
+            )
+
+        recorder = telemetry.begin_op("journal", self.rank)
+        try:
+            n = self._append_epoch_fenced(epoch, gen, pending, pg_wrapper, world)
+        except BaseException:
+            recorder.abandon()
+            raise
+        recorder.finish(extra={"journal_epoch": epoch})
+
+        self.epoch = epoch
+        for path, _fields, _payload, fp in pending:
+            self._baseline[path] = fp
+        return n
+
+    def _append_epoch_fenced(
+        self,
+        epoch: int,
+        gen: str,
+        pending: List[Tuple[str, Dict[str, Any], memoryview, str]],
+        pg_wrapper: Any,
+        world: int,
+    ) -> int:
+        # Phase 1: rank 0 plants the epoch fence (temp + rename), mirroring
+        # the snapshot commit fence. The broadcast doubles as the barrier.
+        fence_path = os.path.join(self.dir, FENCE_FNAME)
+        fence_err: Optional[str] = None
+        if self.rank == 0:
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+                _write_json_atomic(fence_path, {"gen": gen, "epoch": epoch})
+                flightrec.record("journal.open", gen=gen, epoch=epoch)
+            except OSError as e:
+                fence_err = str(e)
+        if world > 1:
+            fence_err = pg_wrapper.broadcast_object(fence_err)
+        if fence_err is not None:
+            raise JournalError(f"journal fence plant failed: {fence_err}")
+
+        # Phase 2: every rank appends its generation-stamped records and
+        # fsyncs its segment. Failures are carried into the offset gather so
+        # no rank deserts the collective.
+        append_err: Optional[str] = None
+        end_offset = 0
+        n_records = 0
+        try:
+            end_offset, n_records = self._append_records(epoch, gen, pending)
+        except OSError as e:
+            # Covers injected transient/permanent faults too — both are
+            # OSError subclasses by the injector's contract.
+            append_err = str(e)
+
+        if world > 1:
+            ends = pg_wrapper.all_gather_object(
+                (self.rank, append_err, end_offset, n_records)
+            )
+        else:
+            ends = [(self.rank, append_err, end_offset, n_records)]
+        failed = [(r, e) for r, e, _, _ in ends if e is not None]
+        if failed:
+            if self.rank == 0:
+                try:
+                    os.unlink(fence_path)
+                except OSError:
+                    pass
+            raise JournalError(f"journal append failed on rank(s) {failed}")
+
+        # Phase 3: rank 0 re-checks the fence generation (a resurrected
+        # straggler that re-planted it means our records must not commit),
+        # then publishes the epoch metadata temp+rename — metadata-last.
+        commit_err: Optional[str] = None
+        if self.rank == 0:
+            try:
+                with open(fence_path, "r") as f:
+                    found = json.load(f).get("gen")
+                if found != gen:
+                    raise JournalError(
+                        f"journal fence usurped (planted {gen}, found {found}); "
+                        "stale epoch abandoned"
+                    )
+                meta = {
+                    "epoch": epoch,
+                    "gen": gen,
+                    "world_size": world,
+                    "offsets": {str(r): o for r, _, o, _ in ends},
+                    "records": {str(r): c for r, _, _, c in ends},
+                }
+                _write_json_atomic(os.path.join(self.dir, epoch_meta_name(epoch)), meta)
+                _fsync_dir(self.dir)
+                os.unlink(fence_path)
+                flightrec.record(
+                    "journal.commit",
+                    gen=gen,
+                    epoch=epoch,
+                    records=sum(c for _, _, _, c in ends),
+                )
+            except (OSError, ValueError, JournalError) as e:
+                commit_err = str(e)
+        if world > 1:
+            commit_err = pg_wrapper.broadcast_object(commit_err)
+        if commit_err is not None:
+            raise JournalError(f"journal epoch commit failed: {commit_err}")
+        return n_records
+
+    def _append_records(
+        self,
+        epoch: int,
+        gen: str,
+        pending: List[Tuple[str, Dict[str, Any], memoryview, str]],
+    ) -> Tuple[int, int]:
+        seg = os.path.join(self.dir, segment_name(self.rank))
+        fd = os.open(seg, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            total = 0
+            for key, fields, payload, _fp in pending:
+                header = {"v": 1, "gen": gen, "epoch": epoch, "key": key}
+                header.update(fields)
+                encoded = encode_record(header, payload)
+                # Split write around the injection site: a ``kill`` rule
+                # fires with the frame prefix already on disk — a genuinely
+                # torn record, which is exactly what the chaos drills need
+                # to prove replay truncates instead of trusting the tail.
+                os.write(fd, encoded[:8])
+                rest = faultinject.mutate("journal.append", encoded[8:])
+                os.write(fd, rest)
+                total += len(payload)
+                telemetry.counter_add("journal_appends", 1)
+                telemetry.counter_add("journal_bytes", len(payload))
+            os.fsync(fd)
+            end = os.lseek(fd, 0, os.SEEK_END)
+        finally:
+            os.close(fd)
+        return end, len(pending)
+
+
+# -------------------------------------------------------------------- replay
+
+
+def maybe_replay(
+    path: str,
+    app_state: AppState,
+    *,
+    pg_wrapper: Any = None,
+    base_ok: bool = True,
+) -> Dict[str, Any]:
+    """Fold committed journal epochs onto a just-restored ``app_state``.
+
+    Called at a fixed point of the restore path on every rank. Never raises:
+    any inconsistency (corrupt record, missing segment, a peer rank's base
+    restore failure) logs a warning and leaves the base state untouched —
+    the bounded fallback. Verify-then-apply: all records are parsed and
+    CRC-checked before any state mutates, and a cross-rank verdict gather
+    ensures either every rank replays or none does.
+
+    Returns {"applied", "epochs", "records", "truncated_bytes"}.
+    """
+    out = {"applied": False, "epochs": 0, "records": 0, "truncated_bytes": 0}
+    local_dir = local_fs_root(path)
+    if local_dir is None:
+        return out
+    jdir = os.path.join(local_dir, JOURNAL_DIRNAME)
+    # Shared-filesystem contract: the directory's presence — and the epoch
+    # metadata below — is identical on every rank, so these early returns
+    # are collectively consistent and need no gather.
+    if not os.path.isdir(jdir):
+        return out
+    metas = read_epoch_metas(jdir)
+    committed = committed_epochs(metas)
+    if not committed:
+        return out
+    rank = pg_wrapper.get_rank() if pg_wrapper is not None else 0
+    world = pg_wrapper.get_world_size() if pg_wrapper is not None else 1
+    meta_world = committed[-1].get("world_size")
+    if meta_world != world:
+        logger.warning(
+            "journal at %s was written by world size %s; restoring with %s — "
+            "skipping replay",
+            jdir,
+            meta_world,
+            world,
+        )
+        return out
+
+    updates, error, tail = collect_rank_updates(jdir, rank, committed)
+    ok = base_ok and error is None
+    if world > 1:
+        verdicts = pg_wrapper.all_gather_object(ok)
+        all_ok = all(verdicts)
+    else:
+        all_ok = ok
+
+    # Torn-tail hygiene: bytes past the committed offset are uncommitted by
+    # definition, so truncating them is always safe — but only when this
+    # rank's committed region parsed clean (a corrupt segment is left
+    # untouched as evidence for fsck).
+    if error is None and tail > 0:
+        seg = os.path.join(jdir, segment_name(rank))
+        try:
+            limit = int(committed[-1]["offsets"][str(rank)])
+            os.truncate(seg, limit)
+            telemetry.counter_add("journal_truncations", 1)
+            out["truncated_bytes"] = tail
+            logger.warning(
+                "journal: truncated %d torn/uncommitted tail byte(s) from %s",
+                tail,
+                seg,
+            )
+        except OSError:
+            pass
+
+    if not all_ok:
+        logger.warning(
+            "journal replay skipped at %s (local: %s); state falls back to "
+            "the base snapshot",
+            jdir,
+            error or ("base restore failed" if not base_ok else "peer rank failed"),
+        )
+        return out
+
+    if updates:
+        _apply_updates(app_state, updates)
+    out["applied"] = True
+    out["epochs"] = len(committed)
+    out["records"] = len(updates)
+    telemetry.counter_add("journal_replays", 1)
+    flightrec.record(
+        "journal.replay",
+        gen=committed[-1].get("gen"),
+        epochs=len(committed),
+        records=len(updates),
+        truncated=out["truncated_bytes"],
+    )
+    return out
+
+
+def _apply_updates(
+    app_state: AppState, updates: Dict[str, Tuple[Dict[str, Any], memoryview]]
+) -> None:
+    for key, stateful in app_state.items():
+        prefix = key + "/"
+        mine = {
+            k: v for k, v in updates.items() if k == key or k.startswith(prefix)
+        }
+        if not mine:
+            continue
+        manifest, flattened = flatten(stateful.state_dict(), prefix=key)
+        for flat_key, (header, payload) in mine.items():
+            like = flattened.get(flat_key)
+            flattened[flat_key] = _materialize_leaf(header, payload, like)
+        stateful.load_state_dict(inflate(manifest, flattened, prefix=key))

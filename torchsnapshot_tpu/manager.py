@@ -62,6 +62,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import journal
 from .pg_wrapper import PGWrapper, ProcessGroup
 from .preemption import PreemptionWatcher
 from .snapshot import PendingSnapshot, Snapshot
@@ -139,6 +140,9 @@ class CheckpointManager:
         self._pending: Optional[PendingSnapshot] = None
         self._pending_step: Optional[int] = None
         self._last_committed: Optional[int] = self.latest_step()
+        # Delta journal bound to the last committed base snapshot (armed by
+        # each save when TORCHSNAPSHOT_TPU_JOURNAL=1; see journal_step).
+        self._journal: Optional["journal.DeltaJournal"] = None
 
     # ----------------------------------------------------------- paths
 
@@ -342,6 +346,19 @@ class CheckpointManager:
                 logger.warning(
                     "preemption flagged: emergency snapshot at step %d", step
                 )
+        if emergency and self._journal_emergency_flush(app_state):
+            # A committed journal epoch IS a recoverable state: flushing
+            # the open journal (milliseconds) replaces the synchronous
+            # full emergency save inside the grace window. Collectively
+            # consistent: every guard below is rank-consistent and
+            # append_epoch raises on all ranks or none.
+            self.preemption.consume()
+            logger.warning(
+                "preemption flagged: journal epoch flushed at step %d "
+                "(emergency full save skipped)",
+                step,
+            )
+            return False
         if not force and not emergency and not self.should_save(step):
             return False
         self.wait()  # at most one pending; also runs its retention
@@ -419,6 +436,11 @@ class CheckpointManager:
         else:
             Snapshot.take(path, app_state, **kwargs)
             self._committed(step)
+        # Arm the delta journal against the state AS SAVED — capturing
+        # lazily at the first journal_step would silently lose any
+        # mutation between here and there. For async saves the journal
+        # stays un-bound (journal_step checks) until wait() commits.
+        self._journal_seed(step, app_state)
         if emergency:
             self.preemption.consume()
             logger.warning("emergency snapshot committed at step %d", step)
@@ -518,6 +540,93 @@ class CheckpointManager:
         self._last_committed = step
         self._apply_retention()
 
+    # --------------------------------------------------- delta journal
+
+    def _journal_seed(self, step: int, app_state: AppState) -> None:
+        """Bind a fresh journal to the snapshot of ``step`` and fingerprint
+        the state as saved (TORCHSNAPSHOT_TPU_JOURNAL=1 only)."""
+        self._journal = None
+        if not journal.enabled_by_env():
+            return
+        from .storage_plugin import local_fs_root
+
+        local = local_fs_root(self.path_for(step))
+        if local is None:
+            logger.warning(
+                "delta journaling needs a shared local filesystem root; "
+                "%s is remote — journaling disabled",
+                self.root,
+            )
+            return
+        j = journal.DeltaJournal(
+            local, base_step=step, rank=PGWrapper(self.pg).get_rank()
+        )
+        j.capture_baseline(app_state)
+        self._journal = j
+
+    def _journal_ready(self) -> bool:
+        return (
+            self._journal is not None
+            and self._journal.armed
+            and self._pending is None
+            and self._journal.base_step == self._last_committed
+        )
+
+    def journal_step(self, step: int, app_state: AppState) -> bool:
+        """Append a delta journal epoch for the leaves that changed since
+        the last committed state (base snapshot or previous epoch).
+
+        Call between cadence saves; sub-second where a full save is
+        minutes. Returns True when state became durable at this step —
+        an epoch committed, or a journal bound converted the call into a
+        forced full save. Returns False when journaling is disabled, a
+        base snapshot has not committed yet (async in flight included),
+        or the root is remote. Collective, like ``save``.
+        """
+        if not journal.enabled_by_env() or not self._journal_ready():
+            return False
+        pg = PGWrapper(self.pg)
+        try:
+            n = self._journal.append_epoch(app_state, pg_wrapper=pg)
+        except journal.JournalLimitError as e:
+            logger.info(
+                "journal bound reached (%s); taking a full snapshot at "
+                "step %d instead",
+                e,
+                step,
+            )
+            return self.save(step, app_state, force=True)
+        finally:
+            pg.retire()
+        logger.debug(
+            "journal epoch %d committed (%d record(s)) at step %d",
+            self._journal.epoch,
+            n,
+            step,
+        )
+        return True
+
+    def _journal_emergency_flush(self, app_state: AppState) -> bool:
+        """On preemption, flush the open journal as one final epoch instead
+        of a synchronous full save. Falls back (returns False) when the
+        journal is not armed or the flush fails — the caller then takes
+        the full emergency save as before."""
+        if not self._journal_ready():
+            return False
+        pg = PGWrapper(self.pg)
+        try:
+            self._journal.append_epoch(app_state, pg_wrapper=pg)
+            return True
+        except Exception as e:
+            logger.warning(
+                "preemption journal flush failed (%s); falling back to a "
+                "full emergency save",
+                e,
+            )
+            return False
+        finally:
+            pg.retire()
+
     # ------------------------------------------------------- retention
 
     def _keep_names(self, names: List[str]) -> set:
@@ -592,4 +701,22 @@ class CheckpointManager:
         # chain against the restored step. Deliberately NOT _committed():
         # restoring must not trigger a retention pass.
         self._last_committed = step
+        # Re-arm the journal on the restored state (base + replay): new
+        # epochs chain after the committed ones, so a resumed run keeps
+        # journaling without waiting for the next full save. Records hold
+        # full leaf values, so epochs appended against the replayed state
+        # replay correctly on top of the same chain.
+        if journal.enabled_by_env():
+            from .storage_plugin import local_fs_root
+
+            local = local_fs_root(self.path_for(step))
+            if local is not None:
+                j = journal.DeltaJournal(
+                    local, base_step=step, rank=PGWrapper(self.pg).get_rank()
+                )
+                j.epoch = len(
+                    journal.committed_epochs(journal.read_epoch_metas(j.dir))
+                )
+                j.capture_baseline(app_state)
+                self._journal = j
         return step

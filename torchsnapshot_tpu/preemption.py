@@ -41,6 +41,12 @@ process is about to die — an async save's background commit could be
 killed mid-write; the metadata-last protocol makes that safe but the
 work would be lost), then marks the watcher consumed so the loop's
 remaining ``save()`` calls don't re-save every step of the grace window.
+With the delta journal armed (journal.py, ``TORCHSNAPSHOT_TPU_JOURNAL``)
+the emergency is cheaper still: instead of a synchronous full save, the
+manager flushes-and-fsyncs one fenced journal epoch against the last
+committed base — seconds of grace window buy a few changed chunks, not
+a whole snapshot — and falls back to the full emergency save only if
+the flush fails.
 
 No reference analogue (torchsnapshot has no preemption story); the
 ecosystem analogue is orbax's preemption checkpointing, which piggybacks

@@ -1089,6 +1089,19 @@ class Snapshot:
                     coop_plan.abort_incomplete()
                 pg_wrapper.barrier()
             timer.mark("load")
+            # Delta-journal replay: fold committed journal epochs onto the
+            # just-restored base (journal.py). Fixed symmetric point —
+            # every rank reaches it (per-key failures are captured, the
+            # loop always completes), so its cross-rank verdict gather
+            # cannot desync; a rank whose base restore failed participates
+            # with base_ok=False and every rank falls back together.
+            # Never raises.
+            from . import journal as _journal
+
+            _journal.maybe_replay(
+                self.path, app_state, pg_wrapper=pg_wrapper,
+                base_ok=exc is None,
+            )
             # BEFORE the raise: every rank reaches this point (per-key
             # failures are captured, the loop always completes), so the
             # unconditional telemetry gather stays symmetric even when

@@ -39,6 +39,12 @@ _SUMMED_COUNTERS = (
     "lease_renewals",
     "fanout_fallbacks",
     "mirror_failovers",
+    # Delta journal (journal.py): epoch appends and restore-side replay,
+    # plus torn-tail truncations — the RPO story in one summary row.
+    "journal_appends",
+    "journal_bytes",
+    "journal_replays",
+    "journal_truncations",
 )
 
 

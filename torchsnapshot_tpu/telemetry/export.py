@@ -216,6 +216,22 @@ def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
             lines.append(
                 f"lease:       {agg['lease_renewals']:.0f} renewal round(s)"
             )
+        # Delta-journal column: appended epochs on the write side, replays
+        # and torn-tail truncations on the restore side (journal.py).
+        journal_bits = []
+        if agg.get("journal_appends"):
+            journal_bits.append(
+                f"{agg['journal_appends']:.0f} append(s) "
+                f"({_fmt_bytes(agg.get('journal_bytes', 0))})"
+            )
+        if agg.get("journal_replays"):
+            journal_bits.append(f"{agg['journal_replays']:.0f} replay(s)")
+        if agg.get("journal_truncations"):
+            journal_bits.append(
+                f"{agg['journal_truncations']:.0f} torn tail(s) truncated"
+            )
+        if journal_bits:
+            lines.append(f"journal:     {', '.join(journal_bits)}")
     for summary in ranks:
         lines.append("")
         lines.append(

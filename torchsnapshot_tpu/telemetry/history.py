@@ -48,6 +48,10 @@ _RECORD_COUNTERS = (
     "lease_renewals",
     "fanout_fallbacks",
     "mirror_failovers",
+    "journal_appends",
+    "journal_bytes",
+    "journal_replays",
+    "journal_truncations",
 )
 
 
@@ -283,6 +287,12 @@ def render_trend(
             extras.append(f"{rec['fanout_fallbacks']:.0f} fanout fallback(s)")
         if rec.get("mirror_failovers"):
             extras.append(f"{rec['mirror_failovers']:.0f} mirror failover(s)")
+        if rec.get("journal_replays"):
+            extras.append(f"{rec['journal_replays']:.0f} journal replay(s)")
+        if rec.get("journal_truncations"):
+            extras.append(
+                f"{rec['journal_truncations']:.0f} torn journal tail(s)"
+            )
         if rec.get("binding"):
             extras.append(f"bound: {rec['binding']}")
         lines.append(

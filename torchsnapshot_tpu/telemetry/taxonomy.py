@@ -66,6 +66,12 @@ EVENTS: Dict[str, str] = {
     # stall forensics (forensics.py)
     "forensic.dump": "the hang watchdog dumped thread stacks (rank, "
     "trigger, reason) — self-triggered or remote-requested",
+    # delta journal (journal.py)
+    "journal.open": "rank 0 planted a journal epoch fence (gen, epoch)",
+    "journal.commit": "a journal epoch committed — metadata published, "
+    "fence cleared (gen, epoch, records)",
+    "journal.replay": "committed journal epochs replayed onto a restored "
+    "base (gen, epochs, records, truncated)",
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
