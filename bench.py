@@ -556,6 +556,73 @@ def _journal_leg(timeout_s: float = 420.0):
     return compact
 
 
+def _distrib_leg(timeout_s: float = 420.0):
+    """Fleet-distribution leg (ISSUE 16), persisted to BENCH_r13.json
+    and embedded in the main record: benchmarks/fleet_restore.py runs
+    the emulated world-64 rollout on throttled storage — 64 independent
+    replica restores with the seeding tier on vs the 64x direct baseline
+    (the script asserts storage-read amplification <= 1.2x and scaling
+    past the BENCH_r09 w4 cooperative restore itself), the concurrent
+    chunk-wave fan-out depth measurement, and the journal-delta rolling
+    update (asserts pushed bytes <= 1.5x committed epoch bytes). Runs in
+    its own process group with a hard timeout; failures degrade to an
+    absent key, never a dead bench."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _log(f"running fleet-distribution leg ({timeout_s:.0f}s budget) ...")
+    r = _run_in_own_group(
+        [sys.executable, os.path.join(here, "benchmarks", "fleet_restore.py")],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"fleet-distribution leg rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    records = _json_records(r.stdout)
+    summary = records.get("fleet_restore/summary")
+    if summary is None:
+        _log("fleet-distribution leg produced no summary; omitting")
+        return None
+    legs = [
+        rec
+        for name, rec in records.items()
+        if name.startswith("fleet_restore/") and name != "fleet_restore/summary"
+    ]
+    out = os.path.join(here, "BENCH_r13.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "metric": "fleet_distribution",
+                "unit": "storage-read amplification (x payload) / GB/s "
+                "aggregate / bytes per replica per rolling update",
+                "summary": summary,
+                "legs": legs,
+                "platform": "cpu",
+                "env": {
+                    "JAX_PLATFORMS": "cpu",
+                    "TORCHSNAPSHOT_TPU_SEED_RESTORE": "always",
+                    "TORCHSNAPSHOT_TPU_JOURNAL": "1",
+                },
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    _log(
+        f"fleet-distribution leg ok: amplification "
+        f"{summary.get('direct_fleet_amplification')}x -> "
+        f"{summary.get('seeded_amplification')}x at fleet "
+        f"{summary.get('fleet')}, tree depth "
+        f"{summary.get('max_tree_depth')}, push amplification "
+        f"{summary.get('push_amplification')}x; written to {out}"
+    )
+    compact = dict(summary)
+    compact.pop("benchmark", None)
+    return compact
+
+
 def _native_io_leg(tmp: str, app_state, state, nbytes: int):
     """Side-by-side native-engine vs Python-path legs (ISSUE 9),
     persisted to BENCH_r10.json and embedded in the main record.
@@ -1015,6 +1082,12 @@ def main() -> None:
     journal_leg = _journal_leg()
     if journal_leg is not None:
         record["journal"] = journal_leg
+    # Fleet-distribution side-leg (BENCH_r13.json): emulated world-64
+    # seeded rollout vs the 64x direct baseline, fan-out depth, and the
+    # journal-delta rolling update.
+    distrib_leg = _distrib_leg()
+    if distrib_leg is not None:
+        record["fleet_distribution"] = distrib_leg
     print(json.dumps(record), flush=True)
 
 

@@ -955,6 +955,138 @@ def test_chaos_journal_preemption_sigterm_flushes_epoch(tmp_path, monkeypatch):
     assert run_fsck(str(tmp_path / "step_0000000000"))[0] == 0
 
 
+# ------------------------------------------- fleet-distribution schedules
+#
+# The ISSUE 16 seeding drills: a fleet of INDEPENDENT replica restores
+# (world-1 process groups over a shared registry store) under peer
+# faults. The invariant is the seeding tier's degradation contract:
+# every replica restore stays committed-bit-exact — a dead or corrupting
+# seeder costs a re-parent and ultimately a direct storage read
+# (fanout_fallbacks), never a hang, never poisoned state.
+
+
+def _seed_fleet_worker(rank: int, world_size: int, root: str, drill: str):
+    """One replica of the serving fleet. Rank 0 restores first and arms
+    the fault (it is the depth-0 seeder every later fetch elects first);
+    rank 1 restores next (the rank that OBSERVES the fault directly);
+    ranks 2+ restore last, sourcing from whatever survived."""
+    import time as _time
+
+    from torchsnapshot_tpu import distrib, telemetry
+    from torchsnapshot_tpu import faultinject as fi
+    from torchsnapshot_tpu.pg_wrapper import ProcessGroup, get_default_pg
+
+    os.environ["TORCHSNAPSHOT_TPU_SEED_RESTORE"] = "always"
+    telemetry.set_enabled(True)
+    store = get_default_pg().store
+    distrib.configure_registry(store.clone)
+    snap = os.path.join(root, "base")
+    expected = _state(7)
+
+    def _restore():
+        dst = _zeros_like(expected)
+        # A world-1 group: each replica restores INDEPENDENTLY — the
+        # fleet overlaps in time but never in a collective.
+        Snapshot(snap, pg=ProcessGroup(None, 0, 1)).restore(dst)
+        return _equal(dst, expected)
+
+    if rank == 0:
+        ok = _restore()  # seeds every shareable chunk at depth 0
+        if drill == "kill":
+            # Die mid-chunk-transfer on the FIRST serve: the fetcher sees
+            # the connection drop, re-parents, and falls back direct.
+            fi.configure("distrib.seed_xfer@1=kill")
+        else:
+            # Corrupt EVERY serve: each fetch from this replica fails the
+            # receiver's content-address re-hash and is rejected.
+            fi.configure("distrib.seed_xfer@1+=corrupt")
+        store.set("seed_ready", b"1")
+        if drill == "kill":
+            try:  # killed by the fault when rank 1's fetch arrives
+                store.get("__never_set__", timeout=90.0)
+            except Exception:  # noqa: BLE001 - pragma: no cover
+                pass
+            return "should-be-dead"  # pragma: no cover
+        # Corrupt drill: keep serving (corruptly) until the fleet is done.
+        deadline = _time.monotonic() + 90.0
+        while store.add("seed_fleet_done", 0) < world_size - 1:
+            if _time.monotonic() > deadline:
+                raise TimeoutError("fleet never finished restoring")
+            _time.sleep(0.05)
+        fi.disable()
+        counters = telemetry.counters()
+    else:
+        store.get("seed_ready", timeout=60.0)
+        if rank > 1:
+            # Restore AFTER rank 1 so a clean survivor seeder exists.
+            store.get("seed_r1_done", timeout=90.0)
+        ok = _restore()
+        if rank == 1:
+            store.set("seed_r1_done", b"1")
+            if drill == "kill":
+                # Rank 0 is dead by now (its kill fired on OUR fetch);
+                # cover its exit-barrier share so survivors don't stall.
+                store.add("__exit__/count", 1)
+        counters = telemetry.counters()
+        store.add("seed_fleet_done", 1)
+    return {
+        "bit_exact": ok,
+        "fallbacks": counters.get("fanout_fallbacks", 0),
+        "seeded_bytes": counters.get("bytes_from_seeders", 0),
+    }
+
+
+def test_chaos_seed_peer_sigkill_mid_transfer(tmp_path):
+    """SIGKILL the depth-0 seeding peer mid-chunk-transfer at w4: the
+    fetcher whose transfer died re-parents, finds no live seeder, and
+    falls back to a direct storage read; later replicas seed from the
+    survivor. Every surviving replica restores committed-bit-exact."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    Snapshot.take(str(tmp_path / "base"), _state(7), replicated=["**"])
+    # The registry must outlive rank 0 (the default store host), so the
+    # leader runs in a dedicated external process.
+    results = run_with_subprocesses(
+        _seed_fleet_worker, 4, str(tmp_path), "kill",
+        timeout=240.0, expect_dead=(0,), external_store=True,
+    )
+    assert 0 not in results, results  # the kill actually landed
+    assert set(results) == {1, 2, 3}, results
+    for rank, out in results.items():
+        assert out["bit_exact"], (rank, out)
+    # Rank 1's transfer died underneath it: re-parent found nobody, the
+    # chunk degraded to a direct read — counted, never a hang.
+    assert results[1]["fallbacks"] >= 1, results[1]
+    # Later replicas sourced from the surviving seeder, not storage.
+    for rank in (2, 3):
+        assert results[rank]["seeded_bytes"] > 0, (rank, results[rank])
+
+
+def test_chaos_seed_corrupt_chunk_rejected_and_reread(tmp_path):
+    """A corrupting seeder at w4: every chunk it serves fails the
+    receiver's content-address re-hash and is rejected like a CRC
+    failure. The first fetcher re-reads direct from storage (and becomes
+    a clean seeder); later replicas re-parent past the corruptor to the
+    clean copy. No replica ever applies poisoned bytes."""
+    from torchsnapshot_tpu.test_utils import run_with_subprocesses
+
+    Snapshot.take(str(tmp_path / "base"), _state(7), replicated=["**"])
+    results = run_with_subprocesses(
+        _seed_fleet_worker, 4, str(tmp_path), "corrupt", timeout=240.0,
+    )
+    assert set(results) == {0, 1, 2, 3}, results
+    for rank, out in results.items():
+        assert out["bit_exact"], (rank, out)
+    # Rank 1 had only the corruptor to fetch from: every unit rejected,
+    # every unit re-read direct.
+    assert results[1]["fallbacks"] >= 1, results[1]
+    assert results[1]["seeded_bytes"] == 0, results[1]
+    # Ranks 2-3 elected the corruptor first (lowest registration seq),
+    # rejected its bytes, and re-parented to rank 1's clean copy.
+    for rank in (2, 3):
+        assert results[rank]["seeded_bytes"] > 0, (rank, results[rank])
+
+
 def test_matrix_is_large_enough():
     """The acceptance floor: >= 30 deterministic schedules across
     backends and world sizes (kills and w2 drills included)."""
@@ -972,5 +1104,7 @@ def test_matrix_is_large_enough():
         + 2  # store-host SIGKILL: failover commit + no-replica bounded
         + 3  # delta-journal: w2 SIGKILL mid-append, corrupt record,
         #      preemption-SIGTERM epoch flush (ISSUE 14)
+        + 2  # fleet distribution: seed-peer SIGKILL mid-transfer +
+        #      corrupt seeded chunk rejected (ISSUE 16)
     )
     assert n >= 30, n

@@ -80,6 +80,9 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_PROGRESS_S",
     "TORCHSNAPSHOT_TPU_RESHARD",
     "TORCHSNAPSHOT_TPU_RESHARD_MIN_REQUESTERS",
+    "TORCHSNAPSHOT_TPU_SEED_FANOUT",
+    "TORCHSNAPSHOT_TPU_SEED_RESTORE",
+    "TORCHSNAPSHOT_TPU_SEED_TTL_S",
     "TORCHSNAPSHOT_TPU_STAGING_POOL_BYTES",
     "TORCHSNAPSHOT_TPU_STORE_ADDR",
     "TORCHSNAPSHOT_TPU_STORE_CONNECT_RETRIES",
@@ -94,6 +97,7 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_TELEMETRY",
     "TORCHSNAPSHOT_TPU_TELEMETRY_MAX_EVENTS",
     "TORCHSNAPSHOT_TPU_TREND_THRESHOLD",
+    "TORCHSNAPSHOT_TPU_UPDATE_PUSH",
     "TORCHSNAPSHOT_TPU_VERIFY",
 })
 

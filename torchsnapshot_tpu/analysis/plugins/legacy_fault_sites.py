@@ -59,14 +59,22 @@ PINNED_SITE_FILES = {
     # while the sites sit on journal.py's record framing boundaries.
     "journal.append": "journal.py",
     "journal.replay": "journal.py",
+    # The fleet-distribution sites (ISSUE 16) are pinned to distrib.py:
+    # the chaos drills SIGKILL/corrupt "the chunk as it leaves the
+    # seeding peer" and corrupt "the epoch blob as it leaves the
+    # pusher", which is only that while the sites sit on distrib.py's
+    # serve/push boundaries.
+    "distrib.seed_xfer": "distrib.py",
+    "distrib.epoch_push": "distrib.py",
 }
 
 # Regression floor: the registry started at 15 sites (ISSUE 5), grew
 # the replication/lease sites (ISSUE 6), the native-engine sites
-# (ISSUE 9), the planned-reshard bundle site (ISSUE 12), and the
-# delta-journal sites (ISSUE 14). Shrinking it means a drill surface
-# was silently unthreaded.
-MIN_SITES = 23
+# (ISSUE 9), the planned-reshard bundle site (ISSUE 12), the
+# delta-journal sites (ISSUE 14), and the fleet-distribution sites
+# (ISSUE 16). Shrinking it means a drill surface was silently
+# unthreaded.
+MIN_SITES = 25
 
 
 def check_source(

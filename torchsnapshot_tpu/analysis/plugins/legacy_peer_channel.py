@@ -30,8 +30,10 @@ PKG = PACKAGE_DIR
 # device-free by the same invariant), and the planned-reshard tier
 # (reshard.py) — its consumers run on the same background restore
 # threads and its planner must stay runnable device-free (CLI dry-run,
-# 50k-shard benchmarks).
-PEER_PLANE_FILES = ("fanout.py", "dist_store.py", "reshard.py")
+# 50k-shard benchmarks). The fleet-distribution tier (distrib.py) serves
+# chunks and applies epoch pushes from listener threads — same invariant
+# (its journal materialization imports are lazy, at the apply sites).
+PEER_PLANE_FILES = ("fanout.py", "dist_store.py", "reshard.py", "distrib.py")
 
 
 def check_source(source: str, filename: str) -> list:

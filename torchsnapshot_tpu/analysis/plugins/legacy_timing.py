@@ -53,6 +53,7 @@ BENCHMARK_ALLOWLIST = {
     "dist_verify.py",
     "dma_overlap.py",
     "embedding_save.py",
+    "fleet_restore.py",  # direct vs seeded fleet restore walls time wall clock
     "manifest_scale.py",
     "journal_rpo.py",  # epoch-append vs full-save walls time wall clock
     "reshard_throughput.py",  # planned vs direct restore walls time wall clock

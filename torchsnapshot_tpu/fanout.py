@@ -60,6 +60,7 @@ construction — ``scripts/check_peer_channel.py`` lints exactly that.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import os
 import threading
@@ -167,6 +168,33 @@ def unit_key(read_req: Any) -> Optional[str]:
         return None  # zero-length: nothing to move
     lo, hi = (br[0], br[1]) if br is not None else (-1, -1)
     return f"{read_req.origin or ''}|{path}|{lo}|{hi}"
+
+
+def content_address(buf: Any) -> str:
+    """A chunk's content address in the ``device_digest`` fingerprint
+    namespace: ``sha256:<hex>`` over the chunk's actual bytes. This is
+    the fleet-distribution transfer key (distrib.py) AND its end-to-end
+    integrity check — a seeded-chunk receiver re-hashes what it got and
+    rejects a mismatch like a CRC failure, so no peer is ever trusted."""
+    return "sha256:" + hashlib.sha256(memoryview(buf).cast("B")).hexdigest()
+
+
+def content_unit_id(
+    scope: str, path: str, byte_range: Optional[Tuple[int, int]]
+) -> Optional[str]:
+    """Content-addressed unit id for a shareable buffered read, or None
+    when the location can never be shared (same ``_SHARED_PREFIXES``
+    rule as :func:`unit_key`). Hashes ``scope|path|lo|hi`` into the same
+    ``sha256:`` namespace the chunk bytes use — ``scope`` is the
+    snapshot identity (its path), so byte-identical requests against
+    DIFFERENT snapshots can never collide in the fleet seed catalog."""
+    if not path.startswith(_SHARED_PREFIXES):
+        return None
+    if byte_range is not None and byte_range[1] <= byte_range[0]:
+        return None  # zero-length: nothing to seed
+    lo, hi = byte_range if byte_range is not None else (-1, -1)
+    raw = f"{scope}|{path}|{lo}|{hi}".encode("utf-8")
+    return "sha256:" + hashlib.sha256(raw).hexdigest()
 
 
 def _unit_nbytes(read_req: Any) -> int:

@@ -113,6 +113,13 @@ SITES: Dict[str, str] = {
     # whose CRCs were computed first — replay must detect all three.
     "journal.append": "data",
     "journal.replay": "data",  # payload just read, before CRC verify
+    # fleet distribution tier (distrib.py): the seeded chunk as it
+    # leaves the serving peer (corrupt is caught by the receiver's
+    # content-address re-hash, kill is the mid-transfer seeder death
+    # drill) and the epoch blob as it leaves the rolling-update pusher
+    # (corrupt is caught by the receiver's record CRCs).
+    "distrib.seed_xfer": "data",
+    "distrib.epoch_push": "data",
 }
 
 KNOWN_SITES = frozenset(SITES)

@@ -191,7 +191,18 @@ def scan_segment(
         return [], f"unreadable segment: {e}"
     if limit is not None and len(data) < limit:
         return [], f"segment shorter than committed offset ({len(data)} < {limit})"
-    buf = memoryview(data)
+    return decode_records(memoryview(data))
+
+
+def decode_records(
+    buf: memoryview,
+) -> Tuple[List[Tuple[Dict[str, Any], memoryview]], Optional[str]]:
+    """Parse TSJR records from an in-memory buffer — the segment scan
+    above and the rolling-update receive path (distrib.py ships epoch
+    record regions verbatim, so a pushed blob parses with the same
+    frames, the same CRCs, and the same fault-detection semantics as a
+    local replay). Returns (records, error); on a non-None error the
+    caller must apply NOTHING — verify-then-apply."""
     records: List[Tuple[Dict[str, Any], memoryview]] = []
     off = 0
     while off < len(buf):
@@ -282,6 +293,44 @@ def collect_rank_updates(
     except OSError:
         tail = 0
     return updates, None, tail
+
+
+def read_epoch_blob(
+    jdir: str, committed: List[Dict[str, Any]], epoch: int
+) -> bytes:
+    """One committed epoch's record bytes across all ranks, read
+    VERBATIM from the segments — the rolling-update push payload
+    (distrib.push_committed_epochs). Epoch e's region for rank r is
+    ``segment[prev_meta.offsets[r] : meta_e.offsets[r]]`` (0 for epoch
+    1); no re-encode, so the receiver verifies the exact CRCs the
+    appenders wrote. Raises ValueError when the epoch is not in the
+    committed prefix or a segment is shorter than its committed offset."""
+    idx = next(
+        (i for i, m in enumerate(committed) if m.get("epoch") == epoch), None
+    )
+    if idx is None:
+        raise ValueError(f"epoch {epoch} is not committed")
+    offsets = committed[idx].get("offsets", {})
+    prev_offsets = committed[idx - 1].get("offsets", {}) if idx else {}
+    parts: List[bytes] = []
+    for rank_key in sorted(offsets, key=int):
+        end = int(offsets[rank_key])
+        start = int(prev_offsets.get(rank_key, 0))
+        if end <= start:
+            continue
+        seg = os.path.join(jdir, segment_name(int(rank_key)))
+        try:
+            with open(seg, "rb") as f:
+                f.seek(start)
+                part = f.read(end - start)
+        except OSError as e:
+            raise ValueError(f"unreadable segment for rank {rank_key}: {e}")
+        if len(part) != end - start:
+            raise ValueError(
+                f"segment for rank {rank_key} shorter than committed offset"
+            )
+        parts.append(part)
+    return b"".join(parts)
 
 
 def _write_json_atomic(path: str, obj: Dict[str, Any]) -> None:

@@ -143,6 +143,12 @@ class CheckpointManager:
         # Delta journal bound to the last committed base snapshot (armed by
         # each save when TORCHSNAPSHOT_TPU_JOURNAL=1; see journal_step).
         self._journal: Optional["journal.DeltaJournal"] = None
+        # Rolling-update push cursor (distrib.py): per live replica, the
+        # last journal epoch already shipped — keeps repeat pushes
+        # incremental. Receivers dedup regardless, so losing this only
+        # costs bytes, never correctness. Reset with each journal seed
+        # (a new base step invalidates old epochs).
+        self._push_cursor: Dict[str, int] = {}
 
     # ----------------------------------------------------------- paths
 
@@ -563,6 +569,7 @@ class CheckpointManager:
         )
         j.capture_baseline(app_state)
         self._journal = j
+        self._push_cursor = {}
 
     def _journal_ready(self) -> bool:
         return (
@@ -604,7 +611,53 @@ class CheckpointManager:
             n,
             step,
         )
+        from . import distrib
+
+        if distrib.update_push_enabled():
+            try:
+                self.push_update()
+            except Exception:
+                # The push is best-effort by contract; durability was
+                # decided by the epoch commit above.
+                logger.warning("rolling-update push failed", exc_info=True)
         return True
+
+    def push_update(self) -> Dict[str, Any]:
+        """Ship committed journal epochs to live replicas registered as
+        holding the current base step (distrib.UpdateReceiver) — a
+        rolling update that moves ≈ the committed dirty set instead of
+        the full snapshot. Incremental across calls (per-replica epoch
+        cursor); receivers apply each (gen, epoch) exactly once, so
+        retries and overlapping pushers are safe. Best-effort: a replica
+        that misses a push converges through its next restore's replay.
+
+        Returns ``{"replicas", "epochs", "bytes", "nacks"}`` (all zero
+        when the journal is unarmed or no registry store is reachable).
+        Runs with ``TORCHSNAPSHOT_TPU_UPDATE_PUSH=1`` after every
+        ``journal_step`` automatically; callable any time regardless.
+        """
+        from . import distrib
+
+        empty = {"replicas": 0, "epochs": 0, "bytes": 0, "nacks": 0}
+        j = self._journal
+        if j is None or not j.armed:
+            return empty
+        pg = PGWrapper(self.pg)
+        try:
+            store = distrib._registry_store(pg)
+        finally:
+            pg.retire()
+        if store is None:
+            return empty
+        try:
+            return distrib.push_committed_epochs(
+                j.dir, j.base_step, store, cursor=self._push_cursor
+            )
+        finally:
+            try:
+                store.close()
+            except Exception:
+                pass
 
     def _journal_emergency_flush(self, app_state: AppState) -> bool:
         """On preemption, flush the open journal as one final epoch instead

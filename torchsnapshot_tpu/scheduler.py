@@ -411,6 +411,20 @@ class IOGovernor:
         bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
         return bps is not None and bps < _STREAM_READ_LATENCY_BPS
 
+    def should_seed_restore(self, plugin: Optional[str] = None) -> bool:
+        """Economic gate for the fleet seeding tier (distrib.py, under
+        ``TORCHSNAPSHOT_TPU_SEED_RESTORE=auto``): sourcing shareable
+        chunks from peers that already hold them beats a direct storage
+        read exactly when storage bandwidth — not the host network — is
+        the bottleneck, the same knee as the coop-restore and planned-
+        reshard elections. Unlike those, this election is PER-REPLICA
+        (every seed miss independently falls back to a direct read), so
+        asymmetric decisions across the fleet are safe — but the
+        evidence rule is identical: no recorded read rate for this
+        backend means no evidence, and direct reads stay."""
+        bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
+        return bps is not None and bps < _STREAM_READ_LATENCY_BPS
+
 
 def preverify_mode() -> str:
     """THE parser for ``TORCHSNAPSHOT_TPU_PREVERIFY`` — every consumer

@@ -848,6 +848,17 @@ class Snapshot:
         storage = url_to_storage_plugin_in_event_loop(
             self.path, event_loop, self._storage_options
         )
+        # Fleet seeding tier (distrib.py, TORCHSNAPSHOT_TPU_SEED_RESTORE):
+        # shareable buffered reads source from peers that already hold the
+        # chunk before touching storage, and chunks this restore obtains
+        # keep seeding later restorers. Default-off is one env check; the
+        # election is per-replica (no collective) because every seed miss
+        # independently falls back to a direct read.
+        from . import distrib as _distrib
+
+        storage, seed_tier = _distrib.maybe_wrap_restore(
+            storage, self.path, pg_wrapper
+        )
         timer = _PhaseTimer("Snapshot.restore")
         recorder = telemetry.begin_op("restore", rank)
         telemetry.flightrec.record(
@@ -1123,6 +1134,14 @@ class Snapshot:
                 self.path, rank, f"restore aborted: {type(e).__name__}"
             )
             recorder.abandon()
+            if seed_tier is not None:
+                try:
+                    # Retract THIS restore's seed registrations: an
+                    # aborted replica must not advertise chunks it may
+                    # be about to throw away.
+                    seed_tier.abort()
+                except Exception:
+                    pass
             raise
         finally:
             if heartbeat is not None:

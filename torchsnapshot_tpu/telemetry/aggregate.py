@@ -45,6 +45,12 @@ _SUMMED_COUNTERS = (
     "journal_bytes",
     "journal_replays",
     "journal_truncations",
+    # Fleet distribution tier (distrib.py): bytes sourced from seeding
+    # peers instead of storage, local chunk-cache hits, and rolling-
+    # update epoch bytes pushed — the seed-vs-storage mix in one row.
+    "bytes_from_seeders",
+    "seed_cache_hits",
+    "epoch_push_bytes",
 )
 
 

@@ -197,6 +197,19 @@ def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
             lines.append(
                 f"peer bytes:  {_fmt_bytes(agg['bytes_to_peers'])} redistributed"
             )
+        # Fleet seeding tier (distrib.py): the seed-vs-storage byte mix
+        # of a fleet restore — ``read`` above is what actually hit
+        # storage, this is what arrived from peers instead.
+        if agg.get("bytes_from_seeders"):
+            lines.append(
+                f"seeded:      {_fmt_bytes(agg['bytes_from_seeders'])} "
+                "from peers"
+                + (
+                    f" ({agg['seed_cache_hits']:.0f} cache hit(s))"
+                    if agg.get("seed_cache_hits")
+                    else ""
+                )
+            )
         if agg.get("retry_attempts"):
             lines.append(f"retries:     {agg['retry_attempts']:.0f} attempts")
         # Degradation counters: zero is the healthy (and silent) case;
@@ -229,6 +242,10 @@ def render_summary_document(doc: Dict[str, Any], verbose: bool = False) -> str:
         if agg.get("journal_truncations"):
             journal_bits.append(
                 f"{agg['journal_truncations']:.0f} torn tail(s) truncated"
+            )
+        if agg.get("epoch_push_bytes"):
+            journal_bits.append(
+                f"{_fmt_bytes(agg['epoch_push_bytes'])} pushed to replicas"
             )
         if journal_bits:
             lines.append(f"journal:     {', '.join(journal_bits)}")

@@ -237,7 +237,7 @@ def read_fleet(store: Any) -> Dict[int, Dict[str, Any]]:
 #: the straggler the watcher exists to flag.
 _PROGRESS_FIELDS = (
     "op", "phase", "staged_bytes", "written_bytes", "read_bytes",
-    "done_entries",
+    "seed_bytes", "done_entries",
 )
 
 
@@ -290,10 +290,14 @@ def render_fleet(
     if not fleet:
         return "no in-flight operation (no heartbeat keys published)"
     lines = []
+    # The ``seed`` column is the seed-vs-storage byte mix of a fleet
+    # restore (distrib.py): ``read`` counts what came from storage,
+    # ``seed`` what arrived from seeding peers — a healthy seeded fleet
+    # shows one replica with a big ``read`` and the rest mostly ``seed``.
     lines.append(
         f"{'rank':>4}  {'op':<8} {'phase':<14} {'staged':>10} {'written':>10} "
-        f"{'read':>10} {'total':>10} {'io':>3} {'eta':>7} {'wall':>8}  "
-        f"{'bound on':<15} status"
+        f"{'read':>10} {'seed':>10} {'total':>10} {'io':>3} {'eta':>7} "
+        f"{'wall':>8}  {'bound on':<15} status"
     )
     walls = []
     for rank in sorted(fleet):
@@ -318,6 +322,7 @@ def render_fleet(
             f"{fmt_bytes(rec.get('staged_bytes')):>10} "
             f"{fmt_bytes(rec.get('written_bytes')):>10} "
             f"{fmt_bytes(rec.get('read_bytes')):>10} "
+            f"{fmt_bytes(rec.get('seed_bytes')):>10} "
             f"{fmt_bytes(rec.get('total_bytes')):>10} "
             f"{rec.get('inflight_io', 0):>3} "
             f"{(str(eta) + 's') if eta is not None else '?':>7} "

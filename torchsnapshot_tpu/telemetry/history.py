@@ -52,6 +52,9 @@ _RECORD_COUNTERS = (
     "journal_bytes",
     "journal_replays",
     "journal_truncations",
+    "bytes_from_seeders",
+    "seed_cache_hits",
+    "epoch_push_bytes",
 )
 
 
@@ -285,6 +288,10 @@ def render_trend(
             extras.append(f"{rec['store_failovers']:.0f} store failover(s)")
         if rec.get("fanout_fallbacks"):
             extras.append(f"{rec['fanout_fallbacks']:.0f} fanout fallback(s)")
+        if rec.get("bytes_from_seeders"):
+            extras.append(f"{fmt_bytes(rec['bytes_from_seeders'])} from seeders")
+        if rec.get("epoch_push_bytes"):
+            extras.append(f"{fmt_bytes(rec['epoch_push_bytes'])} pushed")
         if rec.get("mirror_failovers"):
             extras.append(f"{rec['mirror_failovers']:.0f} mirror failover(s)")
         if rec.get("journal_replays"):

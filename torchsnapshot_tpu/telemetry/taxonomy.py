@@ -72,6 +72,13 @@ EVENTS: Dict[str, str] = {
     "fence cleared (gen, epoch, records)",
     "journal.replay": "committed journal epochs replayed onto a restored "
     "base (gen, epochs, records, truncated)",
+    # fleet distribution tier (distrib.py)
+    "distrib.register": "a chunk this replica now holds was registered "
+    "in the seed catalog (digest, nbytes, depth, holder)",
+    "distrib.fetch": "a chunk arrived from a seeding peer and verified "
+    "its content address (digest, nbytes, parent, depth)",
+    "distrib.push": "one committed journal epoch was pushed to a live "
+    "replica and acked (gen, epoch, nbytes, target, dup)",
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
