@@ -623,6 +623,98 @@ def _distrib_leg(timeout_s: float = 420.0):
     return compact
 
 
+def _tenancy_leg(timeout_s: float = 420.0):
+    """Multi-tenant leg (ISSUE 17), persisted to BENCH_r14.json and
+    embedded in the main record. Two sub-drills: the million-entry
+    columnar manifest plane (benchmarks/manifest_scale.py --columnar:
+    build/encode/decode/plan walls over ~1M shard leaves, asserted
+    < 60 s total) and the admission drill (benchmarks/
+    tenant_admission.py: a priority-1 bulk save contending with a
+    priority-4 restore on one throttled bucket, restore p50 asserted
+    <= 2x solo). Runs in its own process group with a hard timeout;
+    failures degrade to an absent key, never a dead bench."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _log(f"running multi-tenant leg ({timeout_s:.0f}s budget) ...")
+    r = _run_in_own_group(
+        [
+            sys.executable,
+            os.path.join(here, "benchmarks", "manifest_scale.py"),
+            "--columnar",
+        ],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"columnar manifest leg rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    manifest_rec = _json_records(r.stdout).get("manifest_scale_columnar")
+    if manifest_rec is None:
+        _log("columnar manifest leg produced no record; omitting")
+        return None
+    r = _run_in_own_group(
+        [
+            sys.executable,
+            os.path.join(here, "benchmarks", "tenant_admission.py"),
+        ],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"admission drill rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    records = _json_records(r.stdout)
+    admission_summary = records.get("tenant_admission/summary")
+    if admission_summary is None:
+        _log("admission drill produced no summary; omitting")
+        return None
+    legs = [manifest_rec] + [
+        rec
+        for name, rec in records.items()
+        if name.startswith("tenant_admission/")
+        and name != "tenant_admission/summary"
+    ]
+    summary = {
+        "manifest_entries": manifest_rec.get("entries"),
+        "manifest_shard_leaves": manifest_rec.get("shard_leaves"),
+        "manifest_total_s": manifest_rec.get("total_s"),
+        "manifest_compaction_x": manifest_rec.get("compaction_x"),
+        "admission_degradation_x": admission_summary.get("degradation_x"),
+        "no_admission_degradation_x": admission_summary.get(
+            "no_admission_degradation_x"
+        ),
+    }
+    out = os.path.join(here, "BENCH_r14.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "metric": "tenancy",
+                "unit": "seconds for 1M-leaf manifest round-trip / restore "
+                "p50 degradation (x solo) under a contending save",
+                "summary": summary,
+                "legs": legs,
+                "platform": "cpu",
+                "env": {"JAX_PLATFORMS": "cpu"},
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    _log(
+        f"tenancy leg ok: {summary['manifest_shard_leaves']} shard leaves "
+        f"in {summary['manifest_total_s']}s "
+        f"({summary['manifest_compaction_x']}x smaller than JSON), "
+        f"contended restore p50 {summary['admission_degradation_x']}x solo "
+        f"(no admission: {summary['no_admission_degradation_x']}x); "
+        f"written to {out}"
+    )
+    return summary
+
+
 def _native_io_leg(tmp: str, app_state, state, nbytes: int):
     """Side-by-side native-engine vs Python-path legs (ISSUE 9),
     persisted to BENCH_r10.json and embedded in the main record.
@@ -1088,6 +1180,11 @@ def main() -> None:
     distrib_leg = _distrib_leg()
     if distrib_leg is not None:
         record["fleet_distribution"] = distrib_leg
+    # Multi-tenant side-leg (BENCH_r14.json): the 1M-leaf columnar
+    # manifest plane and the priority-weighted admission drill.
+    tenancy_leg = _tenancy_leg()
+    if tenancy_leg is not None:
+        record["tenancy"] = tenancy_leg
     print(json.dumps(record), flush=True)
 
 

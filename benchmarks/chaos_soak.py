@@ -1027,6 +1027,102 @@ def distrib_overhead(trials: int = 5) -> None:
     )
 
 
+def tenancy_overhead(trials: int = 5) -> None:
+    """Disabled-path overhead of the multi-tenant plane (ISSUE 17): a
+    ~2 GiB save with no tenant configured (the shipping default —
+    ``tenancy_admission.maybe_arm`` runs one contextvar read + one env
+    check and returns None; the scheduler's admission getattr misses)
+    vs the arm/disarm hooks bypassed to raw no-op lambdas. Best-vs-best
+    < 1% with the 50 ms floor, same bimodal-host recipe as the legs
+    above. The ENABLED path (namespacing, quota, pacing) is a measured
+    trade-off — see bench.py's tenancy leg / BENCH_r14.json."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict, snapshot
+    from torchsnapshot_tpu.tenancy import TENANT_ENV_VAR
+
+    os.environ.pop(TENANT_ENV_VAR, None)
+
+    nbytes = 2 << 30
+    n_arrays = 8
+    per = nbytes // n_arrays // 4
+    state = {
+        "model": StateDict(
+            **{
+                f"p{i}": np.random.default_rng(i)
+                .standard_normal(per)
+                .astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+    }
+
+    def timed_save() -> float:
+        root = tempfile.mkdtemp(prefix="tenancy_overhead_")
+        try:
+            t0 = time.perf_counter()
+            Snapshot.take(os.path.join(root, "s"), state)
+            return time.perf_counter() - t0
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    def bypassed(fn):
+        # snapshot.py resolves the hooks as module attributes at call
+        # time, so patching them bypasses even the env check — the
+        # honest zero-cost floor.
+        saved_arm = snapshot.tenancy_admission.maybe_arm
+        saved_disarm = snapshot.tenancy_admission.disarm
+        snapshot.tenancy_admission.maybe_arm = (
+            lambda op, storage=None, pg_wrapper=None, tenant=None: None
+        )
+        snapshot.tenancy_admission.disarm = lambda storage, session: None
+        try:
+            return fn()
+        finally:
+            snapshot.tenancy_admission.maybe_arm = saved_arm
+            snapshot.tenancy_admission.disarm = saved_disarm
+
+    timed_save()  # discarded warmup (staging-pool first-touch faults)
+    bypass_walls, shim_walls = [], []
+    max_pairs = 2 * trials
+    for pair in range(max_pairs):
+        if pair % 2 == 0:
+            byp = bypassed(timed_save)
+            shim = timed_save()
+        else:
+            shim = timed_save()
+            byp = bypassed(timed_save)
+        bypass_walls.append(byp)
+        shim_walls.append(shim)
+        budget_s = max(0.01 * min(bypass_walls), 0.05)
+        if pair + 1 >= trials and (
+            min(shim_walls) - min(bypass_walls)
+        ) < budget_s:
+            break
+    bypass_best = min(bypass_walls)
+    shim_best = min(shim_walls)
+    budget_s = max(0.01 * bypass_best, 0.05)
+    delta = (shim_best - bypass_best) / bypass_best
+    report(
+        "tenancy_overhead",
+        {
+            "gib": round(nbytes / (1 << 30), 2),
+            "pairs": len(bypass_walls),
+            "bypass_trials_s": [round(t, 3) for t in bypass_walls],
+            "shim_trials_s": [round(t, 3) for t in shim_walls],
+            "bypass_best_s": round(bypass_best, 3),
+            "shim_best_s": round(shim_best, 3),
+            "overhead_pct": round(delta * 100, 3),
+        },
+        data_bytes=nbytes,
+    )
+    assert (shim_best - bypass_best) < budget_s, (
+        f"disabled-tenancy save overhead {delta * 100:.2f}% over the 1% "
+        f"budget (bypass best {bypass_best:.3f}s vs shipping best "
+        f"{shim_best:.3f}s, floor 50 ms)"
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--soak", action="store_true")
@@ -1049,6 +1145,7 @@ def main() -> None:
         store_overhead(args.trials)
         journal_overhead(args.trials)
         distrib_overhead(args.trials)
+        tenancy_overhead(args.trials)
 
 
 if __name__ == "__main__":

@@ -12,6 +12,14 @@ readers keep working) is ~50x faster on both sides.
 
 Usage: python benchmarks/manifest_scale.py [n_params] [n_ranks]
 Emits one JSON line with all legs.
+
+``--columnar`` runs the million-entry leg instead (ISSUE 17): the
+binary struct-of-arrays TSCM codec (colmanifest.py) over ~1M shard
+leaves — build / encode / decode / restore-plan walls, each bounded.
+JSON at this cardinality is the motivating wall; TSCM must hold the
+whole leg inside 60 s. Usage:
+``python benchmarks/manifest_scale.py --columnar [n_params] [n_ranks]``
+(defaults 20834 x 16 ranks x 3 tensors/param = ~1,000,032 leaves).
 """
 
 from __future__ import annotations
@@ -60,7 +68,66 @@ def build_manifest(n_params: int, n_ranks: int) -> dict:
     return manifest
 
 
+def columnar_main(argv: list) -> int:
+    """Million-entry columnar-manifest leg (ISSUE 17 acceptance)."""
+    n_params = int(argv[0]) if argv else 20834
+    n_ranks = int(argv[1]) if len(argv) > 1 else 16
+
+    from torchsnapshot_tpu import colmanifest
+    from torchsnapshot_tpu.manifest import get_available_entries
+
+    t0 = time.perf_counter()
+    manifest = build_manifest(n_params, n_ranks)
+    t_build = time.perf_counter() - t0
+    n_shards = sum(len(e.shards) for e in manifest.values())
+
+    md = SnapshotMetadata(version="bench", world_size=n_ranks, manifest=manifest)
+    t0 = time.perf_counter()
+    raw = colmanifest.encode_metadata(md)
+    t_encode = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    md2 = colmanifest.decode_metadata(raw)
+    t_decode = time.perf_counter() - t0
+    assert len(md2.manifest) == len(manifest)
+
+    # Restore-plan wall: what every restoring rank does with the parsed
+    # manifest before any byte moves. (The reshard planner leg stays on
+    # the 50k default run — its cost is per-plan-unit geometry, not
+    # manifest-plane serialization, and 1M units is a different study.)
+    t0 = time.perf_counter()
+    avail = get_available_entries(md2.manifest, rank=3)
+    t_plan = time.perf_counter() - t0
+    assert len(avail) == len(manifest)
+
+    total = t_build + t_encode + t_decode + t_plan
+    assert total < 60.0, (
+        f"columnar leg took {total:.1f}s over {n_shards} shard leaves — "
+        "the manifest plane fell onto the commit/restore critical path"
+    )
+
+    json_len = len(md.to_yaml())
+    report(
+        "manifest_scale_columnar",
+        {
+            "entries": len(manifest),
+            "shard_leaves": n_shards,
+            "columnar_mb": round(len(raw) / 1e6, 2),
+            "json_mb": round(json_len / 1e6, 2),
+            "compaction_x": round(json_len / len(raw), 1),
+            "build_s": round(t_build, 3),
+            "encode_s": round(t_encode, 3),
+            "decode_s": round(t_decode, 3),
+            "plan_s": round(t_plan, 3),
+            "total_s": round(total, 3),
+        },
+    )
+    return 0
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--columnar":
+        return columnar_main(sys.argv[2:])
     n_params = int(sys.argv[1]) if len(sys.argv) > 1 else 1050
     n_ranks = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     manifest = build_manifest(n_params, n_ranks)

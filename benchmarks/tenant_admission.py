@@ -1,0 +1,190 @@
+"""Admission-control drill: a bulk save must not starve a restore.
+
+Two tenants share one bandwidth-throttled bucket (every plugin instance
+drains through ONE rate gate — the storage tier's aggregate ceiling).
+Tenant ``batch`` (priority 1) saves in a loop; tenant ``serving``
+(priority 4) restores. Without admission the saver's writes saturate
+the shared gate and the restore's wall degrades toward the contended
+fair-share floor; with admission the saver is paced to its priority
+share at the scheduler's I/O-slot boundary and the restore keeps most
+of the pipe.
+
+Acceptance (ISSUE 17): the contended restore p50 stays <= 2x the solo
+restore p50. An informative no-admission contended leg is also
+reported (not asserted — it documents what admission is buying).
+
+Usage: python benchmarks/tenant_admission.py [mb] [bandwidth_mbps]
+Emits one JSON line per leg plus a summary line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_utils import report  # noqa: E402
+
+REPS = 5
+
+
+class SharedGate:
+    """One serial service queue for ALL storage traffic: each request
+    reserves ``nbytes / bps`` of exclusive pipe time and sleeps until
+    its slot has drained. Thread-safe across event loops (saves and
+    restores run on different scheduler loops)."""
+
+    def __init__(self, bps: float) -> None:
+        self.bps = bps
+        self._lock = threading.Lock()
+        self._free_at = 0.0
+
+    def reserve(self, nbytes: int) -> float:
+        with self._lock:
+            now = time.perf_counter()
+            start = max(now, self._free_at)
+            self._free_at = start + nbytes / self.bps
+            return self._free_at - now
+
+
+def _throttled_fs(gate: SharedGate):
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    class SharedThrottledFS(FSStoragePlugin):
+        # Buffered-only both ways: the slow-storage election would
+        # otherwise route reads through read_stream(), skipping the gate.
+        supports_streaming = False
+        supports_streaming_reads = False
+
+        async def write(self, write_io):
+            nbytes = memoryview(write_io.buf).nbytes
+            await super().write(write_io)
+            await asyncio.sleep(gate.reserve(nbytes))
+
+        async def read(self, read_io):
+            await super().read(read_io)
+            await asyncio.sleep(gate.reserve(memoryview(read_io.buf).nbytes))
+
+    return SharedThrottledFS
+
+
+def main() -> int:
+    mb = float(sys.argv[1]) if len(sys.argv) > 1 else 24.0
+    bandwidth = (
+        float(sys.argv[2]) if len(sys.argv) > 2 else 80.0
+    ) * 1e6  # bytes/s
+
+    import numpy as np
+
+    import torchsnapshot_tpu.storage_plugins.fs as fs_mod
+    from torchsnapshot_tpu import StateDict
+    from torchsnapshot_tpu.manager import CheckpointManager
+    from torchsnapshot_tpu.tenancy import Tenant
+
+    gate = SharedGate(bandwidth)
+    orig_plugin = fs_mod.FSStoragePlugin
+    fs_mod.FSStoragePlugin = _throttled_fs(gate)
+    try:
+        import tempfile
+
+        root = tempfile.mkdtemp(prefix="tsnap_admission_")
+        rows = int(mb * 1e6) // (1024 * 4)
+        payload = np.arange(rows * 1024, dtype=np.float32).reshape(rows, 1024)
+        batch = CheckpointManager(
+            root, tenant=Tenant(id="batch", priority=1), keep_last=2
+        )
+        serving = CheckpointManager(
+            root, tenant=Tenant(id="serving", priority=4), keep_last=2
+        )
+
+        def serving_state():
+            return {"model": StateDict(w=np.zeros_like(payload))}
+
+        # Seed both tenants' snapshots AND the governor's measured
+        # write/read rates (admission pacing needs a measured rate; the
+        # first op is the measurement).
+        batch.save(0, {"model": StateDict(w=payload)})
+        serving.save(0, {"model": StateDict(w=payload)})
+        serving.restore(serving_state())
+
+        def restore_p50() -> float:
+            walls = []
+            for _ in range(REPS):
+                t0 = time.perf_counter()
+                serving.restore(serving_state())
+                walls.append(time.perf_counter() - t0)
+            return statistics.median(walls)
+
+        solo_p50 = restore_p50()
+        report(
+            "tenant_admission/solo",
+            {"restore_p50_s": round(solo_p50, 3), "reps": REPS},
+        )
+
+        def contended_p50() -> float:
+            stop = threading.Event()
+            step = [1]
+
+            def saver() -> None:
+                while not stop.is_set():
+                    step[0] += 1
+                    batch.save(step[0], {"model": StateDict(w=payload)})
+
+            t = threading.Thread(target=saver, daemon=True)
+            t.start()
+            time.sleep(0.2)  # let the first contended save enter I/O
+            try:
+                return restore_p50()
+            finally:
+                stop.set()
+                t.join(timeout=120)
+
+        contended = contended_p50()
+        report(
+            "tenant_admission/contended",
+            {"restore_p50_s": round(contended, 3), "reps": REPS},
+        )
+
+        # Informative control: same contention with admission disabled.
+        os.environ["TORCHSNAPSHOT_TPU_ADMISSION"] = "0"
+        try:
+            unpaced = contended_p50()
+        finally:
+            os.environ.pop("TORCHSNAPSHOT_TPU_ADMISSION", None)
+        report(
+            "tenant_admission/contended_no_admission",
+            {"restore_p50_s": round(unpaced, 3), "reps": REPS},
+        )
+
+        ratio = contended / solo_p50
+        summary = {
+            "payload_mb": mb,
+            "bandwidth_mbps": bandwidth / 1e6,
+            "solo_p50_s": round(solo_p50, 3),
+            "contended_p50_s": round(contended, 3),
+            "no_admission_p50_s": round(unpaced, 3),
+            "degradation_x": round(ratio, 2),
+            "no_admission_degradation_x": round(unpaced / solo_p50, 2),
+        }
+        report("tenant_admission/summary", summary)
+        assert ratio <= 2.0, (
+            f"contended restore p50 {contended:.2f}s is {ratio:.2f}x solo "
+            f"{solo_p50:.2f}s — admission failed to protect the "
+            "high-priority tenant"
+        )
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+    finally:
+        fs_mod.FSStoragePlugin = orig_plugin
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,6 +187,72 @@ def test_asdict_field_order_is_stable(metadata) -> None:
     # every non-incremental snapshot's on-disk format—are unchanged.
 
 
+class TestColumnarGolden:
+    """ISSUE 17: the binary struct-of-arrays (TSCM) manifest plane must
+    be BIT-equivalent to the JSON carrier on the golden fixtures —
+    decode(encode(md)).to_yaml() reproduces the golden text exactly, so
+    either format restores identical snapshots."""
+
+    def test_encode_decode_reproduces_golden_text(
+        self, golden_text, metadata
+    ) -> None:
+        from torchsnapshot_tpu import colmanifest
+
+        raw = colmanifest.encode_metadata(metadata)
+        assert raw[:4] == b"TSCM"
+        assert colmanifest.decode_metadata(raw).to_yaml() == golden_text
+
+    def test_legacy_yaml_to_columnar_equivalence(self, metadata) -> None:
+        """Snapshots parsed from the pre-JSON YAML carrier survive a
+        columnar round-trip with identical manifests."""
+        from torchsnapshot_tpu import colmanifest
+
+        with open(LEGACY_YAML_PATH) as f:
+            legacy = SnapshotMetadata.from_yaml(f.read())
+        rt = colmanifest.decode_metadata(colmanifest.encode_metadata(legacy))
+        assert asdict(rt) == asdict(metadata)
+
+    def test_diff_round_trip(self, metadata) -> None:
+        """Manifest diffs (TSCD) applied to the base reproduce the new
+        manifest exactly — the incremental manifest plane's contract."""
+        import copy
+
+        from torchsnapshot_tpu import colmanifest
+
+        new = copy.deepcopy(metadata)
+        # mutate: change one leaf, drop one entry, add one entry
+        new.manifest["0/model/weight"].checksum = "crc32c:0badf00d"
+        del new.manifest["0/extra/blob"]
+        new.manifest["0/model/extra_w"] = ArrayEntry(
+            location="0/model/extra_w",
+            serializer="buffer_protocol",
+            dtype="float32",
+            shape=[4],
+            replicated=False,
+        )
+        diff = colmanifest.encode_manifest_diff(metadata, new)
+        assert diff[:4] == b"TSCD"
+        applied = colmanifest.apply_manifest_diff(metadata, diff)
+        assert asdict(applied) == asdict(new)
+        assert applied.to_yaml() == new.to_yaml()
+        # the diff is much smaller than a full re-encode
+        assert len(diff) < len(colmanifest.encode_metadata(new))
+
+    def test_snapshot_metadata_reader_sniffs_columnar(
+        self, metadata, tmp_path
+    ) -> None:
+        """_read_metadata dispatches on the TSCM magic, so a columnar
+        ``.snapshot_metadata`` restores through the normal path."""
+        from torchsnapshot_tpu import colmanifest
+        from torchsnapshot_tpu.snapshot import Snapshot
+
+        (tmp_path / ".snapshot_metadata").write_bytes(
+            colmanifest.encode_metadata(metadata)
+        )
+        got = Snapshot(str(tmp_path)).metadata
+        assert asdict(got) == asdict(metadata)
+
+
 def test_legacy_manifest_without_new_fields_parses() -> None:
     # Forward compatibility: manifests written before ObjectEntry.size was
     # introduced must keep loading.

@@ -83,8 +83,12 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_SEED_FANOUT",
     "TORCHSNAPSHOT_TPU_SEED_RESTORE",
     "TORCHSNAPSHOT_TPU_SEED_TTL_S",
+    "TORCHSNAPSHOT_TPU_ADMISSION",
+    "TORCHSNAPSHOT_TPU_MANIFEST_FORMAT",
+    "TORCHSNAPSHOT_TPU_QUOTA_BYTES",
     "TORCHSNAPSHOT_TPU_STAGING_POOL_BYTES",
     "TORCHSNAPSHOT_TPU_STORE_ADDR",
+    "TORCHSNAPSHOT_TPU_TENANT",
     "TORCHSNAPSHOT_TPU_STORE_CONNECT_RETRIES",
     "TORCHSNAPSHOT_TPU_STORE_LEASE_S",
     "TORCHSNAPSHOT_TPU_STORE_REPLICAS",

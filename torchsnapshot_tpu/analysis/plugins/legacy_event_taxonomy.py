@@ -37,7 +37,7 @@ _MODULE_NAME = "flightrec"
 # again when the delta-journal events landed with ISSUE 14 and the
 # fleet-distribution events with ISSUE 16). Shrinking it means an
 # operator-facing event class was silently dropped.
-MIN_EVENTS = 28
+MIN_EVENTS = 30
 # Same floor for histogram instruments (ISSUE 8).
 MIN_HISTOGRAMS = 5
 

@@ -66,15 +66,22 @@ PINNED_SITE_FILES = {
     # serve/push boundaries.
     "distrib.seed_xfer": "distrib.py",
     "distrib.epoch_push": "distrib.py",
+    # The tenancy sites (ISSUE 17) are pinned to the tenancy package:
+    # the chaos drills kill "at the quota gate, before payload I/O"
+    # (must leave no partial) and fail "the admission registration"
+    # (must fail the op, not run unpaced), which is only that while the
+    # sites sit on tenancy's gate boundaries.
+    "tenancy.quota_check": os.path.join("tenancy", "quota.py"),
+    "tenancy.admission": os.path.join("tenancy", "admission.py"),
 }
 
 # Regression floor: the registry started at 15 sites (ISSUE 5), grew
 # the replication/lease sites (ISSUE 6), the native-engine sites
 # (ISSUE 9), the planned-reshard bundle site (ISSUE 12), the
-# delta-journal sites (ISSUE 14), and the fleet-distribution sites
-# (ISSUE 16). Shrinking it means a drill surface was silently
-# unthreaded.
-MIN_SITES = 25
+# delta-journal sites (ISSUE 14), the fleet-distribution sites
+# (ISSUE 16), and the tenancy sites (ISSUE 17). Shrinking it means a
+# drill surface was silently unthreaded.
+MIN_SITES = 27
 
 
 def check_source(

@@ -61,6 +61,7 @@ BENCHMARK_ALLOWLIST = {
     "sharded_save.py",
     "store_scale.py",
     "stream_overlap.py",
+    "tenant_admission.py",  # solo vs contended restore walls time wall clock
     "vs_orbax.py",
 }
 

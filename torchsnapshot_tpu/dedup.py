@@ -89,6 +89,11 @@ class PayloadRef:
     nbytes: Optional[int]
     checksum: Optional[str] = None
     codec: Optional[str] = None
+    # Where the bytes live WITHIN the origin. Usually equal to the new
+    # entry's own location (dedup matches by location), but a pool-swept
+    # base stores its payload under a rewritten ``po/<hex>`` path — a
+    # digest-fallback match must point the new entry there.
+    location: Optional[str] = None
     # Device-resident fingerprint the base recorded (device_digest.py):
     # matching it skips the DtoH transfer, not just the storage write.
     device_digest: Optional[str] = None
@@ -120,6 +125,14 @@ class DedupContext:
     ):
         self.base_path = base_path
         self.refs = refs
+        # Secondary content-address index: a pool-swept base (tenancy/
+        # pool.py rewrites locations to po/<hex>) no longer matches by
+        # location, but its payloads are the same bytes — match() falls
+        # back to the digest. First ref per digest wins (they are
+        # interchangeable by construction: digest + size verified).
+        self.by_digest: Dict[str, PayloadRef] = {}
+        for ref in refs.values():
+            self.by_digest.setdefault(ref.digest, ref)
         # When True, stagers fingerprint device arrays on device
         # (device_digest.py) and skip the DtoH copy on a base match; the
         # fingerprint is also recorded so FUTURE takes can match.
@@ -165,6 +178,7 @@ class DedupContext:
                         checksum=p.checksum,
                         codec=p.codec,
                         device_digest=p.device_digest,
+                        location=p.location,
                     ),
                 )
             if isinstance(entry, ObjectEntry) and entry.digest is not None:
@@ -176,6 +190,7 @@ class DedupContext:
                         nbytes=entry.size,
                         checksum=entry.checksum,
                         codec=entry.codec,
+                        location=entry.location,
                     ),
                 )
         return cls(base_path=base_path, refs=refs, device_digests=device_digests)
@@ -183,7 +198,12 @@ class DedupContext:
     def match(self, location: str, digest: str, nbytes: int) -> Optional[PayloadRef]:
         ref = self.refs.get(location)
         if ref is None or ref.digest != digest:
-            return None
+            # Content-address fallback (pool-swept bases): same bytes
+            # under a rewritten location still dedup — digest + size
+            # agreement is the same evidence the location path demands.
+            ref = self.by_digest.get(digest)
+            if ref is None:
+                return None
         if ref.nbytes is not None and ref.nbytes != nbytes:
             return None  # digest collision paranoia: sizes must agree
         return ref

@@ -556,6 +556,12 @@ def configure_registry(factory: Optional[Callable[[], Any]]) -> None:
 
 
 def _registry_store(pg_wrapper: Any = None) -> Optional[Any]:
+    from .tenancy import maybe_scope_store
+
+    return maybe_scope_store(_registry_store_raw(pg_wrapper))
+
+
+def _registry_store_raw(pg_wrapper: Any = None) -> Optional[Any]:
     if _registry_factory is not None:
         try:
             return _registry_factory()

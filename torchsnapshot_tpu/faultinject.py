@@ -120,6 +120,12 @@ SITES: Dict[str, str] = {
     # (corrupt is caught by the receiver's record CRCs).
     "distrib.seed_xfer": "data",
     "distrib.epoch_push": "data",
+    # tenancy (tenancy/): the quota gate before any payload I/O (kill
+    # here must leave NO partial — the save hasn't started) and the
+    # admission-table registration (a tenant that cannot register must
+    # fail its op, not silently run unpaced at full bandwidth).
+    "tenancy.quota_check": "control",
+    "tenancy.admission": "control",
 }
 
 KNOWN_SITES = frozenset(SITES)

@@ -791,7 +791,16 @@ class ArrayBufferStager(BufferStager):
                 # chains stay stable across codec/level changes.
                 digest = compute_digest(buf)
                 self.entry.digest = digest
-                ref = self.dedup.match(self.entry.location, digest, buf.nbytes)
+                # Slab-batched payloads (byte_range) never dedup: the
+                # entry's offsets index the SLAB, not the base's file —
+                # borrowing a base origin would read the base at slab
+                # offsets. (The by-location match could never hit them;
+                # the content-address fallback could.)
+                ref = (
+                    self.dedup.match(self.entry.location, digest, buf.nbytes)
+                    if self.entry.byte_range is None
+                    else None
+                )
                 if ref is not None:
                     # Unchanged since the base snapshot: record where the
                     # bytes already live and skip the storage write. The
@@ -803,6 +812,11 @@ class ArrayBufferStager(BufferStager):
                     # coverage for the deduplicated entry.
                     self.entry.origin = ref.origin
                     self.entry.codec = ref.codec
+                    if ref.location is not None:
+                        # Content-address fallback: the base stores these
+                        # bytes under its OWN path (e.g. the pool's
+                        # ``po/<hex>``) — restore reads origin+location.
+                        self.entry.location = ref.location
                     if ref.checksum is None and ref.codec is None:
                         if checksums_enabled():
                             self.entry.checksum = compute_checksum(buf)

@@ -97,6 +97,10 @@ class ObjectBufferStager(BufferStager):
                     # (identical) bytes.
                     self.entry.origin = ref.origin
                     self.entry.codec = ref.codec
+                    if ref.location is not None:
+                        # Pool-swept bases store under ``po/<hex>`` — see
+                        # ArrayBufferStager.
+                        self.entry.location = ref.location
                     if ref.checksum is None and ref.codec is None:
                         if checksums_enabled():
                             self.entry.checksum = compute_checksum(buf)

@@ -62,7 +62,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from . import journal
+from . import journal, tenancy
 from .pg_wrapper import PGWrapper, ProcessGroup
 from .preemption import PreemptionWatcher
 from .snapshot import PendingSnapshot, Snapshot
@@ -100,6 +100,7 @@ class CheckpointManager:
         storage_options: Optional[Dict[str, Any]] = None,
         pg: Optional[ProcessGroup] = None,
         preemption: Optional[PreemptionWatcher] = None,
+        tenant: Optional[tenancy.Tenant] = None,
     ) -> None:
         if save_interval_steps < 1:
             raise ValueError("save_interval_steps must be >= 1")
@@ -107,7 +108,18 @@ class CheckpointManager:
             raise ValueError("keep_last must be >= 1 (or None to keep all)")
         if keep_every is not None and keep_every < 1:
             raise ValueError("keep_every must be >= 1 (or None)")
+        # Tenancy: an explicit tenant wins, else the ambient
+        # TORCHSNAPSHOT_TPU_TENANT one (the disabled path's single env
+        # check). With a tenant, this manager's whole world — steps,
+        # retention, fsck scope, coordination keys — lives under the
+        # tenant's namespace; ``root`` stays the SHARED bucket root
+        # (the cross-tenant payload pool lives beside the tenant trees).
+        self._tenant = tenant if tenant is not None else tenancy.tenant_from_env()
+        self._shared_root = root
+        if self._tenant is not None:
+            root = tenancy.tenant_root(root, self._tenant)
         self.root = root
+        self._retention_skip_warned = False
         self.save_interval_steps = save_interval_steps
         self.keep_last = keep_last
         self.keep_every = keep_every
@@ -149,6 +161,46 @@ class CheckpointManager:
         # costs bytes, never correctness. Reset with each journal seed
         # (a new base step invalidates old epochs).
         self._push_cursor: Dict[str, int] = {}
+        # Tenant-registry row published lazily at the first save (the
+        # store may not be reachable at construction time).
+        self._tenant_registered = False
+
+    def _register_tenant(self) -> None:
+        """Publish this tenant's registry row (rank 0, once, best
+        effort) on the GLOBAL store plane — arbitration readers
+        (admission, operators) need to see every tenant."""
+        if self._tenant is None or self._tenant_registered:
+            return
+        self._tenant_registered = True
+        if PGWrapper(self.pg).get_rank() != 0:
+            return
+        try:
+            from . import distrib
+            from .tenancy import registry as tenant_registry
+
+            store = distrib._registry_store_raw(PGWrapper(self.pg))
+            if store is not None:
+                tenant_registry.register(store, self._tenant)
+        except Exception:  # noqa: BLE001 - registry is advisory
+            logger.debug("tenant registration skipped", exc_info=True)
+
+    def close(self) -> None:
+        """Release lifecycle state: wait out a pending async save and
+        plant this tenant's registry death notice (ghost key) so
+        readers stop counting it live."""
+        self.wait()
+        if self._tenant is not None and self._tenant_registered:
+            if PGWrapper(self.pg).get_rank() == 0:
+                try:
+                    from . import distrib
+                    from .tenancy import registry as tenant_registry
+
+                    store = distrib._registry_store_raw(PGWrapper(self.pg))
+                    if store is not None:
+                        tenant_registry.deregister(store, self._tenant.id)
+                except Exception:  # noqa: BLE001
+                    logger.debug("tenant deregister skipped", exc_info=True)
+            self._tenant_registered = False
 
     # ----------------------------------------------------------- paths
 
@@ -156,6 +208,31 @@ class CheckpointManager:
         from .storage_plugin import local_fs_root
 
         return local_fs_root(self.root)
+
+    def _shared_dir(self) -> Optional[str]:
+        """Local fs root of the SHARED (pre-tenant) bucket root — where
+        the cross-tenant payload pool lives. None without a tenant."""
+        if self._tenant is None:
+            return None
+        from .storage_plugin import local_fs_root
+
+        return local_fs_root(self._shared_root)
+
+    @staticmethod
+    def _step_like(name: str) -> bool:
+        """Quota retention may only demote the manager's own steps —
+        foreign names in the directory are never eviction victims."""
+        return bool(_STEP_RE.match(name))
+
+    def _activated(self):
+        """Context manager making this manager's tenant ambient for the
+        calling thread — key-construction sites (heartbeat prefixes,
+        seed/journal store acquisition) resolve the namespace there."""
+        import contextlib
+
+        if self._tenant is None:
+            return contextlib.nullcontext()
+        return tenancy.activated(self._tenant)
 
     def path_for(self, step: int) -> str:
         sep = "" if self.root.endswith("/") else "/"
@@ -328,6 +405,12 @@ class CheckpointManager:
             pg.retire()  # release the handshake/bcast store keys
 
     def save(self, step: int, app_state: AppState, *, force: bool = False) -> bool:
+        with self._activated():
+            return self._save_impl(step, app_state, force=force)
+
+    def _save_impl(
+        self, step: int, app_state: AppState, *, force: bool = False
+    ) -> bool:
         """Snapshot ``app_state`` if ``step`` is due (or ``force``).
 
         Returns True when a save was started/completed. Blocks only for
@@ -404,6 +487,14 @@ class CheckpointManager:
                 pg.broadcast_object("gc-done" if pg.get_rank() == 0 else None, src=0)
             finally:
                 pg.retire()
+        if self._tenant is not None:
+            self._register_tenant()
+            # The quota gate — BEFORE any payload I/O, so an over-quota
+            # save is a clean error, never a torn partial. Collective
+            # (rank 0 decides, everyone raises together).
+            from .tenancy import quota as _quota
+
+            _quota.ensure_capacity(self)
         path = self.path_for(step)
         base = (
             self.path_for(self._last_committed)
@@ -544,7 +635,42 @@ class CheckpointManager:
 
     def _committed(self, step: int) -> None:
         self._last_committed = step
+        self._pool_sweep(step)
         self._apply_retention()
+
+    def _pool_sweep(self, step: int) -> None:
+        """Post-commit cross-tenant dedup: move this step's eligible
+        payloads into the shared content-addressed pool (tenancy.pool)
+        and repoint its manifest. Rank 0, local roots, tenants only;
+        best-effort — a sweep failure degrades dedup, never the commit."""
+        if self._tenant is None:
+            return
+        if PGWrapper(self.pg).get_rank() != 0:
+            return
+        shared = self._shared_dir()
+        dirpath = self._local_dir()
+        if shared is None or dirpath is None:
+            return
+        from . import telemetry
+        from .tenancy import pool
+
+        try:
+            released, n = pool.sweep_step(
+                shared, self._tenant.id, os.path.join(dirpath, _step_name(step))
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning("pool sweep failed for step %d", step, exc_info=True)
+            return
+        if n:
+            telemetry.counter_add("pool_bytes_released", released)
+            logger.info(
+                "pool sweep: step %d shares %d payload(s) (%d bytes "
+                "released) via %s",
+                step,
+                n,
+                released,
+                pool.pool_root(shared),
+            )
 
     # --------------------------------------------------- delta journal
 
@@ -580,6 +706,10 @@ class CheckpointManager:
         )
 
     def journal_step(self, step: int, app_state: AppState) -> bool:
+        with self._activated():
+            return self._journal_step_impl(step, app_state)
+
+    def _journal_step_impl(self, step: int, app_state: AppState) -> bool:
         """Append a delta journal epoch for the leaves that changed since
         the last committed state (base snapshot or previous epoch).
 
@@ -623,6 +753,10 @@ class CheckpointManager:
         return True
 
     def push_update(self) -> Dict[str, Any]:
+        with self._activated():
+            return self._push_update_impl()
+
+    def _push_update_impl(self) -> Dict[str, Any]:
         """Ship committed journal epochs to live replicas registered as
         holding the current base step (distrib.UpdateReceiver) — a
         rolling update that moves ≈ the committed dirty set instead of
@@ -705,7 +839,23 @@ class CheckpointManager:
             return  # commit already barriered; rank 0 owns deletion
         dirpath = self._local_dir()
         if dirpath is None:
-            logger.debug("remote root %s: retention skipped", self.root)
+            # Loud, not silent: an operator who configured keep_last on
+            # an s3/gcs root believes retention is bounding their spend.
+            # One warning per manager + a counter every skip, so both
+            # logs and fleet telemetry carry the truth. (A QUOTA on a
+            # remote root goes further and raises — see tenancy.quota.)
+            from . import telemetry
+
+            if not self._retention_skip_warned:
+                self._retention_skip_warned = True
+                logger.warning(
+                    "retention skipped: root %s is not a local filesystem "
+                    "— keep_last/keep_every cannot reclaim there; bound "
+                    "the remote tier with the `prune` CLI or lifecycle "
+                    "rules",
+                    self.root,
+                )
+            telemetry.counter_add("retention_skipped", 1)
             return
         from .retention import apply_retention, plan_retention
 
@@ -717,6 +867,12 @@ class CheckpointManager:
                 dirpath,
                 ", ".join(sorted(plan.unresolved)),
             )
+        if plan.doomed and self._tenant is not None:
+            shared = self._shared_dir()
+            if shared is not None:
+                from .tenancy import pool
+
+                pool.release_steps(shared, self._tenant.id, plan.doomed)
         n = apply_retention(dirpath, plan)
         if n:
             logger.info(
@@ -731,6 +887,12 @@ class CheckpointManager:
     # --------------------------------------------------------- restore
 
     def restore(self, app_state: AppState, step: Optional[int] = None) -> int:
+        with self._activated():
+            return self._restore_impl(app_state, step)
+
+    def _restore_impl(
+        self, app_state: AppState, step: Optional[int] = None
+    ) -> int:
         """Restore ``app_state`` from ``step`` (default: latest). Returns
         the step restored from. The manager's ``device_digests`` option
         applies here too: destinations already holding a payload's

@@ -19,6 +19,10 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, List, Sequence, Set, Tuple, Union
 
+# The shared content-addressed payload pool's directory name (mirrored
+# from tenancy.pool to avoid a package cycle; pinned by test_tenancy).
+POOL_DIRNAME = ".tsnap_pool"
+
 
 @dataclass
 class RetentionPlan:
@@ -74,6 +78,14 @@ def plan_retention(dirpath: str, keep: KeepPolicy) -> RetentionPlan:
         visited.add(name)
         for origin in origins_of.get(name, ()):
             canon = _canon_snapshot_url(origin)
+            if os.path.basename(canon.rstrip("/")) == POOL_DIRNAME:
+                # Cross-tenant payload pool (tenancy/pool.py): pooled
+                # payloads are protected by their own refcounts — the
+                # manager releases a doomed step's refs before deletion
+                # — not by sparing snapshots. The pool is not a
+                # snapshot; resolving it here would flag every swept
+                # chain unresolved and freeze retention.
+                continue
             locations = origin_locations_of.get(name, {}).get(origin, {})
 
             def _holds_payloads(candidate: str) -> bool:

@@ -980,6 +980,18 @@ async def execute_write_reqs(
         else None
     )
     io_concurrency = governor.io_concurrency("write", plugin_key)
+    # Tenancy admission (tenancy/admission.py): a session armed on this
+    # op's storage scales the I/O-slot cap by the tenant's bandwidth
+    # share and paces each dispatched request through its token bucket.
+    # None on every non-tenant op — the attribute probe is the whole
+    # disabled-path cost here.
+    admission = getattr(storage, "_tsnap_admission", None)
+    if admission is not None:
+        io_concurrency = admission.scale_concurrency(io_concurrency)
+
+    async def _paced(coro, nbytes):
+        await admission.admit(nbytes, "write", plugin_key)
+        return await coro
 
     ready_for_staging = [
         _WritePipeline(req, sub_chunk_bytes=sub_chunk, storage=storage)
@@ -1041,11 +1053,12 @@ async def execute_write_reqs(
             budget.acquire(pipeline.admission_cost_bytes)
             if pipeline.streamed:
                 inflight_streams += 1
-                staging_tasks.add(
-                    event_loop.create_task(
-                        pipeline.stream_write(storage, executor)
+                stream_coro = pipeline.stream_write(storage, executor)
+                if admission is not None:
+                    stream_coro = _paced(
+                        stream_coro, pipeline.admission_cost_bytes
                     )
-                )
+                staging_tasks.add(event_loop.create_task(stream_coro))
             else:
                 staging_tasks.add(
                     event_loop.create_task(pipeline.stage_buffer(executor))
@@ -1058,7 +1071,13 @@ async def execute_write_reqs(
         # Streams count against the same cap (see dispatch_staging).
         while ready_for_io and len(io_tasks) + inflight_streams < io_concurrency:
             pipeline = ready_for_io.pop(0)
-            io_tasks.add(event_loop.create_task(pipeline.write_buffer(storage)))
+            io_coro = pipeline.write_buffer(storage)
+            if admission is not None:
+                # Pacing runs INSIDE the slot: a throttled tenant's
+                # request occupies its (already share-scaled) slot while
+                # it waits, which is exactly the backpressure intended.
+                io_coro = _paced(io_coro, pipeline.admission_cost_bytes)
+            io_tasks.add(event_loop.create_task(io_coro))
             reporter.inflight_io += 1
 
     dispatch_staging()
@@ -1682,6 +1701,17 @@ async def execute_read_reqs(
     inflight: Set[asyncio.Task] = set()
     inflight_recv = 0
     io_concurrency = governor.io_concurrency("read", plugin_key)
+    # Tenancy admission, read side (see execute_write_reqs): scaled slot
+    # cap + per-request pacing. Peer-fed entries are never paced — they
+    # issue no storage request (their direct fallbacks are).
+    admission = getattr(storage, "_tsnap_admission", None)
+    if admission is not None:
+        io_concurrency = admission.scale_concurrency(io_concurrency)
+
+    async def _paced(coro, nbytes):
+        await admission.admit(nbytes, "read", plugin_key)
+        return await coro
+
     telemetry.record_election(
         site="read",
         plugin=plugin_key,
@@ -1708,11 +1738,12 @@ async def execute_read_reqs(
             budget.acquire(pipeline.admission_cost_bytes)
             if pipeline.is_recv:
                 inflight_recv += 1
-            inflight.add(
-                event_loop.create_task(
-                    pipeline.read_and_consume(storage, executor, throughput, budget)
-                )
+            read_coro = pipeline.read_and_consume(
+                storage, executor, throughput, budget
             )
+            if admission is not None and not pipeline.is_recv:
+                read_coro = _paced(read_coro, pipeline.admission_cost_bytes)
+            inflight.add(event_loop.create_task(read_coro))
             reporter.inflight_io += 1
 
         while pending:

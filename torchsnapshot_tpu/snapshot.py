@@ -88,6 +88,7 @@ from .scheduler import (
 from .serialization import array_size_bytes, dtype_to_string
 from .stateful import AppState, Stateful
 from .storage_plugin import url_to_storage_plugin_in_event_loop
+from .tenancy import admission as tenancy_admission
 from .version import __version__
 
 logger = logging.getLogger(__name__)
@@ -269,6 +270,10 @@ class Snapshot:
         # self-dumps stacks on overdue collectives / slow storage ops /
         # frozen progress, and answers `watch --dump` requests.
         watchdog = telemetry.forensics.arm(pg_wrapper, "take", path)
+        # Tenancy admission: registers this op's bandwidth share and
+        # rides `storage` to the scheduler's I/O-slot acquisition. None
+        # (one env check) without a tenant.
+        admission = tenancy_admission.maybe_arm("take", storage, pg_wrapper)
         # Live /metrics endpoint (TORCHSNAPSHOT_TPU_METRICS_PORT): armed
         # once per process at the first op; a no-op with the env unset.
         telemetry.promexp.maybe_start(rank=pg_wrapper.get_rank())
@@ -356,6 +361,7 @@ class Snapshot:
                 heartbeat.stop()
             if watchdog is not None:
                 watchdog.stop()
+            tenancy_admission.disarm(storage, admission)
             # A success flag, NOT sys.exc_info(): in a finally block
             # exc_info also reports an AMBIENT exception the caller is
             # currently handling (take() inside an except block), which
@@ -421,6 +427,7 @@ class Snapshot:
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "take", path)
         watchdog = telemetry.forensics.arm(pg_wrapper, "take", path)
+        admission = tenancy_admission.maybe_arm("take", storage, pg_wrapper)
         telemetry.promexp.maybe_start(rank=pg_wrapper.get_rank())
         try:
             pending_io_work, metadata = cls._take_impl(
@@ -452,6 +459,7 @@ class Snapshot:
                 heartbeat.stop()
             if watchdog is not None:
                 watchdog.stop()
+            tenancy_admission.disarm(storage, admission)
             raise
         # All mutations from this point on do not affect the snapshot.
         return PendingSnapshot(
@@ -466,6 +474,7 @@ class Snapshot:
             recorder=recorder,
             heartbeat=heartbeat,
             watchdog=watchdog,
+            admission=admission,
         )
 
     @classmethod
@@ -866,6 +875,7 @@ class Snapshot:
         )
         heartbeat = telemetry.health.maybe_start(pg_wrapper, "restore", self.path)
         watchdog = telemetry.forensics.arm(pg_wrapper, "restore", self.path)
+        admission = tenancy_admission.maybe_arm("restore", storage, pg_wrapper)
         telemetry.promexp.maybe_start(rank=rank)
         coop_session = None
         try:
@@ -1148,6 +1158,7 @@ class Snapshot:
                 heartbeat.stop()
             if watchdog is not None:
                 watchdog.stop()
+            tenancy_admission.disarm(storage, admission)
             if coop_session is not None:
                 try:
                     # Clean shutdown (bye frames) so this rank's exit is
@@ -1743,6 +1754,10 @@ class Snapshot:
         if not raw.strip():
             raise CorruptSnapshotError(self.path, "zero-byte metadata file")
         try:
+            if raw[:4] == b"TSCM":
+                from . import colmanifest
+
+                return colmanifest.decode_metadata(raw)
             return SnapshotMetadata.from_yaml(raw.decode("utf-8"))
         except Exception as e:  # noqa: BLE001 - any decode failure
             raise CorruptSnapshotError(
@@ -1828,9 +1843,13 @@ class Snapshot:
                 raise StaleCommitError(
                     getattr(metadata, "_commit_path", "<unknown>"), gen, found
                 )
-        buf = faultinject.mutate(
-            "commit.metadata", metadata.to_yaml().encode("utf-8")
-        )
+        if os.environ.get("TORCHSNAPSHOT_TPU_MANIFEST_FORMAT", "") == "columnar":
+            from . import colmanifest
+
+            raw = colmanifest.encode_metadata(metadata)
+        else:
+            raw = metadata.to_yaml().encode("utf-8")
+        buf = faultinject.mutate("commit.metadata", raw)
         event_loop.run_until_complete(
             storage.write(WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=buf))
         )
@@ -2389,6 +2408,7 @@ class PendingSnapshot:
         recorder: Optional["telemetry.OpRecorder"] = None,
         heartbeat: Optional[Any] = None,
         watchdog: Optional[Any] = None,
+        admission: Optional[Any] = None,
     ) -> None:
         self.path = path
         self.pg = pg_wrapper.pg
@@ -2396,6 +2416,7 @@ class PendingSnapshot:
         self._recorder = recorder
         self._heartbeat = heartbeat
         self._watchdog = watchdog
+        self._admission = admission
         self._storage_options = storage_options
         self._done_event = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -2509,6 +2530,12 @@ class PendingSnapshot:
                     self._watchdog.stop()
                 except Exception:  # noqa: BLE001
                     pass
+            try:
+                from .tenancy import admission as _tadm
+
+                _tadm.disarm(storage, self._admission)
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 # Final act on this rank: ack namespace retirement so rank 0
                 # can reclaim this operation's store keys later.

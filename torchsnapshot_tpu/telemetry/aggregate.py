@@ -51,6 +51,12 @@ _SUMMED_COUNTERS = (
     "bytes_from_seeders",
     "seed_cache_hits",
     "epoch_push_bytes",
+    # Multi-tenant plane (tenancy/): quota evictions and pooled-payload
+    # reclaim, plus remote roots where retention could not run — the
+    # per-tenant capacity story in one row.
+    "retention_skipped",
+    "quota_evictions",
+    "pool_bytes_released",
 )
 
 

@@ -105,6 +105,16 @@ class HeartbeatPublisher:
         self.rank = rank
         self.op = op
         self.path = path
+        # Tenant-scoped key prefix, resolved NOW on the calling thread
+        # (the publisher thread would not see the caller's activation).
+        from ..tenancy import current_tenant, scope_key
+
+        tenant = current_tenant()
+        self.prefix = (
+            scope_key(HEARTBEAT_PREFIX, tenant.id)
+            if tenant is not None
+            else HEARTBEAT_PREFIX
+        )
         self.cadence_s = (
             cadence_s if cadence_s is not None else heartbeat_cadence_s()
         )
@@ -144,7 +154,7 @@ class HeartbeatPublisher:
 
     def _publish(self) -> None:
         try:
-            self._store.set(f"{HEARTBEAT_PREFIX}{self.rank}", self._payload())
+            self._store.set(f"{self.prefix}{self.rank}", self._payload())
         except Exception:  # noqa: BLE001 - heartbeats must never fail the op
             logger.debug("heartbeat publish skipped", exc_info=True)
 
@@ -158,7 +168,7 @@ class HeartbeatPublisher:
         # as a permanent ghost rank that `watch` flags STALLED forever.
         if self._delete_on_stop:
             try:
-                self._store.delete(f"{HEARTBEAT_PREFIX}{self.rank}")
+                self._store.delete(f"{self.prefix}{self.rank}")
             except Exception:  # noqa: BLE001
                 pass
         try:
@@ -211,17 +221,30 @@ def maybe_start(pg_wrapper: Any, op: str, path: str) -> Optional[HeartbeatPublis
 # -------------------------------------------------------------- watcher
 
 
-def read_fleet(store: Any) -> Dict[int, Dict[str, Any]]:
+def read_fleet(
+    store: Any, prefix: Optional[str] = None
+) -> Dict[int, Dict[str, Any]]:
     """One non-blocking snapshot of every published heartbeat.
 
     Uses the store's ``collect`` with count=0 — an immediate
     prefix scan, no waiting. Raises whatever the store client raises on
-    a dead tier (the CLI degrades, this function does not)."""
-    _, items = store.collect(HEARTBEAT_PREFIX, 0, timeout=5.0)
+    a dead tier (the CLI degrades, this function does not). ``prefix``
+    defaults to the active/ambient tenant's scoped keyspace (watching a
+    tenant's fleet needs TORCHSNAPSHOT_TPU_TENANT set to match)."""
+    if prefix is None:
+        from ..tenancy import current_tenant, scope_key
+
+        tenant = current_tenant()
+        prefix = (
+            scope_key(HEARTBEAT_PREFIX, tenant.id)
+            if tenant is not None
+            else HEARTBEAT_PREFIX
+        )
+    _, items = store.collect(prefix, 0, timeout=5.0)
     fleet: Dict[int, Dict[str, Any]] = {}
     for key, raw in items.items():
         try:
-            rank = int(key[len(HEARTBEAT_PREFIX):])
+            rank = int(key[len(prefix):])
             rec = json.loads(bytes(raw).decode("utf-8"))
         except (ValueError, UnicodeDecodeError):
             continue

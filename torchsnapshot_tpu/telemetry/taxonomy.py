@@ -79,6 +79,11 @@ EVENTS: Dict[str, str] = {
     "its content address (digest, nbytes, parent, depth)",
     "distrib.push": "one committed journal epoch was pushed to a live "
     "replica and acked (gen, epoch, nbytes, target, dup)",
+    # tenancy (tenancy/)
+    "tenant.admit": "a tenant-scoped op registered in the admission "
+    "table and got its bandwidth share (tenant, op, priority, share)",
+    "tenant.evict": "quota retention reclaimed a tenant's oldest "
+    "step(s) (tenant, evicted, used, quota)",
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
