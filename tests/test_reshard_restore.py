@@ -193,7 +193,8 @@ def test_tp2_to_tp4_planned_reshard_cuts_storage_reads_3x(tmp_path) -> None:
 def _single_gather_worker(rank, world_size, root, port):
     """Save rows and restore cols in ONE world-2 process: counts every
     ``all_gather_object`` payload during the restore and checks the
-    (preverify, addr, coop, reshard) election tuple rides exactly one."""
+    (preverify, addr, coop, reshard, lazy) election tuple rides exactly
+    one."""
     os.environ["TORCHSNAPSHOT_TPU_RESHARD"] = "always"
     os.environ["TORCHSNAPSHOT_TPU_TELEMETRY"] = "1"
     os.environ["TORCHSNAPSHOT_TPU_COOP_RESTORE"] = "never"
@@ -229,7 +230,7 @@ def _single_gather_worker(rank, world_size, root, port):
     _assert_local_shards_equal(dst["model"]["w"], _vals())
 
     election_tuples = [
-        o for o in gathered if isinstance(o, tuple) and len(o) == 4
+        o for o in gathered if isinstance(o, tuple) and len(o) == 5
     ]
     from_peers = int(telemetry.counters().get("bytes_resharded_from_peers", 0))
     return {"elections": len(election_tuples), "from_peers": from_peers}

@@ -65,9 +65,11 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_FSYNC",
     "TORCHSNAPSHOT_TPU_HEARTBEAT_S",
     "TORCHSNAPSHOT_TPU_IO_CONCURRENCY",
+    "TORCHSNAPSHOT_TPU_HOT_SET",
     "TORCHSNAPSHOT_TPU_JOURNAL",
     "TORCHSNAPSHOT_TPU_JOURNAL_EPOCH_BYTES",
     "TORCHSNAPSHOT_TPU_JOURNAL_MAX_EPOCHS",
+    "TORCHSNAPSHOT_TPU_LAZY_RESTORE",
     "TORCHSNAPSHOT_TPU_LINT_BASELINE",
     "TORCHSNAPSHOT_TPU_METRICS_PORT",
     "TORCHSNAPSHOT_TPU_MMAP_READS",
@@ -75,6 +77,7 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_NATIVE_IO",
     "TORCHSNAPSHOT_TPU_NATIVE_ODIRECT",
     "TORCHSNAPSHOT_TPU_NATIVE_QUEUE_DEPTH",
+    "TORCHSNAPSHOT_TPU_PAGEIN_PREFETCH",
     "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES",
     "TORCHSNAPSHOT_TPU_PREVERIFY",
     "TORCHSNAPSHOT_TPU_PROGRESS_S",

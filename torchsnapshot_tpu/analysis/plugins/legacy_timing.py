@@ -56,6 +56,7 @@ BENCHMARK_ALLOWLIST = {
     "fleet_restore.py",  # direct vs seeded fleet restore walls time wall clock
     "manifest_scale.py",
     "journal_rpo.py",  # epoch-append vs full-save walls time wall clock
+    "lazy_restore.py",  # TTFI vs eager restore walls time wall clock
     "reshard_throughput.py",  # planned vs direct restore walls time wall clock
     "restore_overlap.py",  # read/consume overlap legs time wall clock
     "sharded_save.py",

@@ -711,6 +711,15 @@ class SeedingStoragePlugin:
         self.session.retract(published)
 
 
+def unwrap_seed(storage: Any) -> Any:
+    """The plugin under the seeding tier (or ``storage`` itself when
+    unwrapped): degraded page-in retries and queue-jumping demand
+    faults read through this so they depend on nothing but storage."""
+    if isinstance(storage, SeedingStoragePlugin):
+        return storage.inner
+    return storage
+
+
 def maybe_wrap_restore(
     storage: Any, path: str, pg_wrapper: Any = None
 ) -> Tuple[Any, Optional[SeedingStoragePlugin]]:

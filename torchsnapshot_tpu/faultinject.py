@@ -126,6 +126,13 @@ SITES: Dict[str, str] = {
     # fail its op, not silently run unpaced at full bandwidth).
     "tenancy.quota_check": "control",
     "tenancy.admission": "control",
+    # lazy page-in restore (pagein.py): the engine's two batch kinds.
+    # Control-plane sites — they fail/kill the BACKGROUND read attempt
+    # (the drills then prove the leaf degrades to a blocking direct
+    # read, never a torn or stale value); payload corruption reuses the
+    # storage-boundary data sites (fs.read) the reads flow through.
+    "pagein.prefetch": "control",
+    "pagein.fault": "control",
 }
 
 KNOWN_SITES = frozenset(SITES)

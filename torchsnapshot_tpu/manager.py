@@ -155,6 +155,9 @@ class CheckpointManager:
         # Delta journal bound to the last committed base snapshot (armed by
         # each save when TORCHSNAPSHOT_TPU_JOURNAL=1; see journal_step).
         self._journal: Optional["journal.DeltaJournal"] = None
+        # Lazy page-in session of the most recent restore (pagein.py),
+        # None when the lazy election did not engage.
+        self.last_pagein: Optional[Any] = None
         # Rolling-update push cursor (distrib.py): per live replica, the
         # last journal epoch already shipped — keeps repeat pushes
         # incremental. Receivers dedup regardless, so losing this only
@@ -905,7 +908,10 @@ class CheckpointManager:
                     f"no committed snapshots under {self.root} (remote "
                     "roots need an explicit step=)"
                 )
-        Snapshot(
+        # Lazy page-in (TORCHSNAPSHOT_TPU_LAZY_RESTORE): when the lazy
+        # election engages, the session keeps paging after this returns;
+        # surfaced as ``self.last_pagein`` so callers can fault/wait.
+        self.last_pagein = Snapshot(
             self.path_for(step), pg=self.pg,
             storage_options=self._options_for(step),
         ).restore(app_state, device_digests=self.device_digests)
@@ -924,6 +930,13 @@ class CheckpointManager:
         if journal.enabled_by_env():
             from .storage_plugin import local_fs_root
 
+            # capture_baseline READS every leaf: a lazy restore must be
+            # fully resident first, or the baseline would capture proxy
+            # objects instead of values. (Lazy normally stands down when
+            # a journal exists; this covers a fresh journal being armed
+            # over a journal-less snapshot restored lazily.)
+            if self.last_pagein is not None:
+                self.last_pagein.wait()
             local = local_fs_root(self.path_for(step))
             if local is not None:
                 j = journal.DeltaJournal(

@@ -1637,6 +1637,7 @@ async def execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     coop=None,
+    preempt=None,
 ) -> None:
     event_loop = asyncio.get_running_loop()
     executor = ThreadPoolExecutor(max_workers=_MAX_PER_RANK_CPU_CONCURRENCY)
@@ -1747,6 +1748,14 @@ async def execute_read_reqs(
             reporter.inflight_io += 1
 
         while pending:
+            # Preemptible background pipeline (pagein.py): while the
+            # hook reports a demand fault in flight, this execution
+            # trickles — at most ONE request in flight (forward progress
+            # is guaranteed; a full pause would deadlock a fault that
+            # waits on this very batch) — so its I/O slots, and the
+            # admission share they draw from, yield to the fault.
+            if preempt is not None and inflight and preempt():
+                break
             head = pending[0]
             # Peer-fed entries are exempt from the I/O slot cap: they
             # issue no storage request while waiting, and capping them
@@ -1820,7 +1829,11 @@ def sync_execute_read_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
     coop=None,
+    preempt=None,
 ) -> None:
     event_loop.run_until_complete(
-        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank, coop=coop)
+        execute_read_reqs(
+            read_reqs, storage, memory_budget_bytes, rank, coop=coop,
+            preempt=preempt,
+        )
     )

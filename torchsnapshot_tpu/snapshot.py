@@ -44,7 +44,7 @@ import os
 import sys
 import threading
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -785,8 +785,11 @@ class Snapshot:
     # --------------------------------------------------------------- restore
 
     def restore(
-        self, app_state: AppState, device_digests: Optional[bool] = None
-    ) -> None:
+        self,
+        app_state: AppState,
+        device_digests: Optional[bool] = None,
+        hot: Optional[Sequence[Any]] = None,
+    ) -> "Optional[PageInSession]":
         """Restore the app state in place. Arrays are restored into the
         shapes/dtypes/shardings of the *current* state (memory-efficient and
         sharding-aware; reference rationale: snapshot.py:693-700).
@@ -805,10 +808,20 @@ class Snapshot:
         HtoD transfer and keep their current array. Wins whenever a
         process re-restores mostly-unchanged state: reloading the next
         snapshot of an incremental chain, retrying a partial restore.
+
+        ``hot``: lazy-restore hot set — regex strings or ``layout.Rule``
+        objects naming the leaves that must be resident before this call
+        returns. Consulted only under ``TORCHSNAPSHOT_TPU_LAZY_RESTORE``
+        (default ``never``: one env check, eager semantics unchanged,
+        return value ``None``). When the lazy election engages, deferred
+        leaves come back as ``pagein.LeafFuture`` proxies in the loaded
+        state and the returned :class:`pagein.PageInSession` pages them
+        in — ``session.wait()`` is the eager restore's return point.
         """
         self._validate_app_state(app_state)
-        self._restore_impl(
-            app_state, PGWrapper(self.pg), device_digests=device_digests
+        return self._restore_impl(
+            app_state, PGWrapper(self.pg), device_digests=device_digests,
+            hot=hot,
         )
 
     def async_restore(
@@ -843,7 +856,8 @@ class Snapshot:
         app_state: AppState,
         pg_wrapper: PGWrapper,
         device_digests: Optional[bool] = None,
-    ) -> None:
+        hot: Optional[Sequence[Any]] = None,
+    ) -> "Optional[PageInSession]":
         # An explicit device_digests=True is a direct instruction to
         # verify; only the ambient (env-enabled) default is subject to
         # the governor's hash-vs-read economics below.
@@ -852,6 +866,29 @@ class Snapshot:
             from .device_digest import enabled_by_env
 
             device_digests = enabled_by_env()
+        # Lazy page-in election (pagein.py): local decision here; made
+        # collective below by riding the ONE election all-gather as a
+        # fifth tuple element. Default-off costs exactly one env check.
+        from . import pagein as _pagein
+
+        lazy_token = ""
+        lazy_hot = None
+        lazy_learned: List[str] = []
+        lazy_mode = _pagein.lazy_restore_mode()
+        if lazy_mode != "never":
+            lazy_hot = _pagein.HotSet(_pagein.compile_hot_set(hot))
+            lazy_learned = _pagein.learned_order(self.path)
+            # `auto` engages only when there is something to serve early
+            # (declared hot set or a learned first-touch order); both
+            # modes stand down when committed delta-journal epochs exist
+            # — replay folds NEWER values onto restored leaves, and a
+            # page landing after it would silently roll a leaf back.
+            engage_local = (
+                lazy_mode == "always"
+                or bool(lazy_hot.rules)
+                or bool(lazy_learned)
+            ) and not _pagein.journal_blocks_lazy(self.path)
+            lazy_token = _pagein.vote_token(engage_local, lazy_hot)
         event_loop = asyncio.new_event_loop()
         rank = pg_wrapper.get_rank()
         storage = url_to_storage_plugin_in_event_loop(
@@ -878,6 +915,8 @@ class Snapshot:
         admission = tenancy_admission.maybe_arm("restore", storage, pg_wrapper)
         telemetry.promexp.maybe_start(rank=rank)
         coop_session = None
+        pagein_session = None
+        pagein_handoff = False
         try:
             metadata = self._read_metadata(storage, event_loop)
             available = get_manifest_for_rank(metadata, rank)
@@ -939,7 +978,10 @@ class Snapshot:
             # should_planned_reshard gate) rides it as well: its vote is
             # one more element of the SAME gathered tuple, never a
             # second round trip (pinned by tests — the tuple is
-            # (preverify, addr, coop, reshard)). The peer listener and
+            # (preverify, addr, coop, reshard, lazy_token); the lazy
+            # page-in vote (pagein.py) is the fifth slot, a hot-set
+            # signature string that must be unanimous). The peer
+            # listener and
             # session are a shared transport: either subsystem opting in
             # binds it, and each engages only on its own unanimous vote,
             # so env skew in one knob cannot half-enable the other.
@@ -979,8 +1021,28 @@ class Snapshot:
                     extra_opt_in=local_reshard > 0,
                 )
                 gathered_flags = pg_wrapper.all_gather_object(
-                    (bool(local_pre), offer.addr, offer.coop_in, local_reshard)
+                    (
+                        bool(local_pre),
+                        offer.addr,
+                        offer.coop_in,
+                        local_reshard,
+                        lazy_token,
+                    )
                 )
+                # Lazy page-in engages only on a unanimous identical
+                # token (same mode AND same hot set): divergence — one
+                # rank lazy, one not, or differing hot rules — degrades
+                # to the eager restore everywhere, never a half-lazy
+                # fleet whose deferred sets skew the coop plan gather.
+                if lazy_token and not all(
+                    f[4] == lazy_token for f in gathered_flags
+                ):
+                    logger.info(
+                        "lazy page-in disabled for this restore: not "
+                        "every rank voted the same mode/hot set (env "
+                        "skew); restoring eagerly everywhere"
+                    )
+                    lazy_token = ""
                 if manifest_verifiable:
                     dist_verify = all(f[0] for f in gathered_flags)
                     if local_pre and not dist_verify:
@@ -996,6 +1058,25 @@ class Snapshot:
                     use_coop = all(f[2] for f in gathered_flags)
                     if all(f[3] > 0 for f in gathered_flags):
                         reshard_min_req = max(f[3] for f in gathered_flags)
+            if lazy_token:
+                layout_spec = None
+                if getattr(metadata, "layout", None):
+                    from .layout import LayoutSpec
+
+                    try:
+                        layout_spec = LayoutSpec.from_dict(metadata.layout)
+                    except Exception:  # noqa: BLE001 - ordering is advisory
+                        layout_spec = None
+                pagein_session = _pagein.PageInSession(
+                    self.path,
+                    rank,
+                    lazy_hot,
+                    memory_budget,
+                    world_size=pg_wrapper.get_world_size(),
+                    layout_spec=layout_spec,
+                    learned=lazy_learned,
+                    storage_options=self._storage_options,
+                )
             for key in ordered:
                 prepared = None
                 if key in app_state:
@@ -1050,6 +1131,14 @@ class Snapshot:
                             prepared=prepared,
                             preverified=preverified,
                             reshard=reshard_ctx,
+                            # RNG states restore last BECAUSE order
+                            # matters; deferring one would reorder its
+                            # load arbitrarily — they stay eager.
+                            pagein=(
+                                pagein_session
+                                if key not in rng_keys
+                                else None
+                            ),
                         )
                         groups = self._group_read_reqs(read_reqs)
                     except BaseException as e:  # noqa: B036
@@ -1135,7 +1224,20 @@ class Snapshot:
             )
             if exc is not None:
                 raise exc
+            # Lazy handoff: the restore returns HERE — hot set resident,
+            # deferred leaves held as futures — and the page-in engine
+            # adopts this restore's storage plugin and event loop (the
+            # finally block below skips closing them). Failure paths
+            # never reach this, so an aborted restore still closes its
+            # own I/O and the session's futures raise PageInAborted.
+            if pagein_session is not None:
+                if pagein_session.has_deferred:
+                    pagein_session.handoff(storage, event_loop, heartbeat)
+                    pagein_handoff = True
+                else:
+                    pagein_session.finish_empty()
             timer.log()
+            return pagein_session
         except BaseException as e:  # noqa: B036
             telemetry.flightrec.record(
                 "op.abort", op="restore", error=repr(e), kind=type(e).__name__
@@ -1144,6 +1246,13 @@ class Snapshot:
                 self.path, rank, f"restore aborted: {type(e).__name__}"
             )
             recorder.abandon()
+            if pagein_session is not None and not pagein_handoff:
+                try:
+                    # Partial page-in state must be unreferencable: every
+                    # unresolved leaf future raises PageInAborted.
+                    pagein_session.abort()
+                except Exception:
+                    pass
             if seed_tier is not None:
                 try:
                     # Retract THIS restore's seed registrations: an
@@ -1154,7 +1263,12 @@ class Snapshot:
                     pass
             raise
         finally:
-            if heartbeat is not None:
+            # After a lazy handoff the page-in engine owns the storage
+            # plugin, the event loop, and the health heartbeat (it stops
+            # and closes them when the last page lands); everything else
+            # — watchdog, admission, coop transport, wrapper — belongs
+            # to the restore and shuts down here as before.
+            if heartbeat is not None and not pagein_handoff:
                 heartbeat.stop()
             if watchdog is not None:
                 watchdog.stop()
@@ -1170,8 +1284,9 @@ class Snapshot:
                 pg_wrapper.retire()
             except Exception:
                 pass
-            storage.sync_close(event_loop)
-            event_loop.close()
+            if not pagein_handoff:
+                storage.sync_close(event_loop)
+                event_loop.close()
 
     def _distributed_preverify(
         self,
@@ -1385,6 +1500,7 @@ class Snapshot:
         prepared: "Tuple[Any, Dict[str, Any]]",
         preverified: "Optional[set]" = None,
         reshard: "Optional[Any]" = None,
+        pagein: "Optional[Any]" = None,
     ) -> "Tuple[List[ReadReq], Dict[str, Any]]":
         """Plan one app-state key's reads WITHOUT executing them.
 
@@ -1395,7 +1511,15 @@ class Snapshot:
         entries are resolved into ``flattened`` here (no I/O).
         ``reshard`` (reshard.ReshardContext) routes multi-requester
         sharded shards over the planned-peer tier; the planner needs no
-        collective of its own, so this stays pure planning."""
+        collective of its own, so this stays pure planning.
+
+        ``pagein`` (pagein.PageInSession): residency tracking starts at
+        this plan/execute split — eligible cold leaves are CLAIMED here
+        (their requests never enter the eager set; a ``LeafFuture``
+        proxy takes the leaf's place in ``flattened``) and completion
+        callbacks route through ``pagein.deliver`` so a page landing in
+        the background resolves its future instead of writing into a
+        dict the restore has already inflated."""
         _, flattened = prepared
         preverified = preverified or set()
 
@@ -1424,18 +1548,30 @@ class Snapshot:
                 continue
 
             def _cb(value: Any, lp: str = logical_path) -> None:
+                if pagein is not None and pagein.deliver(lp, value):
+                    return
                 flattened[lp] = value
 
-            read_reqs.extend(
-                prepare_read(
-                    entry,
-                    obj_out=obj,
-                    callback=_cb,
-                    device_digests=device_digests,
-                    assume_verified=logical_path in preverified,
-                    reshard=reshard,
-                )
+            reqs = prepare_read(
+                entry,
+                obj_out=obj,
+                callback=_cb,
+                device_digests=device_digests,
+                assume_verified=logical_path in preverified,
+                reshard=reshard,
             )
+            if pagein is not None and reqs:
+                future = pagein.claim_leaf(key, logical_path, entry, reqs)
+                if future is not None:
+                    flattened[logical_path] = future
+                    continue
+                pagein.note_eager_bytes(
+                    sum(
+                        rr.buffer_consumer.get_consuming_cost_bytes()
+                        for rr in reqs
+                    )
+                )
+            read_reqs.extend(reqs)
         return read_reqs, flattened
 
     def _finish_stateful_load(
@@ -1543,7 +1679,9 @@ class Snapshot:
 
     @staticmethod
     def _group_read_reqs(
-        read_reqs: List[ReadReq], batch: bool = True
+        read_reqs: List[ReadReq],
+        batch: bool = True,
+        priority: "Optional[Callable[[ReadReq], int]]" = None,
     ) -> "List[Tuple[Optional[str], List[ReadReq]]]":
         """Group reads by payload origin and coalesce within each group,
         in DETERMINISTIC order (local snapshot first, then origins
@@ -1563,16 +1701,31 @@ class Snapshot:
         the SAME preverify-gate all-gather as the coop election — the
         restore prologue pays exactly ONE flag round trip however many
         peer subsystems engage (pinned by
-        tests/test_reshard_restore.py::test_single_election_gather)."""
-        groups: Dict[Optional[str], List[ReadReq]] = {}
+        tests/test_reshard_restore.py::test_single_election_gather).
+
+        ``priority`` maps each request to an int class (lower executes
+        first); classes split groups — a class-0 demand fault and a
+        class-1 prefetch against the same origin become two groups, the
+        fault's first — and requests never coalesce across classes, so
+        a background page can never be merged into (and thereby gate)
+        a demand fault's read. ``None`` (the eager restore) is a single
+        class and grouping is byte-for-byte what it always was."""
+        groups: Dict[Tuple[int, Optional[str]], List[ReadReq]] = {}
         for rr in read_reqs:
-            groups.setdefault(rr.origin, []).append(rr)
-        ordered = sorted(groups.items(), key=lambda kv: (kv[0] is not None, kv[0] or ""))
+            cls = priority(rr) if priority is not None else 0
+            groups.setdefault((cls, rr.origin), []).append(rr)
+        ordered = sorted(
+            groups.items(),
+            key=lambda kv: (kv[0][0], kv[0][1] is not None, kv[0][1] or ""),
+        )
         if batch:
             # Merge adjacent ranged reads (slab restores, chunked reads)
             # into spanning reads — it only coalesces, never reorders data.
-            ordered = [(origin, batch_read_requests(reqs)) for origin, reqs in ordered]
-        return ordered
+            return [
+                (origin, batch_read_requests(reqs))
+                for (_cls, origin), reqs in ordered
+            ]
+        return [(origin, reqs) for (_cls, origin), reqs in ordered]
 
     def _execute_read_reqs_grouped(
         self,
@@ -2586,10 +2739,13 @@ class PendingRestore:
     ) -> None:
         self._exc: Optional[BaseException] = None
         self._done_event = threading.Event()
+        # Lazy page-in session (pagein.py), when the restore's lazy
+        # election engaged; surfaced by wait().
+        self.pagein: "Optional[Any]" = None
 
         def run() -> None:
             try:
-                snapshot._restore_impl(
+                self.pagein = snapshot._restore_impl(
                     app_state, pg_wrapper, device_digests=device_digests
                 )
             except BaseException as e:  # noqa: B036
@@ -2602,10 +2758,11 @@ class PendingRestore:
         )
         self._thread.start()
 
-    def wait(self) -> None:
+    def wait(self) -> "Optional[Any]":
         self._thread.join()
         if self._exc is not None:
             raise self._exc
+        return self.pagein
 
     def done(self) -> bool:
         return self._done_event.is_set()

@@ -57,6 +57,12 @@ _SUMMED_COUNTERS = (
     "retention_skipped",
     "quota_evictions",
     "pool_bytes_released",
+    # Lazy page-in restore (pagein.py): demand faults vs speculative
+    # prefetch and the bytes paged after restore() returned — the
+    # serve-before-restored story in one row.
+    "pages_faulted",
+    "pages_prefetched",
+    "pagein_bytes",
 )
 
 

@@ -260,7 +260,7 @@ def read_fleet(
 #: the straggler the watcher exists to flag.
 _PROGRESS_FIELDS = (
     "op", "phase", "staged_bytes", "written_bytes", "read_bytes",
-    "seed_bytes", "done_entries",
+    "seed_bytes", "done_entries", "resident_frac",
 )
 
 
@@ -317,10 +317,14 @@ def render_fleet(
     # restore (distrib.py): ``read`` counts what came from storage,
     # ``seed`` what arrived from seeding peers — a healthy seeded fleet
     # shows one replica with a big ``read`` and the rest mostly ``seed``.
+    # The ``resid`` column is a lazy restore's resident fraction
+    # (pagein.py): a replica serving before fully restored climbs from
+    # its hot-set fraction to 100% as the tail pages in; eager ops show
+    # ``-``.
     lines.append(
         f"{'rank':>4}  {'op':<8} {'phase':<14} {'staged':>10} {'written':>10} "
-        f"{'read':>10} {'seed':>10} {'total':>10} {'io':>3} {'eta':>7} "
-        f"{'wall':>8}  {'bound on':<15} status"
+        f"{'read':>10} {'seed':>10} {'total':>10} {'resid':>6} {'io':>3} "
+        f"{'eta':>7} {'wall':>8}  {'bound on':<15} status"
     )
     walls = []
     for rank in sorted(fleet):
@@ -339,6 +343,8 @@ def render_fleet(
         # live estimate): a STALLED row that also says "storage_write"
         # tells the on-call WHAT the straggler is stuck on.
         binding = rec.get("binding") or "-"
+        resid = rec.get("resident_frac")
+        resid_txt = f"{resid * 100:.0f}%" if resid is not None else "-"
         lines.append(
             f"{rank:>4}  {str(rec.get('op', '?')):<8} "
             f"{str(rec.get('phase', '?')):<14} "
@@ -347,6 +353,7 @@ def render_fleet(
             f"{fmt_bytes(rec.get('read_bytes')):>10} "
             f"{fmt_bytes(rec.get('seed_bytes')):>10} "
             f"{fmt_bytes(rec.get('total_bytes')):>10} "
+            f"{resid_txt:>6} "
             f"{rec.get('inflight_io', 0):>3} "
             f"{(str(eta) + 's') if eta is not None else '?':>7} "
             f"{rec.get('wall_s', 0):>7.1f}s  {str(binding):<15} {status}"

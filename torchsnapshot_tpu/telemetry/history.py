@@ -55,6 +55,9 @@ _RECORD_COUNTERS = (
     "bytes_from_seeders",
     "seed_cache_hits",
     "epoch_push_bytes",
+    "pages_faulted",
+    "pages_prefetched",
+    "pagein_bytes",
 )
 
 

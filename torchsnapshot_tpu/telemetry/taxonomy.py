@@ -84,6 +84,13 @@ EVENTS: Dict[str, str] = {
     "table and got its bandwidth share (tenant, op, priority, share)",
     "tenant.evict": "quota retention reclaimed a tenant's oldest "
     "step(s) (tenant, evicted, used, quota)",
+    # lazy page-in restore (pagein.py)
+    "pagein.begin": "a lazy restore returned with its hot set resident "
+    "and handed the tail to the page-in engine (units, bytes, ttfi_s)",
+    "pagein.fault": "a demand fault jumped the prefetch queue for a "
+    "deferred leaf (path, state, direct)",
+    "pagein.complete": "every deferred leaf landed — the lazy restore "
+    "reached eager-equivalent residency (units, faulted, wall_s)",
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
