@@ -778,6 +778,69 @@ def _lazy_leg(timeout_s: float = 420.0):
     return compact
 
 
+def _autotune_leg(timeout_s: float = 420.0):
+    """Closed-loop autotune leg (ISSUE 19), persisted to BENCH_r16.json
+    and embedded in the main record: benchmarks/autotune.py pits the
+    self-driving IOGovernor against a hand-tuned static election on
+    latency-bound storage — cold-start convergence (within 10% of the
+    hand-tuned p50 inside 8 takes) and warm-start parity (first take of
+    a fresh governor >= 0.9x hand-tuned, profiles loaded from the
+    history journal). Runs in its own process group with a hard
+    timeout; failures degrade to an absent key, never a dead bench."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _log(f"running autotune leg ({timeout_s:.0f}s budget) ...")
+    r = _run_in_own_group(
+        [sys.executable, os.path.join(here, "benchmarks", "autotune.py")],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"autotune leg rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    records = _json_records(r.stdout)
+    summary = records.get("autotune/summary")
+    if summary is None:
+        _log("autotune leg produced no summary; omitting")
+        return None
+    legs = [
+        rec
+        for name, rec in records.items()
+        if name.startswith("autotune/") and name != "autotune/summary"
+    ]
+    out = os.path.join(here, "BENCH_r16.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "metric": "autotune",
+                "unit": "take throughput vs hand-tuned p50 (x) / "
+                "takes to convergence",
+                "summary": summary,
+                "legs": legs,
+                "platform": "cpu",
+                "env": {
+                    "JAX_PLATFORMS": "cpu",
+                    "TORCHSNAPSHOT_TPU_AUTOTUNE": "fresh/auto per leg",
+                },
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    _log(
+        f"autotune leg ok: heuristic "
+        f"{summary.get('heuristic_vs_hand')}x hand-tuned, converged at "
+        f"take {summary.get('cold_converged_take')} "
+        f"(budget {summary.get('cold_budget_takes')}), warm first take "
+        f"{summary.get('warm_first_vs_hand_p50')}x; written to {out}"
+    )
+    compact = dict(summary)
+    compact.pop("benchmark", None)
+    return compact
+
+
 def _native_io_leg(tmp: str, app_state, state, nbytes: int):
     """Side-by-side native-engine vs Python-path legs (ISSUE 9),
     persisted to BENCH_r10.json and embedded in the main record.
@@ -1253,6 +1316,12 @@ def main() -> None:
     lazy_leg = _lazy_leg()
     if lazy_leg is not None:
         record["lazy_restore"] = lazy_leg
+    # Closed-loop autotune side-leg (BENCH_r16.json): cold-start
+    # convergence vs a hand-tuned pin, and warm-start from persisted
+    # learned profiles.
+    autotune_leg = _autotune_leg()
+    if autotune_leg is not None:
+        record["autotune"] = autotune_leg
     print(json.dumps(record), flush=True)
 
 

@@ -1,0 +1,415 @@
+"""Closed-loop autotune leg (ISSUE 19): cold-start convergence and
+warm-start parity vs a hand-tuned static election, on latency-bound
+storage.
+
+The regime the tuner exists for: a storage tier where every request
+pays a fixed latency on top of bandwidth (object stores, NFS round
+trips). The governor's measured-rate heuristic sizes sub-chunks at
+~50 ms of measured bandwidth — and on latency-dominated storage that
+backfires: low achieved bandwidth -> small sub-chunks -> MORE requests
+-> more latency -> lower bandwidth still. A hand-tuned operator pins
+``TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES`` at the leaf size and moves on;
+the closed loop (autotune.py) should discover the same thing by
+perturb-and-read — and remember it across processes.
+
+Storage writes are throttled with a per-request latency + bandwidth
+model (LATENCY_S + nbytes/THROTTLE_BPS per buffered write or stream
+sub-chunk), charged through one rate lock per event loop — the same
+single-simulated-pipe discipline as coop_restore.py / lazy_restore.py,
+plus the request-latency term this leg is ABOUT.
+
+Gate metrics are wall-clock throughput per take. The checkpoint root
+sits on tmpfs so real writes are memcpy and the synthetic throttle
+dominates every wall — on the disk-backed /tmp, ext4 writeback stalls
+2-10x a take's modeled time were measuring the host, not the tuner.
+The modeled service time the throttle charged per take is reported
+alongside (``model_gbps``) as a deterministic diagnostic of the
+elections in effect; it is NOT the gate, because it ignores the
+latency the streamed path genuinely hides behind overlapped staging
+(the fused-span residual accounting in telemetry/critpath.py measures
+that overlap, which is why the tuner can legitimately settle on a
+sub-leaf sub-chunk whose wall matches the hand-tuned pin).
+
+Four legs, same 256 MiB state (4 x 64 MiB leaves). I/O concurrency is
+pinned and the native engine disabled on EVERY leg, so sub-chunk size
+is the one experimental dimension (under the shared-pipe model the
+other dims are flat — trials on them would only spend takes learning
+"no difference"):
+
+- hand-tuned: AUTOTUNE=never, SUB_CHUNK_BYTES=64 MiB — the static
+  optimum an operator would pin. Its p50 is the reference.
+- heuristic: AUTOTUNE=never, no pin — the measured-rate default. On
+  this storage it converges DOWN (the pathology), so the gap to
+  hand-tuned is what the tuner must close.
+- cold-start: fresh governor, AUTOTUNE=fresh — two discarded
+  ``never`` warmups feed the rate tables (so learning starts at the
+  heuristic's true operating point, not the rate-free default), then
+  N takes with learning on. GATE: throughput within 10% of the
+  hand-tuned p50, sustained from some take <= 8, and the converged
+  profile persisted to the root's history journal.
+- warm-start: governor reset again (a "new process"), AUTOTUNE=auto —
+  the first take loads the persisted profile and must land >= 0.9x the
+  hand-tuned p50 immediately (no relearning).
+
+Emits one JSON line per leg plus ``autotune/summary`` (bench.py's
+``_autotune_leg`` persists that to BENCH_r16.json).
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/autotune.py
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_utils import report  # noqa: E402
+
+#: Simulated storage: every request pays LATENCY_S, bytes move at
+#: THROTTLE_BPS through one shared pipe. 25 ms / 800 MB/s puts the
+#: optimal sub-chunk at the leaf size (one request per leaf) and makes
+#: the heuristic's ~50 ms-of-bandwidth sizing land 3-8x too small.
+LATENCY_S = 0.025
+THROTTLE_BPS = 800e6
+
+N_LEAVES = 4
+LEAF_BYTES = 64 << 20  # float32 elems below
+PAYLOAD_BYTES = N_LEAVES * LEAF_BYTES
+
+HAND_SUB_CHUNK = str(LEAF_BYTES)
+PINNED_IO_CONCURRENCY = "8"
+
+TAKES_PER_LEG = 5
+COLD_TAKES = 10
+CONVERGE_WITHIN = 8  # gate: sustained >=90% of hand-tuned from take <= 8
+CONVERGE_FRAC = 0.90
+WARM_FLOOR = 0.90  # gate: warm-start first take >= 0.9x hand-tuned p50
+
+#: Modeled service time charged by the throttle, cumulative. Each
+#: take reports PAYLOAD / (charged delta) as ``model_gbps`` — the
+#: deterministic per-request cost of the elections the governor made
+#: on that take, before streaming's stage/write overlap hides any of
+#: it. Diagnostic only; the gates use wall throughput.
+_CHARGED = [0.0]
+
+
+def _throttle():
+    """Charge LATENCY_S + n/THROTTLE_BPS for every payload write
+    request (buffered write, or each sub-chunk of a streamed write),
+    through one rate lock per event loop. Telemetry/manifest artifacts
+    (any dot-prefixed path component) ride free — they are not the
+    storage tier under test and their tiny transfers would poison the
+    governor's measured-rate EWMA."""
+    import asyncio
+
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    rate_lock: list = [None, None]
+
+    def _is_payload(path: str) -> bool:
+        return not any(p.startswith(".") for p in path.split(os.sep))
+
+    async def _pay(n: int) -> None:
+        loop = asyncio.get_running_loop()
+        if rate_lock[1] is not loop:
+            rate_lock[0] = asyncio.Lock()
+            rate_lock[1] = loop
+        charge = LATENCY_S + n / THROTTLE_BPS
+        _CHARGED[0] += charge
+        async with rate_lock[0]:
+            await asyncio.sleep(charge)
+
+    orig_write = FSStoragePlugin.write
+
+    async def slow_write(self, write_io, _orig=orig_write):
+        await _orig(self, write_io)
+        if _is_payload(write_io.path):
+            await _pay(memoryview(write_io.buf).nbytes)
+
+    orig_stream = FSStoragePlugin.write_stream
+
+    async def slow_stream(self, stream, _orig=orig_stream):
+        if not _is_payload(stream.path):
+            await _orig(self, stream)
+            return
+        inner = stream.chunks
+
+        async def chunks():
+            async for c in inner:
+                await _pay(memoryview(c).nbytes)
+                yield c
+
+        stream.chunks = chunks()
+        await _orig(self, stream)
+
+    FSStoragePlugin.write = slow_write
+    FSStoragePlugin.write_stream = slow_stream
+
+
+def _build_state(np):
+    from torchsnapshot_tpu import StateDict
+
+    rng = np.random.default_rng(19)
+    return {
+        "model": StateDict(
+            **{
+                f"p{i}": rng.standard_normal(LEAF_BYTES // 4).astype(
+                    np.float32
+                )
+                for i in range(N_LEAVES)
+            }
+        )
+    }
+
+
+class _Env:
+    """Scoped env overrides (restore on exit)."""
+
+    def __init__(self, **kv):
+        self._kv = {k: v for k, v in kv.items()}
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self._kv.items():
+            self._saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return False
+
+
+def _timed_take(Snapshot, root, name, state):
+    """One take; returns (wall_gbps, model_gbps).
+
+    wall_gbps is the gate metric — real end-to-end rate, stable on the
+    tmpfs root. model_gbps divides the payload by the service time the
+    throttle charged for this take's requests: the deterministic
+    request-count consequence of the sub-chunk elections in effect,
+    reported as a diagnostic (see module docstring for why it is not
+    the gate).
+    """
+    path = os.path.join(root, name)
+    c0 = _CHARGED[0]
+    t0 = time.perf_counter()
+    Snapshot.take(path, state)
+    wall = time.perf_counter() - t0
+    charged = _CHARGED[0] - c0
+    shutil.rmtree(path, ignore_errors=True)
+    # Settle: the rmtree's reclaim otherwise lands inside the NEXT
+    # take's attribution windows and skews what the governor learns.
+    time.sleep(0.2)
+    return (
+        PAYLOAD_BYTES / wall / 1e9,
+        PAYLOAD_BYTES / charged / 1e9 if charged > 0 else float("nan"),
+    )
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, telemetry
+    from torchsnapshot_tpu.scheduler import io_governor, reset_io_governor
+
+    _throttle()
+    telemetry.set_enabled(True)
+    state = _build_state(np)
+    # Prefer tmpfs: real writes become memcpy, so the synthetic
+    # throttle dominates every measurement AND the governor's learning
+    # signal — /tmp here is disk-backed and its writeback stalls were
+    # drowning both.
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    base = tempfile.mkdtemp(prefix="autotune_bench_", dir=shm)
+    # The cold leg persists its learned profile into this root's
+    # journal; the warm leg saves under the SAME root so its governor
+    # warm-starts from it.
+    root = os.path.join(base, "ckpts")
+    os.makedirs(root)
+
+    pin_io = {
+        "TORCHSNAPSHOT_TPU_IO_CONCURRENCY": PINNED_IO_CONCURRENCY,
+        "TORCHSNAPSHOT_TPU_DISABLE_NATIVE": "1",
+        # Preverify hashing overlaps the streamed writes, and its
+        # windows are subtracted from the storage residual the governor
+        # scores trials by (fused-span accounting) — a confounder that
+        # biases the learned sub-chunk away from the wall optimum. Off
+        # on every leg: this bench isolates sub-chunk size against a
+        # latency-bound storage model, nothing else.
+        "TORCHSNAPSHOT_TPU_PREVERIFY": "never",
+    }
+    try:
+        # -------- leg 1: hand-tuned static pin (the reference) --------
+        reset_io_governor()
+        with _Env(
+            TORCHSNAPSHOT_TPU_AUTOTUNE="never",
+            TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES=HAND_SUB_CHUNK,
+            **pin_io,
+        ):
+            _timed_take(Snapshot, root, "warm_hand", state)  # discarded
+            hand = [
+                _timed_take(Snapshot, root, f"hand_{i}", state)
+                for i in range(TAKES_PER_LEG)
+            ]
+        hand_p50 = statistics.median(g for g, _ in hand)
+        report(
+            "autotune/hand",
+            {
+                "sub_chunk_mib": LEAF_BYTES >> 20,
+                "takes_gbps": [round(g, 4) for g, _ in hand],
+                "takes_model_gbps": [round(m, 4) for _, m in hand],
+                "p50_gbps": round(hand_p50, 4),
+            },
+            data_bytes=PAYLOAD_BYTES,
+        )
+
+        # -------- leg 2: measured-rate heuristic (the pathology) ------
+        reset_io_governor()
+        with _Env(TORCHSNAPSHOT_TPU_AUTOTUNE="never", **pin_io):
+            _timed_take(Snapshot, root, "warm_heur", state)  # feeds rates
+            heur = [
+                _timed_take(Snapshot, root, f"heur_{i}", state)
+                for i in range(TAKES_PER_LEG)
+            ]
+        heur_p50 = statistics.median(g for g, _ in heur)
+        report(
+            "autotune/heuristic",
+            {
+                "takes_gbps": [round(g, 4) for g, _ in heur],
+                "takes_model_gbps": [round(m, 4) for _, m in heur],
+                "p50_gbps": round(heur_p50, 4),
+                "vs_hand": round(heur_p50 / hand_p50, 4),
+            },
+            data_bytes=PAYLOAD_BYTES,
+        )
+
+        # -------- leg 3: cold-start learning --------------------------
+        reset_io_governor()
+        cold = []
+        with _Env(TORCHSNAPSHOT_TPU_AUTOTUNE="never", **pin_io):
+            # Two discarded warmups feed the rate tables so learning
+            # starts at the heuristic's real (bad) operating point.
+            _timed_take(Snapshot, root, "warm_cold0", state)
+            _timed_take(Snapshot, root, "warm_cold1", state)
+        with _Env(TORCHSNAPSHOT_TPU_AUTOTUNE="fresh", **pin_io):
+            for i in range(COLD_TAKES):
+                gbps, model_gbps = _timed_take(
+                    Snapshot, root, f"cold_{i}", state
+                )
+                profs = io_governor().profiles()
+                settings = {}
+                for rec in profs.values():
+                    settings.update(rec.get("settings") or {})
+                cold.append(
+                    {
+                        "take": i + 1,
+                        "gbps": round(gbps, 4),
+                        "model_gbps": round(model_gbps, 4),
+                        "vs_hand": round(gbps / hand_p50, 4),
+                        "settings": settings,
+                    }
+                )
+        ratios = [c["vs_hand"] for c in cold]
+        # Converged at the first take that ITSELF clears 90% of
+        # hand-tuned AND whose remaining takes hold a median above it:
+        # the median keeps an isolated dip (a trial probing away from
+        # the optimum, or a residual host stall) from un-converging a
+        # settled profile, while the point condition stops a lucky
+        # early take from claiming convergence the tail doesn't sustain.
+        converged_take = next(
+            (
+                i + 1
+                for i in range(len(ratios))
+                if ratios[i] >= CONVERGE_FRAC
+                and statistics.median(ratios[i:]) >= CONVERGE_FRAC
+            ),
+            None,
+        )
+        report(
+            "autotune/cold",
+            {
+                "takes": cold,
+                "converged_take": converged_take,
+                "budget_takes": CONVERGE_WITHIN,
+                "profiles": io_governor().profiles(),
+            },
+            data_bytes=PAYLOAD_BYTES,
+        )
+
+        # -------- leg 4: warm start (a "new process") -----------------
+        # Three independent "new processes": each iteration resets the
+        # governor and measures its true FIRST take (profiles loaded
+        # from the journal at op entry, no learning before the take).
+        # The gate is the median of the three first-takes — a single
+        # host stall must not flunk a correct warm start.
+        warm_firsts = []
+        with _Env(TORCHSNAPSHOT_TPU_AUTOTUNE="auto", **pin_io):
+            for i in range(3):
+                reset_io_governor()
+                warm_firsts.append(
+                    _timed_take(Snapshot, root, f"warm_{i}", state)
+                )
+        warm_p50 = statistics.median(g for g, _ in warm_firsts)
+        warm_first_ratio = warm_p50 / hand_p50
+        report(
+            "autotune/warm",
+            {
+                "first_takes_gbps": [round(g, 4) for g, _ in warm_firsts],
+                "first_takes_model_gbps": [
+                    round(m, 4) for _, m in warm_firsts
+                ],
+                "first_p50_gbps": round(warm_p50, 4),
+                "first_vs_hand_p50": round(warm_first_ratio, 4),
+                "floor": WARM_FLOOR,
+            },
+            data_bytes=PAYLOAD_BYTES,
+        )
+
+        summary = {
+            "payload_mib": PAYLOAD_BYTES >> 20,
+            "latency_ms": LATENCY_S * 1e3,
+            "throttle_mb_s": THROTTLE_BPS / 1e6,
+            "hand_p50_gbps": round(hand_p50, 4),
+            "heuristic_p50_gbps": round(heur_p50, 4),
+            "heuristic_vs_hand": round(heur_p50 / hand_p50, 4),
+            "cold_takes_gbps": [c["gbps"] for c in cold],
+            "cold_converged_take": converged_take,
+            "cold_budget_takes": CONVERGE_WITHIN,
+            "warm_first_p50_gbps": round(warm_p50, 4),
+            "warm_first_vs_hand_p50": round(warm_first_ratio, 4),
+            "warm_floor": WARM_FLOOR,
+        }
+        report("autotune/summary", summary, data_bytes=PAYLOAD_BYTES)
+
+        assert converged_take is not None and converged_take <= CONVERGE_WITHIN, (
+            f"cold start did not converge to within 10% of hand-tuned "
+            f"within {CONVERGE_WITHIN} takes (sustained from "
+            f"{converged_take}; ratios {ratios})"
+        )
+        assert warm_first_ratio >= WARM_FLOOR, (
+            f"warm-start first take {warm_p50:.3f} GB/s is "
+            f"{warm_first_ratio:.2f}x the hand-tuned p50 "
+            f"{hand_p50:.3f} GB/s (floor {WARM_FLOOR}x)"
+        )
+    finally:
+        telemetry.set_enabled(False)
+        reset_io_governor()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

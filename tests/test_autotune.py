@@ -1,0 +1,708 @@
+"""Closed-loop autotune (autotune.py + the IOGovernor election sites):
+rate smoothing, gate hysteresis across every ``should_*`` knee, the
+perturb/score/revert controller under noisy verdicts, profile
+persistence, and the unattributed-verdict skip path."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from torchsnapshot_tpu import telemetry
+from torchsnapshot_tpu.autotune import AutoTuner, profile_key
+from torchsnapshot_tpu.scheduler import (
+    _DEFAULT_SUB_CHUNK_BYTES,
+    _IO_CONCURRENCY_CAP,
+    _KNEE_MARGIN,
+    _NATIVE_FALLBACK_MARGIN,
+    _PREVERIFY_READ_MARGIN,
+    _STREAM_READ_LATENCY_BPS,
+    IOGovernor,
+)
+from torchsnapshot_tpu.telemetry import history
+
+MB = 1 << 20
+
+_ELECTION_ENV = (
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES",
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_MIN_BYTES",
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_MAX_BYTES",
+    "TORCHSNAPSHOT_TPU_IO_CONCURRENCY",
+    "TORCHSNAPSHOT_TPU_PREVERIFY",
+    "TORCHSNAPSHOT_TPU_AUTOTUNE",
+)
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    """Elections see no ambient overrides; individual tests opt knobs
+    back in with monkeypatch.setenv."""
+    for var in _ELECTION_ENV:
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch
+
+
+@pytest.fixture
+def heuristic_env(clean_env):
+    """Pure measured-rate heuristics: autotune off isolates the gate
+    logic from the tuner plane (and proves ``never`` keeps it intact)."""
+    clean_env.setenv("TORCHSNAPSHOT_TPU_AUTOTUNE", "never")
+    return clean_env
+
+
+def _set_read(gov, plugin, bps):
+    with gov._lock:
+        gov._read_bps[plugin] = bps
+
+
+def _set_write(gov, plugin, bps):
+    with gov._lock:
+        gov._write_bps[plugin] = bps
+
+
+# ------------------------------------------------------- rate smoothing
+
+
+def test_ewma_first_sample_is_taken_verbatim(heuristic_env):
+    gov = IOGovernor()
+    gov.record_write("fs", 1 << 30, 1.0)
+    assert gov.write_bps("fs") == pytest.approx(1 << 30)
+    gov.record_read("fs", 1 << 30, 2.0)
+    assert gov.read_bps("fs") == pytest.approx((1 << 30) / 2.0)
+    gov.record_hash(1 << 30, 4.0)
+    assert gov.hash_bps() == pytest.approx((1 << 30) / 4.0)
+
+
+def test_ewma_alpha_half_smoothing(heuristic_env):
+    gov = IOGovernor()
+    gov.record_write("fs", 1 << 30, 1.0)  # 1 GiB/s
+    gov.record_write("fs", 1 << 30, 0.25)  # 4 GiB/s sample
+    # prev + 0.5 * (sample - prev) = 2.5 GiB/s
+    assert gov.write_bps("fs") == pytest.approx(2.5 * (1 << 30))
+    # One anomalous sample moves the rate halfway at most.
+    gov.record_write("fs", 1 << 30, 100.0)
+    assert gov.write_bps("fs") > 1.25 * (1 << 30)
+
+
+def test_ewma_rejects_degenerate_samples(heuristic_env):
+    gov = IOGovernor()
+    gov.record_write("fs", 0, 1.0)
+    gov.record_write("fs", 1 << 20, 0.0)
+    gov.record_read("fs", -1, 1.0)
+    assert gov.write_bps("fs") is None
+    assert gov.read_bps("fs") is None
+
+
+def test_rates_are_per_plugin(heuristic_env):
+    gov = IOGovernor()
+    gov.record_write("fs", 1 << 30, 1.0)
+    gov.record_write("gcs", 1 << 27, 1.0)
+    assert gov.write_bps("fs") == pytest.approx(1 << 30)
+    assert gov.write_bps("gcs") == pytest.approx(1 << 27)
+    assert gov.write_bps() == pytest.approx(1 << 30)  # best-known
+
+
+# ------------------------------------------- gate hysteresis at the knee
+
+
+def test_preverify_gate_crosses_knee_both_ways_without_flip_flop(
+    heuristic_env,
+):
+    gov = IOGovernor()
+    # No evidence: verify (the zero-byte path).
+    assert gov.should_preverify("fs") is True
+    gov.record_hash(1 << 30, 1.0 * (1 << 30) / 1e9)  # hash at 1 GB/s
+    knee = 1e9 * _PREVERIFY_READ_MARGIN  # 1.25 GB/s crossover
+
+    _set_read(gov, "fs", 2.0e9)  # reads clearly cheaper than hashing
+    assert gov.should_preverify("fs") is False
+    # Jitter back inside the dead band: no flip.
+    _set_read(gov, "fs", knee * (1.0 - _KNEE_MARGIN / 2))
+    assert gov.should_preverify("fs") is False
+    # Clearly below the band: verify again.
+    _set_read(gov, "fs", knee * (1.0 - 2 * _KNEE_MARGIN))
+    assert gov.should_preverify("fs") is True
+    # Jitter above the knee but inside the band: still no flip.
+    _set_read(gov, "fs", knee * (1.0 + _KNEE_MARGIN / 2))
+    assert gov.should_preverify("fs") is True
+    # Clearly above: skip the verify pass.
+    _set_read(gov, "fs", knee * (1.0 + 2 * _KNEE_MARGIN))
+    assert gov.should_preverify("fs") is False
+
+
+def test_preverify_env_overrides_beat_measurement(clean_env):
+    gov = IOGovernor()
+    gov.record_hash(1 << 30, 1.0)
+    _set_read(gov, "fs", 100e9)  # measurement says skip
+    clean_env.setenv("TORCHSNAPSHOT_TPU_PREVERIFY", "always")
+    assert gov.should_preverify("fs") is True
+    clean_env.setenv("TORCHSNAPSHOT_TPU_PREVERIFY", "never")
+    assert gov.should_preverify("fs") is False
+
+
+def test_native_write_gate_optimistic_then_deposed_then_recovers(
+    heuristic_env,
+):
+    gov = IOGovernor()
+    # Unmeasured: optimistic (queued SQEs are never worse than pwrite).
+    assert gov.should_native_io("fs", op="write") is True
+    _set_write(gov, "fs", 1.0e9)
+    assert gov.should_native_io("fs", op="write") is True  # native unmeasured
+    _set_write(gov, "fs.native", _NATIVE_FALLBACK_MARGIN * 1.0e9 - 1e6)
+    assert gov.should_native_io("fs", op="write") is False  # clearly below
+    _set_write(gov, "fs.native", 0.9e9)
+    assert gov.should_native_io("fs", op="write") is True  # recovers
+
+
+def test_native_read_gate_engages_only_on_latency_bound_storage(
+    heuristic_env,
+):
+    gov = IOGovernor()
+    # No measured base rate: no evidence, Python path.
+    assert gov.should_native_io("fs", op="read") is False
+    knee = _STREAM_READ_LATENCY_BPS
+    _set_read(gov, "fs.native", 10e9)  # engine itself looks great
+    _set_read(gov, "fs", 2 * knee)  # memcpy-speed local reads
+    assert gov.should_native_io("fs", op="read") is False
+    _set_read(gov, "fs", 0.5 * knee)  # latency-bound storage
+    assert gov.should_native_io("fs", op="read") is True
+    # Band: hovering just above the knee must not flip it off...
+    _set_read(gov, "fs", knee * (1.0 + _KNEE_MARGIN / 2))
+    assert gov.should_native_io("fs", op="read") is True
+    # ...but clearly crossing it must.
+    _set_read(gov, "fs", knee * (1.0 + 2 * _KNEE_MARGIN))
+    assert gov.should_native_io("fs", op="read") is False
+    # And just below the knee stays off until clearly below the band.
+    _set_read(gov, "fs", knee * (1.0 - _KNEE_MARGIN / 2))
+    assert gov.should_native_io("fs", op="read") is False
+    _set_read(gov, "fs", knee * (1.0 - 2 * _KNEE_MARGIN))
+    assert gov.should_native_io("fs", op="read") is True
+
+
+def test_native_read_gate_deposes_slow_engine_even_when_latency_bound(
+    heuristic_env,
+):
+    gov = IOGovernor()
+    base = 0.5 * _STREAM_READ_LATENCY_BPS
+    _set_read(gov, "fs", base)
+    assert gov.should_native_io("fs", op="read") is True  # engine unmeasured
+    _set_read(gov, "fs.native", _NATIVE_FALLBACK_MARGIN * base - 1e6)
+    assert gov.should_native_io("fs", op="read") is False
+    _set_read(gov, "fs.native", _NATIVE_FALLBACK_MARGIN * base + 1e6)
+    assert gov.should_native_io("fs", op="read") is True
+
+
+@pytest.mark.parametrize(
+    "gate", ["should_coop_restore", "should_planned_reshard", "should_seed_restore"]
+)
+def test_latency_knee_gates_cross_both_ways_without_flip_flop(
+    heuristic_env, gate
+):
+    gov = IOGovernor()
+    decide = getattr(gov, gate)
+    # No recorded read rate: no evidence, the status quo stays.
+    assert decide("fs") is False
+    knee = _STREAM_READ_LATENCY_BPS
+    _set_read(gov, "fs", 0.5 * knee)
+    assert decide("fs") is True  # storage-bandwidth-bound: fan out
+    _set_read(gov, "fs", knee * (1.0 + _KNEE_MARGIN / 2))
+    assert decide("fs") is True  # inside the dead band: no flip
+    _set_read(gov, "fs", knee * (1.0 + 2 * _KNEE_MARGIN))
+    assert decide("fs") is False  # clearly memcpy-speed: direct reads
+    _set_read(gov, "fs", knee * (1.0 - _KNEE_MARGIN / 2))
+    assert decide("fs") is False  # inside the band from below: no flip
+    _set_read(gov, "fs", knee * (1.0 - 2 * _KNEE_MARGIN))
+    assert decide("fs") is True
+
+
+def test_knee_gate_bands_are_independent_per_gate_and_plugin(
+    heuristic_env,
+):
+    gov = IOGovernor()
+    knee = _STREAM_READ_LATENCY_BPS
+    _set_read(gov, "fs", 0.5 * knee)
+    assert gov.should_coop_restore("fs") is True
+    # A different plugin at the same rate decides from scratch — and a
+    # different gate on the same plugin keeps its own dead band.
+    _set_read(gov, "gcs", 2 * knee)
+    assert gov.should_coop_restore("gcs") is False
+    _set_read(gov, "fs", knee * (1.0 + _KNEE_MARGIN / 2))
+    assert gov.should_coop_restore("fs") is True  # banded (prior decision)
+    # seed_restore has no prior decision for fs: first call compares the
+    # raw knee, so the same rate decides False.
+    assert gov.should_seed_restore("fs") is False
+
+
+# ------------------------------------------------- election precedence
+
+
+def _profile_records(settings, plugin="fs", world=1, binding="storage_write"):
+    return [
+        {
+            "type": "profile",
+            "plugin": plugin,
+            "world_size": world,
+            "binding": binding,
+            "settings": settings,
+            "score_gbps": 1.0,
+            "takes": 5,
+            "op": "write",
+        }
+    ]
+
+
+def test_sub_chunk_env_pin_beats_learned_profile(clean_env):
+    gov = IOGovernor()
+    gov._tuner.load(_profile_records({"sub_chunk.write": 32 * MB}))
+    assert gov.sub_chunk_bytes("fs", op="write") == 32 * MB  # profile
+    clean_env.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES", str(12345))
+    assert gov.sub_chunk_bytes("fs", op="write") == 12345  # env wins
+
+
+def test_sub_chunk_learned_profile_beats_heuristic(clean_env):
+    gov = IOGovernor()
+    _set_write(gov, "fs", 2e9)  # heuristic would size ~100 MB windows
+    gov._tuner.load(_profile_records({"sub_chunk.write": 16 * MB}))
+    assert gov.sub_chunk_bytes("fs", op="write") == 16 * MB
+    # never: the learned profile is ignored, heuristics return.
+    clean_env.setenv("TORCHSNAPSHOT_TPU_AUTOTUNE", "never")
+    assert gov.sub_chunk_bytes("fs", op="write") == int(2e9 * 0.05) // MB * MB
+
+
+def test_sub_chunk_learned_value_clamped_into_env_bounds(clean_env):
+    gov = IOGovernor()
+    gov._tuner.load(_profile_records({"sub_chunk.write": 1 * MB}))
+    # Default floor is 8 MB: a profile learned under other bounds clamps.
+    assert gov.sub_chunk_bytes("fs", op="write") == 8 * MB
+    clean_env.setenv("TORCHSNAPSHOT_TPU_SUB_CHUNK_MIN_BYTES", str(MB))
+    assert gov.sub_chunk_bytes("fs", op="write") == 1 * MB
+
+
+def test_sub_chunk_heuristic_defaults_without_measurement(heuristic_env):
+    gov = IOGovernor()
+    assert gov.sub_chunk_bytes("fs", op="write") == _DEFAULT_SUB_CHUNK_BYTES
+
+
+def test_io_concurrency_precedence_env_profile_heuristic(clean_env):
+    gov = IOGovernor()
+    gov._tuner.load(_profile_records({"io_concurrency.write": 64}))
+    # Learned values respect the designed-for cap...
+    assert gov.io_concurrency("write", "fs") == _IO_CONCURRENCY_CAP
+    # ...an explicit env pin may exceed it.
+    clean_env.setenv("TORCHSNAPSHOT_TPU_IO_CONCURRENCY", "64")
+    assert gov.io_concurrency("write", "fs") == 64
+
+
+def test_io_concurrency_heuristic_rates(heuristic_env):
+    gov = IOGovernor()
+    default = gov.io_concurrency("write", "fs")
+    assert 1 <= default <= 16
+    _set_write(gov, "fs", 5e7)  # latency-bound network storage
+    assert gov.io_concurrency("write", "fs") == 16
+    _set_write(gov, "fs", 5e9)  # bandwidth-bound local storage
+    assert gov.io_concurrency("write", "fs") <= default
+
+
+# --------------------------------------- perturb / score / revert loop
+
+
+DIMS = {
+    "sub_chunk.write": {
+        "value": 64 * MB,
+        "kind": "geom",
+        "lo": 8 * MB,
+        "hi": 256 * MB,
+        "quantum": MB,
+    }
+}
+
+
+def test_tuner_arms_only_against_a_fresh_scored_incumbent():
+    tuner = AutoTuner()
+    # Cold: no binding verdict yet, nothing to experiment against.
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is None
+    r = tuner.observe("write", "fs", "storage_write", 1.0)
+    assert r["verdict"] == "scored"
+    trial = tuner.maybe_arm("write", "fs", dict(DIMS))
+    assert trial is not None and trial["dim"] == "sub_chunk.write"
+    assert trial["value"] == 128 * MB  # geometric step, initial climb up
+    # Exactly one perturbation process-wide.
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is None
+
+
+def test_tuner_kept_adopts_and_chains():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    tuner.maybe_arm("write", "fs", dict(DIMS))
+    r = tuner.observe("write", "fs", "storage_write", 1.2)  # beats +5% band
+    assert r["verdict"] == "kept"
+    assert r["settings"]["sub_chunk.write"] == 128 * MB
+    assert r["score"] == pytest.approx(1.1)  # alpha-0.5 fold
+    # A keep is itself a measurement at the adopted settings: the next
+    # trial arms immediately (fast climb out of a bad region).
+    trial = tuner.maybe_arm(
+        "write", "fs", {"sub_chunk.write": dict(DIMS["sub_chunk.write"], value=128 * MB)}
+    )
+    assert trial is not None and trial["value"] == 256 * MB
+
+
+def test_tuner_reverted_keeps_incumbent_flips_direction_and_rebaselines():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    tuner.maybe_arm("write", "fs", dict(DIMS))  # trial 128 MB
+    r = tuner.observe("write", "fs", "storage_write", 0.5)  # clearly worse
+    assert r["verdict"] == "reverted"
+    assert "sub_chunk.write" not in r["settings"]  # incumbent stays
+    assert r["score"] == pytest.approx(1.0)  # degraded rate NOT folded in
+    # A/B pacing: no new trial until a clean take re-baselines the score.
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is None
+    r = tuner.observe("write", "fs", "storage_write", 1.0)
+    assert r["verdict"] == "scored"
+    trial = tuner.maybe_arm("write", "fs", dict(DIMS))
+    assert trial is not None and trial["value"] == 32 * MB  # direction flipped
+
+
+def test_tuner_neutral_refreshes_score_without_moving_settings():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    tuner.maybe_arm("write", "fs", dict(DIMS))
+    r = tuner.observe("write", "fs", "storage_write", 1.02)  # inside ±5%
+    assert r["verdict"] == "neutral"
+    assert "sub_chunk.write" not in r["settings"]
+    assert r["score"] == pytest.approx(1.01)  # rate still folds in
+
+
+def test_tuner_arm_false_never_unlocks_trials():
+    tuner = AutoTuner()
+    # A pipeline-bound verdict scores but does not open the experiment:
+    # perturbing storage knobs cannot improve an op staging is gating.
+    tuner.observe("write", "fs", "stage_copy", 1.0, arm=False)
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is None
+    # A storage-bound verdict unlocks it.
+    tuner.observe("write", "fs", "stage_copy", 1.0, arm=True)
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is not None
+
+
+def test_tuner_kept_with_arm_false_does_not_chain():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    tuner.maybe_arm("write", "fs", dict(DIMS))
+    r = tuner.observe("write", "fs", "storage_write", 1.5, arm=False)
+    assert r["verdict"] == "kept"
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is None
+
+
+def test_tuner_aborts_when_binding_flips_under_the_experiment():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    tuner.maybe_arm("write", "fs", dict(DIMS))
+    # The verdict scores a different profile than the trial perturbed.
+    r = tuner.observe("write", "fs", "collective_wait", 5.0)
+    assert r["verdict"] == "aborted"
+    assert "sub_chunk.write" not in tuner.profiles()[r["key"]]["settings"]
+    old = profile_key("fs", 1, "storage_write")
+    assert tuner.profiles()[old]["settings"] == {}
+
+
+def test_tuner_explicit_abort_discards_the_trial():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    assert tuner.maybe_arm("write", "fs", dict(DIMS)) is not None
+    assert tuner.abort_trial("write", "fs") is True
+    assert tuner.active_trial() is None
+    assert tuner.abort_trial("write", "fs") is False
+
+
+def test_tuner_pin_mode_refreshes_binding_but_never_learns():
+    tuner = AutoTuner()
+    r = tuner.observe("write", "fs", "storage_write", 1.0, learn=False)
+    assert r["verdict"] == "pinned"
+    assert tuner.profiles() == {}
+    # The binding memory still lets profile keys resolve.
+    assert tuner.key_for("fs", "write") == profile_key("fs", 1, "storage_write")
+
+
+def test_tuner_converges_to_the_optimum_under_noisy_verdicts():
+    """Deterministic end-to-end climb: a synthetic landscape peaking at
+    64 MB, multiplicative noise inside the hysteresis band. The climber
+    must reach the peak and then hold it — reverted/neutral trials only,
+    no flip-flop."""
+    landscape = {8: 0.25, 16: 0.5, 32: 0.8, 64: 1.0, 128: 0.7, 256: 0.65}
+    rng = random.Random(0)
+    tuner = AutoTuner()
+    key = profile_key("fs", 1, "storage_write")
+
+    def current_setting():
+        state = tuner.profiles().get(key, {"settings": {}})
+        return state["settings"].get("sub_chunk.write", 8 * MB)
+
+    def measure(nbytes):
+        noise = 1.0 + rng.uniform(-0.03, 0.03)
+        return landscape[nbytes // MB] * noise
+
+    verdicts = []
+    tuner.observe("write", "fs", "storage_write", measure(8 * MB))
+    for _ in range(30):
+        setting = current_setting()
+        dims = {"sub_chunk.write": dict(DIMS["sub_chunk.write"], value=setting)}
+        trial = tuner.maybe_arm("write", "fs", dims)
+        effective = trial["value"] if trial is not None else setting
+        r = tuner.observe("write", "fs", "storage_write", measure(effective))
+        verdicts.append(r["verdict"])
+
+    assert current_setting() == 64 * MB
+    score = tuner.profiles()[key]["score_gbps"]
+    assert score == pytest.approx(1.0, rel=0.1)
+    # Converged: the tail probes both directions, rejects both, and the
+    # incumbent never moves again.
+    tail = verdicts[-8:]
+    assert "kept" in verdicts
+    assert all(v in ("reverted", "neutral", "scored") for v in tail)
+
+
+def test_tuner_toggle_dimension_flips_the_engine_choice():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    dims = {"native.write": {"value": True, "kind": "toggle"}}
+    trial = tuner.maybe_arm("write", "fs", dims)
+    assert trial is not None and trial["value"] is False
+    r = tuner.observe("write", "fs", "storage_write", 1.2)
+    assert r["verdict"] == "kept"
+    assert r["settings"]["native.write"] is False
+
+
+def test_tuner_round_robin_cycles_dimensions():
+    tuner = AutoTuner()
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    dims = dict(
+        DIMS,
+        **{"io_concurrency.write": {"value": 8, "kind": "geom", "lo": 1, "hi": 32, "quantum": 1}},
+    )
+    first = tuner.maybe_arm("write", "fs", dims)
+    tuner.observe("write", "fs", "storage_write", 1.0)  # neutral
+    tuner.observe("write", "fs", "storage_write", 1.0)  # re-baseline
+    second = tuner.maybe_arm("write", "fs", dims)
+    assert {first["dim"], second["dim"]} == {
+        "sub_chunk.write",
+        "io_concurrency.write",
+    }
+
+
+# ------------------------------------------------- profile persistence
+
+
+def test_profile_record_roundtrip_through_the_history_journal(tmp_path):
+    tuner = AutoTuner()
+    tuner.note_world(4)
+    tuner.observe("write", "fs", "storage_write", 1.0)
+    tuner.maybe_arm("write", "fs", dict(DIMS))
+    tuner.observe("write", "fs", "storage_write", 1.3)  # kept: 128 MB
+    key = profile_key("fs", 4, "storage_write")
+    record = tuner.profile_record(key)
+    assert record is not None and record["type"] == "profile"
+    # No wall_s: the trend/regression reader must never see profiles.
+    assert "wall_s" not in record
+    record["op"] = "write"
+    assert history.append_record(str(tmp_path), record)
+    history.append_record(
+        str(tmp_path),
+        {"ts": 1.0, "op": "take", "snapshot": "s", "wall_s": 2.0},
+    )
+
+    assert [r["wall_s"] for r in history.load_history(str(tmp_path))] == [2.0]
+    profiles = history.load_profiles(str(tmp_path))
+    assert len(profiles) == 1
+
+    warm = AutoTuner()
+    warm.note_world(4)
+    assert warm.load(profiles) == 1
+    # The binding memory was re-seeded: the first op of the new process
+    # resolves the learned value before any verdict is observed.
+    assert warm.resolve("sub_chunk.write", "fs", "write") == (
+        128 * MB,
+        "profile",
+    )
+    assert warm.profiles()[key]["score_gbps"] == pytest.approx(1.15)
+
+
+def test_profile_load_last_record_per_key_wins():
+    tuner = AutoTuner()
+    records = _profile_records({"sub_chunk.write": 16 * MB}) + _profile_records(
+        {"sub_chunk.write": 32 * MB}
+    )
+    assert tuner.load(records) == 2
+    assert tuner.resolve("sub_chunk.write", "fs", "write") == (32 * MB, "profile")
+
+
+def test_profile_load_skips_malformed_records():
+    tuner = AutoTuner()
+    assert (
+        tuner.load(
+            [
+                {"type": "profile", "plugin": "fs"},  # no binding
+                {"type": "profile", "binding": "storage_write"},  # no plugin
+                {"type": "profile", "plugin": "fs", "binding": None},
+                {"type": "take", "wall_s": 1.0},
+                "garbage",
+            ]
+        )
+        == 0
+    )
+    assert tuner.profiles() == {}
+
+
+def test_governor_warm_start_loads_once_per_root(clean_env, tmp_path):
+    source = AutoTuner()
+    source.observe("write", "fs", "storage_write", 1.0)
+    source.maybe_arm("write", "fs", dict(DIMS))
+    source.observe("write", "fs", "storage_write", 1.3)
+    record = source.profile_record(profile_key("fs", 1, "storage_write"))
+    record["op"] = "write"
+    history.append_record(str(tmp_path), record)
+
+    gov = IOGovernor()
+    assert gov.load_profiles(str(tmp_path)) == 1
+    assert gov.load_profiles(str(tmp_path)) == 0  # once per root
+    assert gov.sub_chunk_bytes("fs", op="write") == 128 * MB
+    # fresh mode relearns from scratch: stored profiles are ignored.
+    clean_env.setenv("TORCHSNAPSHOT_TPU_AUTOTUNE", "fresh")
+    fresh = IOGovernor()
+    assert fresh.load_profiles(str(tmp_path)) == 0
+    assert fresh.sub_chunk_bytes("fs", op="write") == _DEFAULT_SUB_CHUNK_BYTES
+
+
+# --------------------------------------------- verdict feedback (gov)
+
+
+@pytest.fixture
+def live_telemetry():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.set_enabled(False)
+
+
+def test_observe_verdict_skips_unattributed_ops(clean_env, live_telemetry):
+    gov = IOGovernor()
+    gov.observe_verdict("take", "fs", 1, attribution=None)
+    gov.observe_verdict("take", "fs", 1, attribution={"binding": {}})
+    gov.observe_verdict(
+        "take", "fs", 1, attribution={"binding": {"category": "storage_write"}}
+    )  # category but no rate: still no evidence
+    assert telemetry.counters().get("profile_skips") == 3
+    # Nothing learned: a None binding never became a profile key.
+    assert gov.profiles() == {}
+
+
+def test_observe_verdict_learns_and_persists_on_rank_zero(
+    clean_env, live_telemetry, tmp_path
+):
+    gov = IOGovernor()
+    gov.observe_verdict(
+        "take",
+        "fs",
+        2,
+        attribution={"binding": {"category": "storage_write", "gbps": 1.0}},
+        root=str(tmp_path),
+        rank=0,
+    )
+    key = profile_key("fs", 2, "storage_write")
+    assert gov.profiles()[key]["score_gbps"] == pytest.approx(1.0)
+    assert len(history.load_profiles(str(tmp_path))) == 1
+    # Non-zero ranks learn in memory but never write the journal.
+    gov.observe_verdict(
+        "take",
+        "fs",
+        2,
+        attribution={"binding": {"category": "storage_write", "gbps": 1.0}},
+        root=str(tmp_path),
+        rank=1,
+    )
+    assert len(history.load_profiles(str(tmp_path))) == 1
+
+
+def test_observe_verdict_scores_by_aggregate_wall_rate(clean_env):
+    """The binding window's busy rate is a fused-span residual; the
+    score must track the operator's clock (bytes over the op wall)."""
+    gov = IOGovernor()
+    gov.observe_verdict(
+        "take",
+        "fs",
+        1,
+        attribution={"binding": {"category": "storage_write", "gbps": 9.0}},
+        aggregate={"write_gbps": 2.0},
+    )
+    key = profile_key("fs", 1, "storage_write")
+    assert gov.profiles()[key]["score_gbps"] == pytest.approx(2.0)
+
+
+def test_observe_verdict_arms_only_storage_bound_categories(clean_env):
+    gov = IOGovernor()
+    gov.observe_verdict(
+        "take",
+        "fs",
+        1,
+        attribution={"binding": {"category": "stage_copy", "gbps": 1.0}},
+    )
+    assert gov._tuner._states[profile_key("fs", 1, "stage_copy")].fresh is False
+    gov.observe_verdict(
+        "take",
+        "fs",
+        1,
+        attribution={"binding": {"category": "storage_write", "gbps": 1.0}},
+    )
+    assert gov._tuner._states[profile_key("fs", 1, "storage_write")].fresh is True
+
+
+def test_real_take_learns_a_profile_and_explain_renders_it(
+    clean_env, live_telemetry, tmp_path, capsys
+):
+    """End-to-end: a committed take under ``auto`` persists a profile
+    record into the root's history journal, and ``explain --profiles``
+    renders the decision trail from it."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.cli import main
+    from torchsnapshot_tpu.scheduler import reset_io_governor
+
+    reset_io_governor()
+    state = {"model": StateDict(w=np.arange(200_000, dtype=np.float32))}
+    Snapshot.take(str(tmp_path / "step_0000000001"), state)
+    Snapshot.take(str(tmp_path / "step_0000000002"), state)
+    records = history.load_profiles(str(tmp_path))
+    assert records, "a committed take under auto must persist a profile"
+    assert all(r["type"] == "profile" for r in records)
+    assert all(r["binding"] for r in records)
+    # The trend reader must not see them.
+    assert all("wall_s" in r for r in history.load_history(str(tmp_path)))
+
+    assert main(["explain", "--profiles", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "learned profiles" in out
+    key = records[-1]
+    assert f"{key['plugin']}|w{key['world_size']}|{key['binding']}" in out
+    reset_io_governor()
+
+
+def test_explain_profiles_errors_cleanly_without_a_journal(
+    clean_env, tmp_path, capsys
+):
+    from torchsnapshot_tpu.cli import main
+
+    assert main(["explain", "--profiles", str(tmp_path / "nowhere")]) == 2
+    assert "no learned profiles" in capsys.readouterr().err
+
+
+def test_observe_verdict_never_mode_is_one_env_check(clean_env):
+    clean_env.setenv("TORCHSNAPSHOT_TPU_AUTOTUNE", "never")
+    gov = IOGovernor()
+    gov.observe_verdict(
+        "take",
+        "fs",
+        1,
+        attribution={"binding": {"category": "storage_write", "gbps": 1.0}},
+    )
+    assert gov.profiles() == {}

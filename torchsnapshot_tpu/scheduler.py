@@ -81,6 +81,7 @@ from typing import Any, Dict, List, Optional, Set
 import psutil
 
 from . import faultinject, telemetry
+from . import autotune as _autotune
 from .telemetry import forensics
 from .io_types import (
     ReadIO,
@@ -174,6 +175,41 @@ _PREVERIFY_READ_MARGIN = 1.25
 # clearly below the plugin's non-native rate — hysteresis against the
 # two meters' different windows (whole pipeline vs one stream).
 _NATIVE_FALLBACK_MARGIN = 0.75
+# Dead band around a boolean gate's knee: once a should_* election is
+# made, the measured rate must cross the knee by this fraction to flip
+# it back — a rate hovering at the knee (EWMA jitter) must not flip-flop
+# a fast path on and off between consecutive ops.
+_KNEE_MARGIN = 0.10
+# Hard cap for tuned/heuristic I/O concurrency: the autotuner's climb
+# must stay inside the range the pipeline was designed for (an explicit
+# env pin may still exceed it).
+_IO_CONCURRENCY_CAP = 32
+
+# The closed-loop autotune mode parser lives with the controller
+# (autotune.py); re-exported here because the governor is its consumer.
+AUTOTUNE_ENV_VAR = _autotune.AUTOTUNE_ENV_VAR
+autotune_mode = _autotune.autotune_mode
+
+#: Every env knob consulted by an IOGovernor election site — the knobs
+#: whose role shifted from "the tuning interface" to "operator override
+#: above the learned profiles". The envreg tsalint pass cross-checks
+#: this set against ENV_GOVERNANCE (analysis/plugins/envreg.py): each
+#: knob must declare whether it overrides elections, bounds them, or
+#: switches the tuner itself.
+ELECTION_KNOBS = frozenset({
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_BYTES",
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_MIN_BYTES",
+    "TORCHSNAPSHOT_TPU_SUB_CHUNK_MAX_BYTES",
+    "TORCHSNAPSHOT_TPU_IO_CONCURRENCY",
+    "TORCHSNAPSHOT_TPU_PREVERIFY",
+    "TORCHSNAPSHOT_TPU_STREAM_READS",
+    "TORCHSNAPSHOT_TPU_STREAM_WRITES",
+    "TORCHSNAPSHOT_TPU_NATIVE_IO",
+    "TORCHSNAPSHOT_TPU_COOP_RESTORE",
+    "TORCHSNAPSHOT_TPU_RESHARD",
+    "TORCHSNAPSHOT_TPU_SEED_RESTORE",
+    "TORCHSNAPSHOT_TPU_AUTOTUNE",
+})
 
 
 class IOGovernor:
@@ -206,6 +242,16 @@ class IOGovernor:
     Rates are exponentially smoothed (alpha 0.5): one anomalous save
     (page-cache flush, noisy neighbor) moves a tunable halfway at most,
     and the next clean measurement pulls it back.
+
+    **Closed loop** (ROADMAP item 4, ``TORCHSNAPSHOT_TPU_AUTOTUNE``):
+    every election site resolves env override -> learned profile ->
+    measured-rate heuristic, through one shared :class:`autotune.
+    Election` record. The controller (autotune.AutoTuner) perturbs at
+    most one tunable per operation, scores it against the critical-path
+    verdict fed back by ``observe_verdict`` after commit, and persists
+    converged settings per ``(storage class, world size, binding
+    category)`` into the root's history journal — ``load_profiles``
+    warm-starts a fresh process from them.
     """
 
     _EWMA_ALPHA = 0.5
@@ -215,6 +261,15 @@ class IOGovernor:
         self._write_bps: Dict[str, float] = {}
         self._read_bps: Dict[str, float] = {}
         self._hash_bps: Optional[float] = None
+        self._tuner = _autotune.AutoTuner()
+        #: Last Election per (dim, plugin): the decision-change detector
+        #: that keeps ``governor.elect`` flight events to transitions
+        #: (io_concurrency is consulted inside dispatch loops).
+        self._elections: Dict[Any, _autotune.Election] = {}
+        #: Boolean gate memory for the knee dead band (_banded).
+        self._gate_state: Dict[Any, bool] = {}
+        #: Roots whose profile records were already loaded (once each).
+        self._profile_roots: Set[str] = set()
 
     # ------------------------------------------------------- recording
 
@@ -275,64 +330,169 @@ class IOGovernor:
                 "hash_bps": self._hash_bps,
             }
 
+    # -------------------------------------------------- election plumbing
+
+    def _resolved(
+        self,
+        site: str,
+        dim: str,
+        plugin: Optional[str],
+        value: Any,
+        source: str,
+        **inputs: Any,
+    ) -> Any:
+        """Every election site funnels its decision through here: one
+        shared :class:`autotune.Election` record per (dim, plugin), a
+        ``governor.elect`` flight event WHEN THE DECISION CHANGES (the
+        hot dispatch loops re-consult io_concurrency; steady-state
+        re-elections must not flood the ring), and the profile key
+        attached when a learned profile or trial made the call."""
+        profile = None
+        if source in ("profile", "trial"):
+            op = dim.rsplit(".", 1)[1] if "." in dim else "read"
+            profile = self._tuner.key_for(plugin or "", op)
+        election = _autotune.Election(
+            site, dim, plugin, value, source, profile=profile, inputs=inputs
+        )
+        key = (dim, plugin or "")
+        with self._lock:
+            prev = self._elections.get(key)
+            changed = (
+                prev is None or prev.value != value or prev.source != source
+            )
+            self._elections[key] = election
+        if changed:
+            telemetry.record_election(**election.as_fields())
+        return value
+
+    def _banded(
+        self, gate: str, plugin: Optional[str], rate: float, knee: float
+    ) -> bool:
+        """Knee comparison with a dead band: True while the rate is
+        below the knee, but once a decision is made the rate must cross
+        the knee by ``_KNEE_MARGIN`` to flip it — measurement jitter
+        around the knee cannot flip-flop a fast path between ops."""
+        key = (gate, plugin or "")
+        with self._lock:
+            prev = self._gate_state.get(key)
+            if prev is None:
+                decision = rate < knee
+            elif prev:
+                decision = rate < knee * (1.0 + _KNEE_MARGIN)
+            else:
+                decision = rate < knee * (1.0 - _KNEE_MARGIN)
+            self._gate_state[key] = decision
+        return decision
+
+    def _tuned(self, dim: str, plugin: Optional[str], op: str):
+        """Learned-profile / armed-trial resolution for one dimension,
+        or None (cold start / autotune off). The ``never`` mode costs
+        exactly this one env check."""
+        if _autotune.autotune_mode() == "never":
+            return None
+        return self._tuner.resolve(dim, plugin or "", op)
+
     # ---------------------------------------------------------- tunables
 
     def sub_chunk_bytes(self, plugin: Optional[str] = None, op: str = "write") -> int:
-        """Streaming sub-chunk size for ``op`` ("write"/"read") — sized
-        from the MATCHING measured bandwidth (a fast local save must not
-        size a later network restore's read windows, and vice versa)."""
+        """Streaming sub-chunk size for ``op`` ("write"/"read") —
+        env override > learned profile > sized from the MATCHING
+        measured bandwidth (a fast local save must not size a later
+        network restore's read windows, and vice versa)."""
+        dim = f"sub_chunk.{op}"
         pinned = os.environ.get(SUB_CHUNK_ENV_VAR, "").strip()
         if pinned:
             try:
                 # An explicit pin is honored as-is (tests pin tiny chunks
                 # to exercise many-sub-chunk streams on small payloads).
-                return max(1, int(pinned))
+                value = max(1, int(pinned))
             except ValueError:
                 logger.warning(
                     "ignoring non-integer %s=%r", SUB_CHUNK_ENV_VAR, pinned
                 )
+            else:
+                return self._resolved("sub_chunk", dim, plugin, value, "env")
         lo = _env_int(SUB_CHUNK_MIN_ENV_VAR, _DEFAULT_SUB_CHUNK_MIN_BYTES)
         hi = _env_int(SUB_CHUNK_MAX_ENV_VAR, _DEFAULT_SUB_CHUNK_MAX_BYTES)
         hi = max(lo, hi)
+        tuned = self._tuned(dim, plugin, op)
+        if tuned is not None:
+            value, source = tuned
+            try:
+                value = int(value)
+            except (TypeError, ValueError):
+                value = _DEFAULT_SUB_CHUNK_BYTES
+            # Learned values stay inside the env bounds (trials were
+            # generated inside them; a profile learned under different
+            # bounds is clamped into today's).
+            return self._resolved(
+                "sub_chunk", dim, plugin, min(max(value, lo), hi), source
+            )
         bps = self.read_bps(plugin) if op == "read" else self.write_bps(plugin)
         if bps is None:
-            return min(max(_DEFAULT_SUB_CHUNK_BYTES, lo), hi)
+            return self._resolved(
+                "sub_chunk", dim, plugin,
+                min(max(_DEFAULT_SUB_CHUNK_BYTES, lo), hi), "heuristic",
+            )
         target = int(bps * _SUB_CHUNK_TARGET_SECONDS)
         # Round to a 1 MB multiple: exact-size staging-pool free lists
         # recycle far better when sizes don't wander byte-by-byte.
         target = max(1 << 20, (target >> 20) << 20)
-        return min(max(target, lo), hi)
+        return self._resolved(
+            "sub_chunk", dim, plugin, min(max(target, lo), hi), "heuristic",
+            bps=round(bps),
+        )
 
     def io_concurrency(
         self, op: str = "write", plugin: Optional[str] = None
     ) -> int:
         """In-flight storage requests for ``op`` ("write"/"read") —
-        tuned from the MATCHING measured rate (a fast local save must
-        not clamp concurrency for a later latency-bound network
-        restore, and vice versa), for ``plugin`` when it has a recorded
-        rate."""
+        env override > learned profile > tuned from the MATCHING
+        measured rate (a fast local save must not clamp concurrency for
+        a later latency-bound network restore, and vice versa), for
+        ``plugin`` when it has a recorded rate."""
+        dim = f"io_concurrency.{op}"
         raw = os.environ.get(IO_CONCURRENCY_ENV_VAR, "").strip()
         if raw:
             try:
-                return max(1, int(raw))
+                value = max(1, int(raw))
             except ValueError:
                 pass  # warned at import time by _env_int
+            else:
+                return self._resolved("io_concurrency", dim, plugin, value, "env")
+        tuned = self._tuned(dim, plugin, op)
+        if tuned is not None:
+            value, source = tuned
+            try:
+                value = int(value)
+            except (TypeError, ValueError):
+                value = 0
+            if value >= 1:
+                return self._resolved(
+                    "io_concurrency", dim, plugin,
+                    min(value, _IO_CONCURRENCY_CAP), source,
+                )
         default = min(16, max(8, 2 * _CPU_COUNT))
         table = self.read_bps if op == "read" else self.write_bps
         bps = table(plugin)
         if bps is None and plugin is not None:
             bps = table(None)  # best-known rate for this op
         if bps is None:
-            return default
-        if bps >= 1e9:
+            value = default
+        elif bps >= 1e9:
             # Bandwidth-bound (local SSD/tmpfs): a couple of streams per
             # core saturate the bus; more just thrash caches.
-            return min(default, max(4, 2 * _CPU_COUNT))
-        if bps <= 1e8:
+            value = min(default, max(4, 2 * _CPU_COUNT))
+        elif bps <= 1e8:
             # Latency-bound (network storage): hide per-request latency
             # with every stream the cap allows.
-            return 16
-        return default
+            value = 16
+        else:
+            value = default
+        return self._resolved(
+            "io_concurrency", dim, plugin, value, "heuristic",
+            bps=round(bps) if bps is not None else None,
+        )
 
     def should_preverify(self, plugin: Optional[str] = None) -> bool:
         """``plugin``: the storage plugin the CURRENT restore reads
@@ -342,14 +502,31 @@ class IOGovernor:
         No recorded rate for this plugin means no evidence: verify."""
         mode = preverify_mode()
         if mode == "always":
-            return True
+            return self._resolved("preverify", "preverify", plugin, True, "env")
         if mode == "never":
-            return False
+            return self._resolved("preverify", "preverify", plugin, False, "env")
+        tuned = self._tuned("preverify", plugin, "read")
+        if tuned is not None:
+            value, source = tuned
+            return self._resolved(
+                "preverify", "preverify", plugin, bool(value), source
+            )
         hash_bps = self.hash_bps()
         read_bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
         if hash_bps is None or read_bps is None:
-            return True  # no evidence: keep the zero-byte verify path
-        return read_bps <= hash_bps * _PREVERIFY_READ_MARGIN
+            # No evidence: keep the zero-byte verify path.
+            return self._resolved(
+                "preverify", "preverify", plugin, True, "heuristic"
+            )
+        # The crossover knee with the gate dead band: hovering at
+        # read ~= hash * margin must not flip verification per-restore.
+        value = self._banded(
+            "preverify", plugin, read_bps, hash_bps * _PREVERIFY_READ_MARGIN
+        )
+        return self._resolved(
+            "preverify", "preverify", plugin, value, "heuristic",
+            read_bps=round(read_bps), hash_bps=round(hash_bps),
+        )
 
     def should_native_io(self, plugin: Optional[str] = None, op: str = "write") -> bool:
         """Economic gate for the native I/O engine (native_io.py, under
@@ -370,20 +547,35 @@ class IOGovernor:
           memcpy-speed local reads (page cache) the engine measurably
           loses to the mmap/pread paths, so native reads engage only on
           measured latency-bound storage (no measurement = no evidence
-          = Python path, the read-side status quo bias)."""
+          = Python path, the read-side status quo bias). The engine choice
+        is a tunable dimension (``native.write``/``native.read``): a
+        learned profile or armed trial overrides the margin logic."""
+        dim = f"native.{op}"
+        tuned = self._tuned(dim, plugin, op)
+        if tuned is not None:
+            value, source = tuned
+            return self._resolved("native", dim, plugin, bool(value), source)
         table = self._read_bps if op == "read" else self._write_bps
         with self._lock:
             native = table.get(f"{plugin}.native") if plugin else None
             base = table.get(plugin) if plugin else None
         if op == "read":
-            if base is None or base >= _STREAM_READ_LATENCY_BPS:
-                return False
-            if native is None:
-                return True
-            return native >= _NATIVE_FALLBACK_MARGIN * base
+            if base is None or not self._banded(
+                dim, plugin, base, _STREAM_READ_LATENCY_BPS
+            ):
+                return self._resolved(
+                    "native", dim, plugin, False, "heuristic"
+                )
+            value = native is None or native >= _NATIVE_FALLBACK_MARGIN * base
+            return self._resolved("native", dim, plugin, value, "heuristic")
         if native is None or base is None:
-            return True  # no evidence against it: gather measurements
-        return native >= _NATIVE_FALLBACK_MARGIN * base
+            # No evidence against it: gather measurements.
+            return self._resolved("native", dim, plugin, True, "heuristic")
+        value = native >= _NATIVE_FALLBACK_MARGIN * base
+        return self._resolved(
+            "native", dim, plugin, value, "heuristic",
+            native_bps=round(native), base_bps=round(base),
+        )
 
     def should_coop_restore(self, plugin: Optional[str] = None) -> bool:
         """Economic gate for cooperative restore fan-out (fanout.py,
@@ -395,8 +587,7 @@ class IOGovernor:
         latency-bound knee the streamed-read election uses. No recorded
         read rate for this restore's backend means no evidence: direct
         reads (the status quo) stay."""
-        bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
-        return bps is not None and bps < _STREAM_READ_LATENCY_BPS
+        return self._knee_gate("coop_restore", plugin)
 
     def should_planned_reshard(self, plugin: Optional[str] = None) -> bool:
         """Economic gate for the planned-reshard tier (reshard.py, under
@@ -408,8 +599,7 @@ class IOGovernor:
         local fs (page-cache reads) stays on the direct overlap-scatter
         path; no recorded read rate means no evidence, so the status quo
         stays."""
-        bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
-        return bps is not None and bps < _STREAM_READ_LATENCY_BPS
+        return self._knee_gate("planned_reshard", plugin)
 
     def should_seed_restore(self, plugin: Optional[str] = None) -> bool:
         """Economic gate for the fleet seeding tier (distrib.py, under
@@ -422,8 +612,189 @@ class IOGovernor:
         asymmetric decisions across the fleet are safe — but the
         evidence rule is identical: no recorded read rate for this
         backend means no evidence, and direct reads stay."""
+        return self._knee_gate("seed_restore", plugin)
+
+    def _knee_gate(self, gate: str, plugin: Optional[str]) -> bool:
+        """The shared latency-bound election (coop restore, planned
+        reshard, seed restore): learned profile > the measured-rate
+        knee with the flip-flop dead band."""
+        tuned = self._tuned(gate, plugin, "read")
+        if tuned is not None:
+            value, source = tuned
+            return self._resolved(gate, gate, plugin, bool(value), source)
         bps = self.read_bps(plugin) if plugin is not None else self.read_bps()
-        return bps is not None and bps < _STREAM_READ_LATENCY_BPS
+        value = bps is not None and self._banded(
+            gate, plugin, bps, _STREAM_READ_LATENCY_BPS
+        )
+        return self._resolved(
+            gate, gate, plugin, value, "heuristic",
+            read_bps=round(bps) if bps is not None else None,
+        )
+
+    # ------------------------------------------------ closed-loop autotune
+
+    def note_world(self, world_size: int) -> None:
+        self._tuner.note_world(world_size)
+
+    def _trial_dims(self, op: str, plugin: str) -> Dict[str, Dict[str, Any]]:
+        """The dimensions this op direction may perturb, with their
+        current incumbent values and env bounds. An env-pinned knob is
+        never perturbed — overrides remove the dimension from the
+        experiment entirely."""
+        dims: Dict[str, Dict[str, Any]] = {}
+        if not os.environ.get(SUB_CHUNK_ENV_VAR, "").strip():
+            lo = _env_int(SUB_CHUNK_MIN_ENV_VAR, _DEFAULT_SUB_CHUNK_MIN_BYTES)
+            hi = max(
+                lo, _env_int(SUB_CHUNK_MAX_ENV_VAR, _DEFAULT_SUB_CHUNK_MAX_BYTES)
+            )
+            dims[f"sub_chunk.{op}"] = {
+                "value": self.sub_chunk_bytes(plugin, op=op),
+                "kind": "geom", "lo": lo, "hi": hi, "quantum": 1 << 20,
+            }
+        if not os.environ.get(IO_CONCURRENCY_ENV_VAR, "").strip():
+            dims[f"io_concurrency.{op}"] = {
+                "value": self.io_concurrency(op, plugin),
+                "kind": "geom", "lo": 1, "hi": _IO_CONCURRENCY_CAP,
+                "quantum": 1,
+            }
+        # Engine choice joins the experiment only once the native engine
+        # has a measured per-stream rate for this plugin — toggling an
+        # engine that never ran would score nothing.
+        with self._lock:
+            table = self._read_bps if op == "read" else self._write_bps
+            has_native = f"{plugin}.native" in table
+        if has_native:
+            dims[f"native.{op}"] = {
+                "value": self.should_native_io(plugin, op=op),
+                "kind": "toggle",
+            }
+        return dims
+
+    def begin_io_op(self, op: str, plugin: str) -> None:
+        """Scheduler entry hook (execute_write_reqs / execute_read_reqs):
+        publishes this op's profile key to the heartbeat plane and —
+        learning modes only, scored incumbent permitting — arms at most
+        one perturbation trial, so the elections that follow inside the
+        op resolve it. ``never`` costs one env check."""
+        mode = _autotune.autotune_mode()
+        if mode == "never":
+            return
+        key = self._tuner.key_for(plugin, op)
+        if mode in ("auto", "fresh") and key is not None:
+            self._tuner.maybe_arm(op, plugin, self._trial_dims(op, plugin))
+        active = self._tuner.active_trial()
+        trial_dim = (
+            active["dim"]
+            if active is not None
+            and active["op"] == op
+            and active["plugin"] == plugin
+            else None
+        )
+        # The watch `profile` column (health plane): profile key plus
+        # whether this rank is running a perturbation trial. Not part of
+        # the stall fingerprint (health._PROGRESS_FIELDS).
+        telemetry.health.update(profile=key or "-", trial=trial_dim)
+
+    def observe_verdict(
+        self,
+        op: str,
+        plugin: str,
+        world_size: int,
+        attribution: Optional[Dict[str, Any]],
+        aggregate: Optional[Dict[str, Any]] = None,
+        root: Optional[str] = None,
+        rank: int = 0,
+    ) -> None:
+        """Post-commit feedback: score the critical-path verdict of one
+        committed take/restore against the incumbent profile. Called on
+        EVERY rank (the in-memory learning must agree fleet-wide — all
+        ranks saw the same merged attribution); rank 0 additionally
+        persists the updated profile record into ``root``'s history
+        journal. Never raises into the committed op."""
+        mode = _autotune.autotune_mode()
+        if mode == "never":
+            return
+        op_kind = "read" if op == "restore" else "write"
+        self._tuner.note_world(world_size)
+        binding = (attribution or {}).get("binding") or {}
+        category = binding.get("category")
+        # Score by the fleet's achieved end-to-end rate (bytes over the
+        # op wall), not the binding window's busy rate: the busy rate is
+        # a RESIDUAL (fused-span accounting subtracts overlapped
+        # staging/hash windows), so finer chunking earns overlap credit
+        # and the residual optimum drifts below the wall optimum — the
+        # tuner would faithfully converge to settings the operator's
+        # clock disagrees with. The binding category still keys the
+        # profile and gates learning; its rate is only the fallback.
+        agg = aggregate or {}
+        gbps = agg.get("read_gbps" if op_kind == "read" else "write_gbps")
+        if not isinstance(gbps, (int, float)) or gbps <= 0:
+            gbps = binding.get("gbps")
+        if (
+            not isinstance(category, str)
+            or not category
+            or not isinstance(gbps, (int, float))
+            or gbps <= 0
+        ):
+            # Bus-off / unattributed op: skip EXPLICITLY — a None
+            # binding category must never become a learned profile key.
+            telemetry.counter_add("profile_skips", 1)
+            aborted = self._tuner.abort_trial(op_kind, plugin)
+            telemetry.record_learn(
+                op=op, plugin=plugin, skipped=True, trial_aborted=aborted
+            )
+            return
+        # Trials only arm off storage-class verdicts: when the pipeline
+        # (staging, hashing) gates the op, perturbing storage knobs is
+        # noise-chasing — the score still tracks, the experiment waits.
+        storage_bound = (
+            telemetry.critpath.classify_category(category) == "storage"
+        )
+        result = self._tuner.observe(
+            op_kind,
+            plugin,
+            category,
+            float(gbps),
+            learn=(mode != "pin"),
+            arm=storage_bound,
+        )
+        telemetry.record_learn(
+            op=op,
+            **{k: v for k, v in result.items() if k not in ("settings", "op")},
+        )
+        if root is not None and rank == 0 and mode != "pin":
+            record = self._tuner.profile_record(result["key"])
+            if record is not None:
+                record["op"] = op_kind
+                telemetry.history.append_record(root, record)
+
+    def load_profiles(self, root: str) -> int:
+        """Warm-start from ``root``'s history journal: adopt the last
+        persisted profile per key so the first op of this process elects
+        the learned optimum, not the static default. Once per root per
+        governor; ``fresh`` (relearn) and ``never`` skip."""
+        mode = _autotune.autotune_mode()
+        if mode in ("never", "fresh") or not root:
+            return 0
+        with self._lock:
+            if root in self._profile_roots:
+                return 0
+            self._profile_roots.add(root)
+        try:
+            records = telemetry.history.load_profiles(root)
+        except Exception:  # noqa: BLE001 - profiles are advisory
+            logger.debug("profile load skipped", exc_info=True)
+            return 0
+        loaded = self._tuner.load(records)
+        if loaded:
+            logger.debug(
+                "autotune: warm-started %d profile(s) from %s", loaded, root
+            )
+        return loaded
+
+    def profiles(self) -> Dict[str, Dict[str, Any]]:
+        """Live convergence state per profile key (introspection)."""
+        return self._tuner.profiles()
 
 
 def preverify_mode() -> str:
@@ -450,6 +821,41 @@ def io_governor() -> IOGovernor:
             if _governor is None:
                 _governor = IOGovernor()
     return _governor
+
+
+def reset_io_governor() -> IOGovernor:
+    """Replace the process governor with a fresh instance. Test/bench
+    hook: the warm-start benchmark simulates "a new process on a known
+    host" with it (fresh EWMA tables + profile reload). The bus rate
+    listener resolves the current instance per call, so the swap is
+    safe mid-process."""
+    global _governor
+    with _governor_lock:
+        _governor = IOGovernor()
+        return _governor
+
+
+def preload_profiles(path: str, world_size: Optional[int] = None) -> None:
+    """Load the learned profiles governing ``path``'s root (the
+    snapshot's parent directory — where the history journal lives) into
+    the process governor, before the op's first election. Cheap no-op
+    when autotuning is off or the path has no local filesystem root;
+    never raises into the op."""
+    if _autotune.autotune_mode() == "never":
+        return
+    governor = io_governor()
+    if world_size:
+        governor.note_world(world_size)
+    try:
+        from .storage_plugin import local_fs_root
+
+        local = local_fs_root(path)
+        if local is None:
+            return
+        root = os.path.dirname(os.path.abspath(local.rstrip("/")))
+        governor.load_profiles(root)
+    except Exception:  # noqa: BLE001 - profiles are advisory
+        logger.debug("profile preload skipped", exc_info=True)
 
 
 def _feed_governor_rates(
@@ -967,6 +1373,10 @@ async def execute_write_reqs(
 
     governor = io_governor()
     plugin_key = type(storage).__name__
+    # Closed-loop hook: publish the profile key and (learning modes)
+    # arm at most one perturbation trial BEFORE the elections below, so
+    # this op runs it and the post-commit verdict scores it.
+    governor.begin_io_op("write", plugin_key)
     # Streaming fuses staging with storage I/O, so a streamed entry's
     # write completes before this function returns — callers that rely on
     # the staging-complete consistency point RETURNING EARLY (async_take)
@@ -1648,6 +2058,9 @@ async def execute_read_reqs(
 
     governor = io_governor()
     plugin_key = type(storage).__name__
+    # Closed-loop hook (see execute_write_reqs): trial arming must
+    # precede the elections below.
+    governor.begin_io_op("read", plugin_key)
     # Streamed-read election mirrors the write side: only plugins that
     # produce chunks incrementally are eligible (the buffered read_stream
     # fallback would hold a full entry while the budget charged a

@@ -63,6 +63,11 @@ _SUMMED_COUNTERS = (
     "pages_faulted",
     "pages_prefetched",
     "pagein_bytes",
+    # Closed-loop autotune (scheduler.IOGovernor / autotune.py): ops
+    # whose verdict carried no binding category and were therefore
+    # skipped by profile learning — a high count means the tuner is
+    # flying blind (telemetry bus off / attribution failing).
+    "profile_skips",
 )
 
 

@@ -321,10 +321,15 @@ def render_fleet(
     # (pagein.py): a replica serving before fully restored climbs from
     # its hot-set fraction to 100% as the tail pages in; eager ops show
     # ``-``.
+    # The ``profile`` column is the autotuner's active profile key
+    # (scheduler.begin_io_op -> autotune.profile_key); a trailing ``*``
+    # marks a rank currently running a perturbation trial on that op.
+    # Neither field is in _PROGRESS_FIELDS — a trial toggling must never
+    # mask (or fake) byte-level progress in the stall fingerprint.
     lines.append(
         f"{'rank':>4}  {'op':<8} {'phase':<14} {'staged':>10} {'written':>10} "
         f"{'read':>10} {'seed':>10} {'total':>10} {'resid':>6} {'io':>3} "
-        f"{'eta':>7} {'wall':>8}  {'bound on':<15} status"
+        f"{'eta':>7} {'wall':>8}  {'bound on':<15} {'profile':<28} status"
     )
     walls = []
     for rank in sorted(fleet):
@@ -345,6 +350,9 @@ def render_fleet(
         binding = rec.get("binding") or "-"
         resid = rec.get("resident_frac")
         resid_txt = f"{resid * 100:.0f}%" if resid is not None else "-"
+        profile = str(rec.get("profile") or "-")
+        if rec.get("trial"):
+            profile += "*"
         lines.append(
             f"{rank:>4}  {str(rec.get('op', '?')):<8} "
             f"{str(rec.get('phase', '?')):<14} "
@@ -356,7 +364,8 @@ def render_fleet(
             f"{resid_txt:>6} "
             f"{rec.get('inflight_io', 0):>3} "
             f"{(str(eta) + 's') if eta is not None else '?':>7} "
-            f"{rec.get('wall_s', 0):>7.1f}s  {str(binding):<15} {status}"
+            f"{rec.get('wall_s', 0):>7.1f}s  {str(binding):<15} "
+            f"{profile:<28} {status}"
         )
     if len(walls) > 1:
         wall_max, slowest = max(walls)
