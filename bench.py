@@ -841,6 +841,69 @@ def _autotune_leg(timeout_s: float = 420.0):
     return compact
 
 
+def _georep_leg(timeout_s: float = 420.0):
+    """Geo-replication RPO leg (ISSUE 20), persisted to BENCH_r17.json
+    and embedded in the main record: benchmarks/georep_rpo.py ships a
+    base snapshot and per-epoch journal deltas over a 20 MB/s-throttled
+    WAN, expresses the remote tier's recovery point at several journal
+    cadences (cadence + measured fold time, vs re-shipping the base
+    every cadence point), and gates the foreground cost of an armed
+    shipper (<= 5% with a 50 ms floor on journal_step). Runs in its own
+    process group with a hard timeout; failures degrade to an absent
+    key, never a dead bench."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _log(f"running geo-replication RPO leg ({timeout_s:.0f}s budget) ...")
+    r = _run_in_own_group(
+        [sys.executable, os.path.join(here, "benchmarks", "georep_rpo.py")],
+        timeout=timeout_s,
+    )
+    if r.killed or r.returncode != 0:
+        _log(
+            f"georep RPO leg rc={r.returncode} killed={r.killed} "
+            f"stderr={r.stderr.strip()[-300:]!r}; omitting"
+        )
+        return None
+    records = _json_records(r.stdout)
+    summary = records.get("georep_rpo/summary")
+    if summary is None:
+        _log("georep RPO leg produced no summary; omitting")
+        return None
+    legs = [
+        rec
+        for name, rec in records.items()
+        if name.startswith("georep_rpo/") and name != "georep_rpo/summary"
+    ]
+    out = os.path.join(here, "BENCH_r17.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "metric": "georep_rpo",
+                "unit": "seconds of remote-tier recovery point vs "
+                "journal cadence on a 20 MB/s WAN",
+                "summary": summary,
+                "legs": legs,
+                "platform": "cpu",
+                "env": {
+                    "JAX_PLATFORMS": "cpu",
+                    "TORCHSNAPSHOT_TPU_JOURNAL": "1",
+                },
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    _log(
+        f"georep leg ok: epoch ship {summary.get('epoch_ship_s')}s vs "
+        f"base ship {summary.get('base_ship_s')}s "
+        f"({summary.get('ship_reduction_x')}x), foreground overhead "
+        f"{summary.get('foreground_overhead_pct')}%; written to {out}"
+    )
+    compact = dict(summary)
+    compact.pop("benchmark", None)
+    return compact
+
+
 def _native_io_leg(tmp: str, app_state, state, nbytes: int):
     """Side-by-side native-engine vs Python-path legs (ISSUE 9),
     persisted to BENCH_r10.json and embedded in the main record.
@@ -1322,6 +1385,12 @@ def main() -> None:
     autotune_leg = _autotune_leg()
     if autotune_leg is not None:
         record["autotune"] = autotune_leg
+    # Geo-replication RPO side-leg (BENCH_r17.json): remote recovery
+    # point vs journal cadence over a throttled WAN, and the armed-
+    # shipper foreground gate.
+    georep_leg = _georep_leg()
+    if georep_leg is not None:
+        record["georep"] = georep_leg
     print(json.dumps(record), flush=True)
 
 

@@ -66,6 +66,13 @@ Two legs:
     hook bypassed to a raw passthrough, best-vs-best < 1% with the
     50 ms floor. The enabled path's win is measured by bench.py's
     fleet-distribution leg (BENCH_r13.json).
+    And gates the geo-replication tier's DISABLED path (ISSUE 20): a
+    2 GiB CheckpointManager save with ``TORCHSNAPSHOT_TPU_GEOREP``
+    unset (the shipping default — one ``remote_url`` env check at
+    construction, one attribute check per commit) vs that env check
+    bypassed to a raw ``None``, best-vs-best < 1% with the 50 ms floor.
+    The ARMED shipper's foreground cost is gated separately by
+    bench.py's georep leg (BENCH_r17.json).
 
 Usage::
 
@@ -1228,6 +1235,95 @@ def autotune_overhead(trials: int = 5) -> None:
     )
 
 
+def georep_overhead(trials: int = 5) -> None:
+    """Disabled-path overhead of the geo-replication tier (ISSUE 20): a
+    ~2 GiB CheckpointManager save with no remote configured (the
+    shipping default — one ``remote_url`` env check at construction,
+    one attribute check after the commit) vs that env check bypassed to
+    a raw ``None``. Best-vs-best < 1% with the 50 ms floor, same
+    bimodal-host recipe as the injector gate. The ENABLED path's cost
+    (WAN shipping) is measured, not gated — see bench.py's georep leg /
+    BENCH_r17.json and its foreground gate for the armed shipper."""
+    import numpy as np
+
+    from torchsnapshot_tpu import CheckpointManager, StateDict
+    from torchsnapshot_tpu import georep as georep_mod
+
+    os.environ.pop("TORCHSNAPSHOT_TPU_GEOREP", None)
+
+    nbytes = 2 << 30
+    n_arrays = 8
+    per = nbytes // n_arrays // 4
+    state = {
+        "model": StateDict(
+            **{
+                f"p{i}": np.random.default_rng(i)
+                .standard_normal(per)
+                .astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+    }
+
+    def timed_save() -> float:
+        root = tempfile.mkdtemp(prefix="georep_overhead_")
+        try:
+            mgr = CheckpointManager(root, save_interval_steps=1)
+            t0 = time.perf_counter()
+            mgr.save(0, state)
+            return time.perf_counter() - t0
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    def bypassed(fn):
+        saved = georep_mod.remote_url
+        georep_mod.remote_url = lambda: None
+        try:
+            return fn()
+        finally:
+            georep_mod.remote_url = saved
+
+    timed_save()  # warmup: staging-pool first touch, page cache
+    bypass_walls, shim_walls = [], []
+    max_pairs = 2 * trials
+    for pair in range(max_pairs):
+        if pair % 2 == 0:
+            byp = bypassed(timed_save)
+            shim = timed_save()
+        else:
+            shim = timed_save()
+            byp = bypassed(timed_save)
+        bypass_walls.append(byp)
+        shim_walls.append(shim)
+        budget_s = max(0.01 * min(bypass_walls), 0.05)
+        if pair + 1 >= trials and (
+            min(shim_walls) - min(bypass_walls)
+        ) < budget_s:
+            break
+    bypass_best = min(bypass_walls)
+    shim_best = min(shim_walls)
+    budget_s = max(0.01 * bypass_best, 0.05)
+    delta = (shim_best - bypass_best) / bypass_best
+    report(
+        "georep_overhead",
+        {
+            "gib": round(nbytes / (1 << 30), 2),
+            "pairs": len(bypass_walls),
+            "bypass_trials_s": [round(t, 3) for t in bypass_walls],
+            "shim_trials_s": [round(t, 3) for t in shim_walls],
+            "bypass_best_s": round(bypass_best, 3),
+            "shim_best_s": round(shim_best, 3),
+            "overhead_pct": round(delta * 100, 3),
+        },
+        data_bytes=nbytes,
+    )
+    assert (shim_best - bypass_best) < budget_s, (
+        f"disabled-georep overhead {delta * 100:.2f}% over the 1% budget "
+        f"(bypass best {bypass_best:.3f}s vs shipping best "
+        f"{shim_best:.3f}s, floor 50 ms)"
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--soak", action="store_true")
@@ -1252,6 +1348,7 @@ def main() -> None:
         distrib_overhead(args.trials)
         tenancy_overhead(args.trials)
         autotune_overhead(args.trials)
+        georep_overhead(args.trials)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,268 @@
+"""Geo-replication RPO leg (ISSUE 20): remote-tier recovery point vs
+journal cadence on WAN-throttled storage, plus the foreground-overhead
+gate.
+
+The DR model (docs/source/fault_tolerance.rst, "Cross-region disaster
+recovery"): the remote tier's recovery point is the primary's
+durability cadence PLUS the replication lag — the time an epoch takes
+to cross the WAN and fold onto the remote tier. This leg measures both
+halves on a throttled remote tier:
+
+* ``base_ship_s`` — shipping the full base snapshot (what a remote RPO
+  would cost per cadence point WITHOUT journal-epoch shipping: every
+  durability point re-pays the whole state over the WAN).
+* ``epoch_ship_s`` — shipping one committed journal epoch carrying only
+  the hot set. Remote RPO then tracks the JOURNAL cadence
+  (``cadence + epoch_ship_s``) instead of the full-save cadence, and
+  the leg gates the ratio (>= 3x here; ~16x ideal for this shape).
+* the foreground gate — ``journal_step`` wall with the shipper armed
+  and actively pushing over the throttled WAN must stay within 5% (with
+  a 50 ms floor) of the unarmed wall: replication is an enqueue on the
+  foreground path, never a blocking write.
+
+Only the REMOTE tier is throttled (``_RemoteTier.write`` pays
+WAN_BPS transfer time under one rate lock); primary-side saves run at
+local speed — the asymmetry is the point, a WAN is slower than the
+local filer and the shipper must absorb that without the training loop
+noticing.
+
+Emits one JSON line per leg plus a ``georep_rpo/summary`` line
+(bench.py's ``_georep_leg`` persists that to BENCH_r17.json).
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/georep_rpo.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_utils import report  # noqa: E402
+
+# Simulated cross-region WAN bandwidth. Deliberately below the
+# throttled-filer rates the other legs use (coop_restore 40 MB/s,
+# journal_rpo 50 MB/s): inter-region links are the slowest pipe in the
+# system, the regime async shipping exists for.
+WAN_BPS = 20e6
+
+# Journal cadences (seconds) the summary expresses remote RPO at.
+CADENCES_S = (1, 5, 30)
+
+FOREGROUND_TRIALS = 3
+SHIP_TRIALS = 3
+
+
+def _throttle_wan():
+    """Charge WAN_BPS transfer time for every byte written to the
+    remote tier, through one rate lock (the shipper is single-threaded
+    today, but the lock keeps the model honest if that changes). The
+    primary tier stays unthrottled. Returns a byte counter."""
+    from torchsnapshot_tpu import georep as georep_mod
+
+    lock = threading.Lock()
+    shipped = {"bytes": 0}
+    orig_write = georep_mod._RemoteTier.write
+
+    def slow_write(self, rel, buf, _orig=orig_write):
+        _orig(self, rel, buf)
+        shipped["bytes"] += len(buf)
+        with lock:
+            time.sleep(len(buf) / WAN_BPS)
+
+    georep_mod._RemoteTier.write = slow_write
+    orig_append = georep_mod._RemoteTier.append
+
+    def slow_append(self, rel, existing, region, _orig=orig_append):
+        _orig(self, rel, existing, region)
+        shipped["bytes"] += len(region)
+        with lock:
+            time.sleep(len(region) / WAN_BPS)
+
+    georep_mod._RemoteTier.append = slow_append
+    return shipped
+
+
+def _build_state(np):
+    """~32 MiB frozen bulk + a ~2 MiB hot set (one head array and 32
+    small embedding rows) — base ship pays the bulk once, epoch ships
+    pay only the hot set."""
+    from torchsnapshot_tpu import StateDict
+
+    frozen = {
+        f"frozen_{i}": np.random.default_rng(i)
+        .standard_normal((8 << 20) // 4)
+        .astype(np.float32)
+        for i in range(4)
+    }
+    hot = {"head": np.zeros((2 << 20) // 4, dtype=np.float32)}
+    for i in range(32):
+        hot[f"emb_{i}"] = np.zeros(1024, dtype=np.float32)
+    state = StateDict(**frozen, **hot, step=0)
+    hot_bytes = sum(v.nbytes for v in hot.values())
+    total_bytes = hot_bytes + sum(v.nbytes for v in frozen.values())
+    return {"model": state}, total_bytes, hot_bytes
+
+
+def _mutate_hot(app_state, np, step: int) -> None:
+    st = app_state["model"]
+    st["head"] = np.full_like(st["head"], float(step))
+    for i in range(32):
+        st[f"emb_{i}"] = np.full_like(st[f"emb_{i}"], float(step + i))
+    st["step"] = step
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["TORCHSNAPSHOT_TPU_JOURNAL"] = "1"
+    import numpy as np
+
+    from torchsnapshot_tpu import CheckpointManager
+    from torchsnapshot_tpu import georep, journal
+
+    app_state, total_bytes, hot_bytes = _build_state(np)
+    shipped = _throttle_wan()
+
+    root = tempfile.mkdtemp(prefix="georep_rpo_p_")
+    remote = tempfile.mkdtemp(prefix="georep_rpo_r_")
+    rep = None
+    hook = None
+    try:
+        mgr = CheckpointManager(root, save_interval_steps=1)
+        base_step = 100
+        mgr.save(base_step, app_state)  # primary tier: unthrottled
+
+        # Foreground baseline: epoch commits with NO shipper armed.
+        off_walls = []
+        for t in range(FOREGROUND_TRIALS):
+            _mutate_hot(app_state, np, 200 + t)
+            t0 = time.perf_counter()
+            assert mgr.journal_step(200 + t, app_state)
+            off_walls.append(time.perf_counter() - t0)
+        t_off = min(off_walls)
+
+        # Arm the shipper the way the manager does: a journal commit
+        # hook that enqueues, nothing else on the foreground path.
+        rep = georep.GeoReplicator(remote, interval=0.05)
+
+        def hook(base_dir, bstep, _epoch, _rep=rep):
+            _rep.enqueue(base_dir, bstep)
+
+        journal.register_commit_hook(hook)
+
+        # Base ship: full state + the baseline epochs cross the WAN.
+        shipped["bytes"] = 0
+        t0 = time.perf_counter()
+        rep.enqueue(mgr.path_for(base_step), base_step)
+        assert rep.drain(timeout=120.0), rep.last_error
+        t_base = time.perf_counter() - t0
+        base_bytes = shipped["bytes"]
+        report(
+            "georep_rpo/base_ship",
+            {
+                "state_mib": round(total_bytes / (1 << 20), 1),
+                "wan_mb_s": WAN_BPS / 1e6,
+                "shipped_mib": round(base_bytes / (1 << 20), 1),
+                "wall_s": round(t_base, 4),
+            },
+            data_bytes=base_bytes,
+        )
+
+        # Epoch ships: one committed epoch (hot set only) per trial,
+        # wall measured commit -> remote-applied. Also the foreground
+        # gate: journal_step wall with the shipper armed and pushing.
+        on_walls, ship_walls, epoch_bytes = [], [], []
+        for t in range(SHIP_TRIALS):
+            step = 300 + t
+            _mutate_hot(app_state, np, step)
+            t0 = time.perf_counter()
+            assert mgr.journal_step(step, app_state)
+            on_walls.append(time.perf_counter() - t0)
+            shipped["bytes"] = 0
+            t0 = time.perf_counter()
+            assert rep.drain(timeout=60.0), rep.last_error
+            ship_walls.append(time.perf_counter() - t0)
+            epoch_bytes.append(shipped["bytes"])
+        t_on = min(on_walls)
+        t_epoch = min(ship_walls)
+        report(
+            "georep_rpo/epoch_ship",
+            {
+                "hot_mib": round(hot_bytes / (1 << 20), 2),
+                "trials_s": [round(w, 4) for w in ship_walls],
+                "shipped_mib": round(min(epoch_bytes) / (1 << 20), 2),
+                "wall_s": round(t_epoch, 4),
+            },
+            data_bytes=min(epoch_bytes),
+        )
+        report(
+            "georep_rpo/foreground",
+            {
+                "journal_step_off_s": round(t_off, 4),
+                "journal_step_on_s": round(t_on, 4),
+                "off_trials_s": [round(w, 4) for w in off_walls],
+                "on_trials_s": [round(w, 4) for w in on_walls],
+            },
+            data_bytes=hot_bytes,
+        )
+
+        # Sanity: the remote tier is a real snapshot — the drill proper
+        # (bit-exact restore) lives in tests/test_georep.py; here just
+        # check the cursor reached the last committed epoch.
+        st = georep.status(root, remote_root=remote)
+        assert st["backlog_epochs"] == 0, st
+        assert st["applied_epoch"] == st["local_epochs"], st
+
+        ship_ratio = t_base / t_epoch
+        summary = {
+            "benchmark": "georep_rpo/summary",
+            "state_mib": round(total_bytes / (1 << 20), 1),
+            "hot_mib": round(hot_bytes / (1 << 20), 2),
+            "wan_mb_s": WAN_BPS / 1e6,
+            "base_ship_s": round(t_base, 4),
+            "epoch_ship_s": round(t_epoch, 4),
+            "ship_reduction_x": round(ship_ratio, 1),
+            "journal_step_off_s": round(t_off, 4),
+            "journal_step_on_s": round(t_on, 4),
+            "foreground_overhead_pct": round(
+                (t_on - t_off) / t_off * 100.0, 2
+            ),
+            # Remote RPO at each journal cadence: the durability
+            # interval plus the measured WAN fold time. The base-ship
+            # row is what every cadence point would cost without
+            # epoch shipping.
+            "rpo_remote_by_cadence_s": {
+                str(c): round(c + t_epoch, 2) for c in CADENCES_S
+            },
+            "rpo_remote_base_only_by_cadence_s": {
+                str(c): round(c + t_base, 2) for c in CADENCES_S
+            },
+        }
+        print(json.dumps(summary), flush=True)
+        assert ship_ratio >= 3.0, (
+            f"epoch ship {t_epoch:.3f}s not meaningfully cheaper than "
+            f"base ship {t_base:.3f}s ({ship_ratio:.1f}x < 3x)"
+        )
+        assert t_on <= max(t_off * 1.05, t_off + 0.05), (
+            f"armed journal_step {t_on:.4f}s exceeds foreground gate "
+            f"(unarmed {t_off:.4f}s): shipping is leaking into the "
+            f"foreground path"
+        )
+    finally:
+        if hook is not None:
+            journal.unregister_commit_hook(hook)
+        if rep is not None:
+            rep.close(drain_timeout=0.1)
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(remote, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1087,6 +1087,199 @@ def test_chaos_seed_corrupt_chunk_rejected_and_reread(tmp_path):
         assert results[rank]["seeded_bytes"] > 0, (rank, results[rank])
 
 
+# ---------------------------------------------- geo-replication drills
+#
+# ISSUE 20: the async shipper's splice fences under kill/corrupt/outage.
+# The invariant: the REMOTE tier only ever holds base + a contiguous
+# prefix of committed epochs — a dead, corrupting, or refused shipper
+# can delay replication, never poison it, and never touch the
+# foreground.
+
+_GEOREP_KILL_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict, faultinject, georep
+from torchsnapshot_tpu.journal import DeltaJournal
+
+root, remote, plan = sys.argv[1], sys.argv[2], sys.argv[3]
+step_dir = os.path.join(root, "step_0000000001")
+rng = np.random.default_rng(11)
+state = {"model": StateDict(
+    w=rng.standard_normal(20_000).astype(np.float32),
+    step=np.array([0], dtype=np.int64),
+)}
+Snapshot.take(step_dir, state)
+j = DeltaJournal(step_dir, base_step=1, rank=0)
+j.capture_baseline(state)
+for e in (1, 2):
+    state["model"]["w"][: 64 * e] = float(e)
+    state["model"]["step"][0] = e
+    j.append_epoch(state)
+faultinject.configure(plan)
+rep = georep.GeoReplicator(remote, interval=0.05)
+rep.enqueue(step_dir, 1)
+rep.drain(60)
+print("SURVIVED")  # only reachable if the plan never fired
+"""
+
+
+def test_chaos_georep_shipper_sigkill_resumes_exactly_once(tmp_path):
+    """SIGKILL the shipper mid-stream (epoch 2's blob just read, epoch 1
+    already applied): the remote holds base + epoch 1 and a cursor that
+    proves it. A resurrected shipper resumes FROM the cursor — one
+    segment extension, no re-apply — and the remote then restores every
+    committed epoch bit-exact."""
+    from torchsnapshot_tpu import georep, journal
+
+    root = str(tmp_path / "primary")
+    remote = str(tmp_path / "remote")
+    os.makedirs(root)
+    os.makedirs(remote)
+    r = subprocess.run(
+        [sys.executable, "-c", _GEOREP_KILL_CHILD, root, remote,
+         "georep.ship@2=kill"],
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+    assert "SURVIVED" not in r.stdout
+    step_dir = os.path.join(root, "step_0000000001")
+    remote_step = os.path.join(remote, "step_0000000001")
+    cur = georep.read_cursor(remote_step)
+    assert cur is not None and cur["epoch"] == 1, cur
+    shipped = journal.committed_epochs(
+        journal.read_epoch_metas(
+            os.path.join(remote_step, journal.JOURNAL_DIRNAME)
+        )
+    )
+    assert [m["epoch"] for m in shipped] == [1]
+
+    # Resurrected shipper: resumes mid-stream, ships ONLY epoch 2.
+    rep = georep.GeoReplicator(remote, interval=0.05)
+    try:
+        rep.enqueue(step_dir, 1)
+        assert rep.drain(timeout=30.0), rep.last_error
+    finally:
+        rep.close(0)
+    assert georep.read_cursor(remote_step)["epoch"] == 2
+    # Region loss: the remote restores the child's final state bit-exact.
+    rng = np.random.default_rng(11)
+    w = rng.standard_normal(20_000).astype(np.float32)
+    for e in (1, 2):
+        w[: 64 * e] = float(e)
+    dst = {"model": StateDict(
+        w=np.zeros(20_000, dtype=np.float32),
+        step=np.array([0], dtype=np.int64),
+    )}
+    Snapshot(remote_step).restore(dst)
+    assert np.array_equal(np.asarray(dst["model"]["w"]), w)
+    assert int(dst["model"]["step"][0]) == 2
+    assert run_fsck(step_dir)[0] == 0
+    assert run_fsck(remote_step)[0] == 0
+
+
+def test_chaos_georep_corrupt_frame_rejected_and_reshipped(tmp_path):
+    """A frame corrupted in flight (after the CRCs were computed over
+    the true bytes): the remote applier rejects it without touching a
+    byte, and the next cycle re-reads the intact primary journal and
+    re-ships clean. The remote never holds the poisoned frame."""
+    from torchsnapshot_tpu import georep, journal, telemetry
+    from torchsnapshot_tpu.journal import DeltaJournal
+
+    telemetry.set_enabled(True)
+    try:
+        root = str(tmp_path / "primary")
+        remote = str(tmp_path / "remote")
+        step_dir = os.path.join(root, "step_0000000001")
+        state = _state(3)
+        Snapshot.take(step_dir, state)
+        j = DeltaJournal(step_dir, base_step=1, rank=0)
+        j.capture_baseline(state)
+        state["model"]["w"] = np.asarray(state["model"]["w"]) + 1.0
+        assert j.append_epoch(state) > 0
+
+        faultinject.configure("georep.ship@1=corrupt;seed=47")
+        rep = georep.GeoReplicator(remote, interval=0.05)
+        try:
+            rep.enqueue(step_dir, 1)
+            # The first attempt is rejected; the retry cycle re-ships
+            # the intact blob and converges.
+            assert rep.drain(timeout=30.0), rep.last_error
+        finally:
+            rep.close(0)
+            faultinject.disable()
+        assert telemetry.counters().get("georep_frames_rejected", 0) >= 1
+
+        remote_step = os.path.join(remote, "step_0000000001")
+        jdir = os.path.join(remote_step, journal.JOURNAL_DIRNAME)
+        committed = journal.committed_epochs(journal.read_epoch_metas(jdir))
+        assert [m["epoch"] for m in committed] == [1]
+        # Byte-identical to the primary's committed chain: the poisoned
+        # frame never spliced.
+        local_seg = os.path.join(
+            step_dir, journal.JOURNAL_DIRNAME, journal.segment_name(0)
+        )
+        remote_seg = os.path.join(jdir, journal.segment_name(0))
+        assert (
+            open(remote_seg, "rb").read() == open(local_seg, "rb").read()
+        )
+        dst = _zeros_like(state)
+        Snapshot(remote_step).restore(dst)
+        assert _equal(dst, state)
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(False)
+
+
+def test_chaos_georep_remote_outage_bounded_and_foreground_clean(tmp_path):
+    """A permanent remote-tier outage at the apply control point: the
+    foreground keeps committing (journal appends succeed untouched),
+    the backlog stays bounded, and the lag is loud. When the tier
+    returns, the shipper converges without operator action."""
+    from torchsnapshot_tpu import georep, telemetry
+    from torchsnapshot_tpu.journal import DeltaJournal
+
+    telemetry.set_enabled(True)
+    try:
+        root = str(tmp_path / "primary")
+        remote = str(tmp_path / "remote")
+        step_dir = os.path.join(root, "step_0000000001")
+        state = _state(5)
+        Snapshot.take(step_dir, state)
+        j = DeltaJournal(step_dir, base_step=1, rank=0)
+        j.capture_baseline(state)
+
+        faultinject.configure("georep.apply@1+=permanent")
+        rep = georep.GeoReplicator(remote, interval=0.05)
+        try:
+            # Foreground commits keep landing while every remote apply
+            # fails — the shipper absorbs the outage off the hot path.
+            for e in (1, 2, 3):
+                state["model"]["w"] = np.asarray(state["model"]["w"]) + 1.0
+                assert j.append_epoch(state) > 0
+                rep.enqueue(step_dir, 1)
+            assert not rep.drain(timeout=1.0)
+            assert rep.last_error, "the outage must be loud"
+            assert rep.backlog_epochs() >= 1
+            assert rep.lag_s() > 0.0
+            assert telemetry.counters().get("georep_ship_errors", 0) >= 1
+            # The tier comes back: convergence needs nothing but time.
+            faultinject.disable()
+            assert rep.drain(timeout=30.0), rep.last_error
+            assert rep.backlog_epochs() == 0
+        finally:
+            rep.close(0)
+            faultinject.disable()
+        dst = _zeros_like(state)
+        Snapshot(os.path.join(remote, "step_0000000001")).restore(dst)
+        assert _equal(dst, state)
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(False)
+
+
 def test_matrix_is_large_enough():
     """The acceptance floor: >= 30 deterministic schedules across
     backends and world sizes (kills and w2 drills included)."""
@@ -1106,5 +1299,8 @@ def test_matrix_is_large_enough():
         #      preemption-SIGTERM epoch flush (ISSUE 14)
         + 2  # fleet distribution: seed-peer SIGKILL mid-transfer +
         #      corrupt seeded chunk rejected (ISSUE 16)
+        + 3  # geo-replication: shipper SIGKILL mid-stream, corrupt
+        #      frame rejected + re-shipped, remote-tier outage bounded
+        #      (ISSUE 20)
     )
-    assert n >= 30, n
+    assert n >= 33, n

@@ -110,6 +110,10 @@ ENV_REGISTRY = frozenset({
     "TORCHSNAPSHOT_TPU_UPDATE_PUSH",
     "TORCHSNAPSHOT_TPU_VERIFY",
     "TORCHSNAPSHOT_TPU_AUTOTUNE",
+    "TORCHSNAPSHOT_TPU_GEOREP",
+    "TORCHSNAPSHOT_TPU_GEOREP_INTERVAL_S",
+    "TORCHSNAPSHOT_TPU_GEOREP_BACKLOG",
+    "TORCHSNAPSHOT_TPU_GEOREP_DRAIN_S",
 })
 
 #: Election-site governance (rule ``env-ungoverned``). Every knob the

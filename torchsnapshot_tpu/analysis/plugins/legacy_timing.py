@@ -55,6 +55,7 @@ BENCHMARK_ALLOWLIST = {
     "dma_overlap.py",
     "embedding_save.py",
     "fleet_restore.py",  # direct vs seeded fleet restore walls time wall clock
+    "georep_rpo.py",  # WAN ship walls + the foreground-overhead gate
     "manifest_scale.py",
     "journal_rpo.py",  # epoch-append vs full-save walls time wall clock
     "lazy_restore.py",  # TTFI vs eager restore walls time wall clock

@@ -62,6 +62,11 @@ on-call asks, so they get first-class commands here:
   standby): role, epoch, op-log position, per-replica lag and lease age
   (dist_store replication tier; docs/source/fault_tolerance.rst).
 
+- ``georep-status`` — the geo-replication plane of a snapshot ROOT:
+  remote cursor position, last applied generation, backlog epochs and
+  measured lag (georep.py; docs/source/fault_tolerance.rst,
+  "Cross-region disaster recovery").
+
 The inspection commands (``info``/``ls``/``cat``/``verify``) and
 ``consolidate`` work over any registered storage backend (fs://, s3://,
 gs://) because they reuse the plugin layer; plain paths mean fs.
@@ -426,6 +431,13 @@ INTERNAL_ARTIFACTS: Tuple[InternalArtifact, ...] = (
     # snapshots. Exempt from the orphan scan, but NOT unchecked — it has
     # its own fsck pass (_fsck_journal) with dedicated finding classes.
     InternalArtifact("journal", prefixes=(JOURNAL_DIRNAME,)),
+    # Geo-replication (georep.py): the durable cursor a remote-tier step
+    # directory carries. Exempt from the orphan scan, but NOT unchecked —
+    # _fsck_georep cross-checks it against the directory's own journal
+    # state (finding class georep-stale-cursor). In-flight ship temps use
+    # the shared ``.tmp.`` naming, so the temp-file class already covers
+    # them.
+    InternalArtifact("georep", files=(".georep_cursor.json",)),
 )
 
 
@@ -455,6 +467,7 @@ class FsckReport:
         "stale-fence",
         "journal-torn-tail",
         "journal-orphan-epoch",
+        "georep-stale-cursor",
     )
 
     def __init__(self) -> None:
@@ -778,6 +791,69 @@ def _fsck_journal(local_dir: str, report: FsckReport) -> None:
             report.journal_tails[seg_rel] = limit
 
 
+def _fsck_georep(local_dir: str, report: FsckReport) -> None:
+    """The geo-replication artifact class (georep.py): the durable
+    replication cursor a remote-tier step directory carries. Finding
+    class:
+
+    - ``georep-stale-cursor`` (repairable): the cursor is unparseable or
+      disagrees with the directory's OWN committed state — it names a
+      base step other than the directory's, claims more epochs than the
+      committed chain holds, or carries a generation the committed
+      metadata does not. The shipper never trusts the cursor blindly (it
+      re-probes the remote metadata and re-derives it), so the repair
+      simply quarantines the file.
+    """
+    import json as json_mod
+    import os
+
+    from . import georep as georep_mod
+    from . import journal as journal_mod
+
+    cpath = os.path.join(local_dir, georep_mod.CURSOR_FNAME)
+    if not os.path.isfile(cpath):
+        return
+    rel = georep_mod.CURSOR_FNAME
+    try:
+        with open(cpath, "r") as f:
+            cur = json_mod.load(f)
+        if not isinstance(cur, dict):
+            raise ValueError("not a JSON object")
+        epoch = int(cur["epoch"])
+        base_step = int(cur["base_step"])
+        gen = cur.get("gen")
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        report.add(
+            "georep-stale-cursor", rel,
+            f"unparseable replication cursor ({type(e).__name__}: {e}) — "
+            "the shipper re-derives it; safe to quarantine",
+        )
+        return
+    dir_m = georep_mod._STEP_RE.match(os.path.basename(local_dir.rstrip(os.sep)))
+    if dir_m is not None and int(dir_m.group(1)) != base_step:
+        report.add(
+            "georep-stale-cursor", rel,
+            f"cursor names base step {base_step}, directory is "
+            f"step {int(dir_m.group(1))}",
+        )
+        return
+    jdir = os.path.join(local_dir, JOURNAL_DIRNAME)
+    committed = journal_mod.committed_epochs(journal_mod.read_epoch_metas(jdir))
+    if epoch > len(committed):
+        report.add(
+            "georep-stale-cursor", rel,
+            f"cursor claims epoch {epoch} applied; the committed chain "
+            f"here holds {len(committed)} epoch(s)",
+        )
+        return
+    if epoch >= 1 and committed[epoch - 1].get("gen") != gen:
+        report.add(
+            "georep-stale-cursor", rel,
+            f"cursor carries generation {gen!r} for epoch {epoch}; the "
+            f"committed metadata says {committed[epoch - 1].get('gen')!r}",
+        )
+
+
 def _fsck_repair(local_dir: str, report: FsckReport, echo) -> None:
     """Quarantine repairable findings under ``.fsck_quarantine/``
     (preserving relative paths) — never deletes, never touches payload
@@ -914,6 +990,7 @@ def run_fsck(
     if local_dir is not None:
         _fsck_orphan_scan(local_dir, meta, report)
         _fsck_journal(local_dir, report)
+        _fsck_georep(local_dir, report)
     else:
         echo("note: remote backend — orphan scan skipped (payload and "
              "chain checks only)")
@@ -1747,6 +1824,56 @@ def cmd_store_status(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_georep_status(args: argparse.Namespace) -> int:
+    """Report the geo-replication plane of a snapshot ROOT: the latest
+    committed step vs the remote tier's durable cursor — base shipped or
+    not, last applied epoch + generation, backlog in epochs, and the
+    measured lag (the RPO exposure a region loss right now would add).
+    Exit 0 caught up, 1 behind, 2 cannot-check (no committed step, or no
+    remote tier configured and none given with --remote)."""
+    import json
+
+    from . import georep
+
+    info = georep.status(args.path, remote_root=args.remote)
+    if args.json:
+        print(json.dumps(info, indent=1, sort_keys=True))
+    else:
+        if not info.get("enabled"):
+            print(
+                f"{args.path}: geo-replication not configured (set "
+                f"{georep.GEOREP_ENV_VAR} or pass --remote)"
+            )
+            return 2
+        if info.get("step") is None:
+            print(f"{args.path}: no committed step to replicate")
+            return 2
+        print(
+            f"{args.path}: step {info['step']} -> {info['remote']}  "
+            f"({info.get('local_epochs', 0)} committed epoch(s), "
+            f"generation {info.get('local_gen')})"
+        )
+        if not info.get("base_replicated"):
+            print("  base: NOT replicated (no remote cursor/metadata)")
+        else:
+            print(
+                f"  cursor: epoch {info.get('applied_epoch')} applied, "
+                f"generation {info.get('applied_gen')}"
+            )
+        backlog = info.get("backlog_epochs") or 0
+        lag = info.get("lag_s")
+        if backlog:
+            print(
+                f"  BEHIND by {backlog} epoch(s); oldest unreplicated "
+                f"state is {lag}s old"
+            )
+        else:
+            print("  caught up (replication lag 0.0s)")
+    if not info.get("enabled") or info.get("step") is None:
+        return 2
+    return 1 if (info.get("backlog_epochs") or 0) else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m torchsnapshot_tpu",
@@ -1804,7 +1931,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="render .telemetry_history.jsonl of a snapshot ROOT "
                         "and gate on p50 regression (exit 1)")
     p.add_argument("--trend-metric", default="wall_s",
-                   choices=["wall_s", "write_gbps", "read_gbps"],
+                   choices=["wall_s", "write_gbps", "read_gbps",
+                            "replication_lag_s"],
                    help="history metric to gate on (default wall_s). "
                         "Constrained: a typo'd metric would match no "
                         "records and silently disarm the CI gate")
@@ -1936,6 +2064,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=5.0)
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_store_status)
+
+    p = sub.add_parser(
+        "georep-status",
+        help="report the geo-replication plane of a snapshot ROOT: "
+             "remote cursor position, last applied generation, backlog "
+             "epochs, measured lag (exit 0 caught up / 1 behind / "
+             "2 cannot-check)",
+    )
+    p.add_argument("path", help="snapshot ROOT directory (the primary)")
+    p.add_argument("--remote", default=None,
+                   help="remote tier root URL (default "
+                        "TORCHSNAPSHOT_TPU_GEOREP)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_georep_status)
 
     p = sub.add_parser(
         "lint",

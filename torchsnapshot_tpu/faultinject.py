@@ -133,6 +133,15 @@ SITES: Dict[str, str] = {
     # storage-boundary data sites (fs.read) the reads flow through.
     "pagein.prefetch": "control",
     "pagein.fault": "control",
+    # cross-region geo-replication (georep.py): the epoch blob as it
+    # leaves the shipper (corrupt/truncate must be caught by the remote
+    # apply's record CRCs before ANY remote byte changes; kill is the
+    # shipper-death-mid-ship drill — the cursor must resume exactly-once)
+    # and the remote apply step after segment bytes landed but before the
+    # epoch meta publishes (permanent models a remote-tier outage: the
+    # backlog must stay bounded and the foreground save unaffected).
+    "georep.ship": "data",
+    "georep.apply": "control",
 }
 
 KNOWN_SITES = frozenset(SITES)

@@ -53,7 +53,7 @@ import os
 import re
 import struct
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -386,6 +386,37 @@ def _materialize_leaf(header: Dict[str, Any], payload: memoryview, like: Any) ->
     return np.array(arr)
 
 
+# -------------------------------------------------------------- commit hooks
+#
+# Observers (the geo-replication shipper, most notably) register here to be
+# woken the moment an epoch commits, instead of polling the journal dir.
+# Hooks fire on rank 0 only, after the commit broadcast resolved — i.e. the
+# epoch meta is durably published — and are exception-isolated: a broken
+# observer must never fail a committed save.
+
+_COMMIT_HOOKS: List[Callable[[str, int, int], None]] = []
+
+
+def register_commit_hook(hook: Callable[[str, int, int], None]) -> None:
+    """Register ``hook(base_dir, base_step, epoch)`` to run on rank 0 after
+    every successful epoch commit. Idempotent per hook object."""
+    if hook not in _COMMIT_HOOKS:
+        _COMMIT_HOOKS.append(hook)
+
+
+def unregister_commit_hook(hook: Callable[[str, int, int], None]) -> None:
+    if hook in _COMMIT_HOOKS:
+        _COMMIT_HOOKS.remove(hook)
+
+
+def _fire_commit_hooks(base_dir: str, base_step: int, epoch: int) -> None:
+    for hook in list(_COMMIT_HOOKS):
+        try:
+            hook(base_dir, base_step, epoch)
+        except Exception as e:
+            logger.warning("journal commit hook %r failed: %s", hook, e)
+
+
 # -------------------------------------------------------------- DeltaJournal
 
 
@@ -483,6 +514,8 @@ class DeltaJournal:
         self.epoch = epoch
         for path, _fields, _payload, fp in pending:
             self._baseline[path] = fp
+        if self.rank == 0:
+            _fire_commit_hooks(self.base_dir, self.base_step, epoch)
         return n
 
     def _append_epoch_fenced(

@@ -167,6 +167,32 @@ class CheckpointManager:
         # Tenant-registry row published lazily at the first save (the
         # store may not be reachable at construction time).
         self._tenant_registered = False
+        # Async geo-replication shipper (georep.py): a rank-0 background
+        # daemon armed by TORCHSNAPSHOT_TPU_GEOREP — the one env check on
+        # the disabled path. Committed bases enqueue from _committed;
+        # committed journal epochs (emergency flushes included) wake it
+        # through the journal commit-hook registry; a preemption's
+        # consume() runs the bounded drain inside the grace window.
+        self._georep: Optional[Any] = None
+        self._georep_hook: Optional[Any] = None
+        from . import georep
+
+        georep_url = georep.remote_url()
+        if georep_url is not None and PGWrapper(self.pg).get_rank() == 0:
+            rep = georep.GeoReplicator(
+                georep_url, storage_options=self.storage_options
+            )
+            self._georep = rep
+
+            def _georep_on_epoch(
+                base_dir: str, base_step: int, _epoch: int
+            ) -> None:
+                rep.enqueue(base_dir, base_step)
+
+            self._georep_hook = _georep_on_epoch
+            journal.register_commit_hook(_georep_on_epoch)
+            if self.preemption is not None:
+                self.preemption.add_consume_hook(rep.drain)
         # Warm-start the IOGovernor's learned I/O profiles from this
         # root's history journal (autotune.py) so the FIRST managed save
         # already runs converged elections. Local roots only; one env
@@ -204,10 +230,24 @@ class CheckpointManager:
             logger.debug("tenant registration skipped", exc_info=True)
 
     def close(self) -> None:
-        """Release lifecycle state: wait out a pending async save and
-        plant this tenant's registry death notice (ghost key) so
-        readers stop counting it live."""
+        """Release lifecycle state: wait out a pending async save, drain
+        the geo-replication backlog (bounded by
+        TORCHSNAPSHOT_TPU_GEOREP_DRAIN_S), and plant this tenant's
+        registry death notice (ghost key) so readers stop counting it
+        live."""
         self.wait()
+        if self._georep is not None:
+            if self._georep_hook is not None:
+                journal.unregister_commit_hook(self._georep_hook)
+                self._georep_hook = None
+            if not self._georep.close():
+                logger.warning(
+                    "geo-replication drain timed out at close; remote tier "
+                    "%s is behind (last error: %s)",
+                    self._georep.remote_root,
+                    self._georep.last_error,
+                )
+            self._georep = None
         if self._tenant is not None and self._tenant_registered:
             if PGWrapper(self.pg).get_rank() == 0:
                 try:
@@ -654,6 +694,8 @@ class CheckpointManager:
 
     def _committed(self, step: int) -> None:
         self._last_committed = step
+        if self._georep is not None:
+            self._georep.enqueue(self.path_for(step), step)
         self._pool_sweep(step)
         self._apply_retention()
 

@@ -99,6 +99,7 @@ class PreemptionWatcher:
         self._flagged = threading.Event()
         self._signums: list = []
         self._consumed = False
+        self._consume_hooks: list = []
         self._prev = {}
         for sig in signals:
             self._prev[sig] = signal.signal(sig, self._handle)
@@ -173,12 +174,27 @@ class PreemptionWatcher:
         finally:
             wrapper.retire()
 
+    def add_consume_hook(self, hook) -> None:
+        """Run ``hook()`` inside :meth:`consume` — i.e. inside the grace
+        window, AFTER the durable state (emergency save or final journal
+        epoch) committed. The geo-replication shipper registers its
+        bounded drain here so the final epoch also reaches the remote
+        tier before the process dies. Hooks are exception-isolated: a
+        failed drain must never stall the teardown."""
+        if hook not in self._consume_hooks:
+            self._consume_hooks.append(hook)
+
     def consume(self) -> None:
         """Mark the preemption handled (a snapshot committed): subsequent
         ``CheckpointManager.save`` calls stop re-triggering while the
         loop finishes its grace-window teardown."""
         self._log_pending()
         self._consumed = True
+        for hook in list(self._consume_hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - teardown must proceed
+                logger.warning("preemption consume hook failed", exc_info=True)
 
     @property
     def consumed(self) -> bool:

@@ -1222,6 +1222,23 @@ class Snapshot:
                 self.path, app_state, pg_wrapper=pg_wrapper,
                 base_ok=exc is None,
             )
+            # DR provenance: a replication cursor in the directory means
+            # this restore ran against the REMOTE tier's copy (base +
+            # applied epochs) — the fleet is recovering from a region
+            # loss, which the operator log and counters should say.
+            from . import georep as _georep
+            from .storage_plugin import local_fs_root as _lfr
+
+            _local = _lfr(self.path)
+            if _local is not None and os.path.isfile(
+                os.path.join(_local, _georep.CURSOR_FNAME)
+            ):
+                telemetry.counter_add("dr_replica_restores", 1)
+                logger.info(
+                    "restored from a geo-replicated copy (%s present in %s)",
+                    _georep.CURSOR_FNAME,
+                    self.path,
+                )
             # BEFORE the raise: every rank reaches this point (per-key
             # failures are captured, the loop always completes), so the
             # unconditional telemetry gather stays symmetric even when

@@ -68,6 +68,16 @@ _SUMMED_COUNTERS = (
     # skipped by profile learning — a high count means the tuner is
     # flying blind (telemetry bus off / attribution failing).
     "profile_skips",
+    # Cross-region geo-replication (georep.py): what the rank-0 shipper
+    # moved, what it refused (CRC rejects, splice refusals), and what it
+    # shed under backlog pressure — the DR-tier health in one row.
+    "georep_bases_shipped",
+    "georep_epochs_shipped",
+    "georep_bytes_shipped",
+    "georep_ship_errors",
+    "georep_frames_rejected",
+    "georep_splice_refusals",
+    "georep_steps_dropped",
 )
 
 

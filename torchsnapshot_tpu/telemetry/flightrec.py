@@ -413,6 +413,23 @@ def merge_timeline(dumps: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                         "action": rec.get("action"),
                     }
                 )
+    # Replication lag collapses to the LATEST sample per (rank, tier):
+    # the shipper records georep.lag every failed cycle, and a hundred
+    # copies of the same aging backlog is one finding, not a hundred.
+    lagging: Dict[Any, Dict[str, Any]] = {}
+    for rank in ranks:
+        for rec in dumps[rank]:
+            if rec.get("ev") != "georep.lag":
+                continue
+            lagging[(rank, rec.get("tier"))] = {
+                "class": "replication-lag",
+                "rank": rank,
+                "tier": rec.get("tier"),
+                "backlog_epochs": rec.get("backlog_epochs"),
+                "lag_s": rec.get("lag_s"),
+                "error": rec.get("error"),
+            }
+    findings.extend(lagging[k] for k in sorted(lagging, key=str))
     return {
         "ranks": ranks,
         "aligned": aligned,
@@ -501,6 +518,13 @@ def render_timeline(merged: Dict[str, Any], verbose: bool = False) -> str:
                 f"{f.get('category')} at {f.get('frame')} "
                 f"({f.get('dumps')} consecutive dump(s), "
                 f"thread {f.get('thread')})"
+            )
+        elif cls == "replication-lag":
+            lines.append(
+                f"  REPLICATION-LAG rank {f['rank']} tier {f.get('tier')} "
+                f"is {f.get('backlog_epochs')} epoch(s) behind, oldest "
+                f"unshipped state {f.get('lag_s')}s old "
+                f"(last error: {f.get('error')})"
             )
     lines.append("")
     lines.append("timeline (relative seconds):")

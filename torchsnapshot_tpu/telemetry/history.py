@@ -59,6 +59,13 @@ _RECORD_COUNTERS = (
     "pages_prefetched",
     "pagein_bytes",
     "profile_skips",
+    "georep_bases_shipped",
+    "georep_epochs_shipped",
+    "georep_bytes_shipped",
+    "georep_ship_errors",
+    "georep_frames_rejected",
+    "georep_splice_refusals",
+    "georep_steps_dropped",
 )
 
 
@@ -105,6 +112,14 @@ def build_record(
     for key in ("write_gbps", "read_gbps"):
         if agg.get(key):
             rec[key] = round(agg[key], 4)
+    # The remote tier's RPO exposure at commit time. A gauge, not a
+    # summed counter: the shipper is rank-0-only, so the local gauge IS
+    # the fleet value — recorded so ``stats --trend`` can gate RPO.
+    from . import core
+
+    lag = (core.gauges() or {}).get("replication_lag_s")
+    if lag is not None:
+        rec["replication_lag_s"] = round(float(lag), 3)
     if fleet:
         rec["skew_s"] = fleet.get("skew_s")
         rec["slowest_rank"] = fleet.get("slowest_rank")
@@ -359,7 +374,11 @@ def render_trend(
     from .export import fmt_bytes
 
     lines = [f"history: {len(records)} committed take(s)"]
-    for metric, label in (("wall_s", "wall"), ("write_gbps", "write GB/s")):
+    for metric, label in (
+        ("wall_s", "wall"),
+        ("write_gbps", "write GB/s"),
+        ("replication_lag_s", "repl lag"),
+    ):
         series = [
             float(r[metric])
             for r in records

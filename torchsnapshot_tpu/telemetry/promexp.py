@@ -62,6 +62,8 @@ _HEARTBEAT_NUMERIC = (
     "written_bytes",
     "read_bytes",
     "total_bytes",
+    "georep_lag_s",
+    "georep_backlog",
 )
 
 

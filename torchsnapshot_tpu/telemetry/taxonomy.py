@@ -95,6 +95,13 @@ EVENTS: Dict[str, str] = {
     "deferred leaf (path, state, direct)",
     "pagein.complete": "every deferred leaf landed — the lazy restore "
     "reached eager-equivalent residency (units, faulted, wall_s)",
+    # cross-region geo-replication (georep.py)
+    "georep.ship": "a base snapshot or epoch blob left the shipper for "
+    "the remote tier (kind, step, nbytes, tier, dur_s)",
+    "georep.apply": "a shipped epoch was verified and folded onto the "
+    "remote tier — or refused (epoch, gen, nbytes, tier, ok)",
+    "georep.lag": "the shipper fell behind — a ship cycle failed and the "
+    "backlog is aging (tier, backlog_epochs, lag_s, error)",
 }
 
 FLIGHT_EVENTS = frozenset(EVENTS)
